@@ -1,0 +1,22 @@
+"""PipeGCN-TPU: a TPU-native framework for full-graph GNN training with
+pipelined boundary-node communication.
+
+Re-implements the capabilities of PipeGCN (ICLR 2022) — METIS-style graph
+partitioning across devices, per-layer halo (boundary node) feature exchange,
+cross-epoch pipelining of that exchange (staleness-1), optional smoothing
+corrections, and asynchronous gradient reduction — as a single SPMD JAX
+program over a `jax.sharding.Mesh`, instead of one Python process per
+partition with gloo p2p (reference: /root/reference/main.py:44-59,
+helper/feature_buffer.py).
+
+Layout:
+    graph/      host-side graph containers + dataset loaders (numpy)
+    partition/  graph partitioner + halo index pipeline (host, numpy)
+    ops/        TPU compute kernels (XLA segment-sum SpMM, Pallas kernels)
+    models/     GraphSAGE model family (pure JAX, functional params)
+    parallel/   mesh, halo exchange, pipelining, gradient reduction, SyncBN
+    train/      trainer, losses, metrics, evaluation
+    utils/      timers, logging, checkpointing, config
+"""
+
+__version__ = "0.1.0"

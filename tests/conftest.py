@@ -1,0 +1,13 @@
+"""Test configuration: force JAX onto 8 virtual CPU devices so multi-device
+sharding (the TPU analogue of the reference's localhost-gloo multiprocess
+testing, SURVEY.md §4) is exercised without TPU hardware.
+
+Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,86 @@
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph import (
+    Graph,
+    karate_club,
+    normalize_self_loops,
+    synthetic_graph,
+)
+from pipegcn_tpu.graph.datasets import inductive_split, is_multilabel, n_classes
+
+
+def test_self_loop_normalization():
+    g = Graph(
+        num_nodes=3,
+        src=np.array([0, 0, 1, 2, 2]),
+        dst=np.array([1, 0, 1, 2, 0]),
+    )
+    g2 = normalize_self_loops(g)
+    # exactly one self loop per node, original non-loop edges kept
+    loops = g2.src == g2.dst
+    assert loops.sum() == 3
+    assert g2.num_edges == 2 + 3  # (0->1), (2->0) kept + 3 loops
+
+
+def test_degrees_and_csr():
+    g = karate_club()
+    deg = g.in_degrees()
+    assert deg.sum() == g.num_edges
+    indptr, src_sorted, eid = g.in_csr()
+    assert indptr[-1] == g.num_edges
+    # row i of CSR holds sources of in-edges of node i
+    i = 5
+    row = src_sorted[indptr[i] : indptr[i + 1]]
+    expect = np.sort(g.src[g.dst == i])
+    np.testing.assert_array_equal(np.sort(row), expect)
+
+
+def test_subgraph():
+    g = karate_club()
+    nodes = np.arange(10)
+    sub = g.node_subgraph(nodes)
+    assert sub.num_nodes == 10
+    sub.validate()
+    # all subgraph edges exist in the original graph
+    orig = set(zip(g.src.tolist(), g.dst.tolist()))
+    for s, d in zip(sub.src, sub.dst):
+        assert (nodes[s], nodes[d]) in orig
+
+
+def test_synthetic_graph_shapes():
+    g = synthetic_graph(num_nodes=500, avg_degree=8, n_feat=16, n_class=5, seed=1)
+    g.validate()
+    assert g.ndata["feat"].shape == (500, 16)
+    assert n_classes(g) == 5
+    assert not is_multilabel(g)
+    masks = g.ndata["train_mask"] | g.ndata["val_mask"] | g.ndata["test_mask"]
+    assert masks.all()
+    assert (g.ndata["train_mask"] & g.ndata["val_mask"]).sum() == 0
+    # one self loop per node
+    assert (g.src == g.dst).sum() == 500
+
+
+def test_synthetic_multilabel():
+    g = synthetic_graph(num_nodes=200, n_class=6, multilabel=True, seed=2)
+    assert is_multilabel(g)
+    assert g.ndata["label"].shape == (200, 6)
+    assert n_classes(g) == 6
+
+
+def test_homophily_present():
+    # the generator should produce assortative structure — most edges
+    # intra-community — otherwise GNN tests on it are meaningless
+    g = synthetic_graph(num_nodes=2000, avg_degree=10, n_class=4, seed=3)
+    lab = g.ndata["label"]
+    non_loop = g.src != g.dst
+    frac_intra = (lab[g.src[non_loop]] == lab[g.dst[non_loop]]).mean()
+    assert frac_intra > 0.6
+
+
+def test_inductive_split():
+    g = synthetic_graph(num_nodes=300, seed=4)
+    train_g, val_g, test_g = inductive_split(g)
+    assert train_g.num_nodes == g.ndata["train_mask"].sum()
+    assert val_g.num_nodes == (g.ndata["train_mask"] | g.ndata["val_mask"]).sum()
+    assert test_g.num_nodes == g.num_nodes
