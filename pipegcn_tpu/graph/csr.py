@@ -102,6 +102,10 @@ class Graph:
             dst=new_id[self.dst[keep]],
             ndata={k: v[nodes] for k, v in self.ndata.items()},
         )
+        if "in_deg" in sub.ndata:
+            # derived data: recompute for the induced graph rather than
+            # keeping the full-graph degrees sliced above
+            sub.ndata["in_deg"] = sub.in_degrees().astype(np.float32)
         return sub
 
     def copy(self) -> "Graph":

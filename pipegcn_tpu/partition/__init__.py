@@ -1,0 +1,4 @@
+from .partitioner import partition_graph
+from .halo import ShardedGraph
+
+__all__ = ["partition_graph", "ShardedGraph"]

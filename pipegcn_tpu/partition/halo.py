@@ -1,0 +1,313 @@
+"""Halo index pipeline: partitioned graph -> static-shaped device arrays.
+
+This is the TPU-native replacement for the reference's entire per-rank
+graph-construction stack — boundary discovery (helper/utils.py:154-188),
+halo ordering + renumbering (train.py:84-131, 206-229), train-first
+permutation (train.py:134-155), and recv-shape computation
+(train.py:101-110) — done once on host in numpy, producing arrays whose
+shapes are identical on every device so a single SPMD program can be
+traced over them.
+
+Layout per device r (P devices total):
+
+  rows [0, N_max)           : inner (owned) nodes, train nodes first
+                              (local ids of train nodes are [0, n_train_r)),
+                              padded with zero rows up to N_max
+  rows [N_max + (d-1)*B_max + k) for d in 1..P-1, k in [0, B_max):
+                              halo slot k of ring distance d — after the
+                              exchange step at distance d it holds entry k
+                              of the send list of owner q = (r-d) mod P
+
+The send list S[r][d-1] contains local indices of r's inner nodes needed
+by the peer t = (r+d) mod P (nodes with an out-edge into t), sorted by
+local id, padded to B_max. Keying halo blocks by ring *distance* instead
+of owner rank (the reference sorts by owner rank, train.py:120-131) makes
+the ppermute-based exchange's recv offsets identical across devices —
+the property that lets one traced program serve all shards.
+
+Local edges: every global edge (u, v) with part(v) == r appears exactly
+once on device r as (src_local, dst_local); src_local is an inner id or a
+halo slot. Edge arrays are padded to E_max with (src=0, dst=N_max); the
+dst sentinel routes padded contributions into a dropped segment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..graph.csr import Graph
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m if m > 0 else x
+
+
+@dataclasses.dataclass
+class ShardedGraph:
+    """Stacked per-device arrays (leading axis = device / partition).
+
+    All integer index arrays are int32 (TPU-friendly); features float32.
+    """
+
+    num_parts: int
+    n_max: int          # padded inner-node rows per device
+    b_max: int          # padded send-list length (per peer distance)
+    e_max: int          # padded edge count per device
+    n_train_global: int
+    n_feat: int
+    n_class: int
+    multilabel: bool
+
+    inner_count: np.ndarray   # [P] real inner nodes per device
+    train_count: np.ndarray   # [P] train nodes per device (local ids [0, t))
+    edge_count: np.ndarray    # [P] real edges per device
+    send_counts: np.ndarray   # [P, P-1] real send-list lengths
+
+    edge_src: np.ndarray      # [P, E_max] int32 in [0, N_max + (P-1)*B_max)
+    edge_dst: np.ndarray      # [P, E_max] int32 in [0, N_max]; N_max = pad
+    send_idx: np.ndarray      # [P, P-1, B_max] int32 local inner ids
+    send_mask: np.ndarray     # [P, P-1, B_max] bool
+
+    feat: np.ndarray          # [P, N_max, F]
+    label: np.ndarray         # [P, N_max] int64 or [P, N_max, C] float32
+    train_mask: np.ndarray    # [P, N_max] bool (padding rows False)
+    val_mask: np.ndarray      # [P, N_max] bool
+    test_mask: np.ndarray     # [P, N_max] bool
+    in_deg: np.ndarray        # [P, N_max] float32 (padding rows 1.0)
+    global_nid: np.ndarray    # [P, N_max] int64 (padding rows -1)
+
+    @property
+    def halo_size(self) -> int:
+        return (self.num_parts - 1) * self.b_max
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def build(
+        g: Graph,
+        parts: np.ndarray,
+        n_parts: Optional[int] = None,
+        pad_to: int = 8,
+    ) -> "ShardedGraph":
+        """Build the sharded layout from a graph and a partition assignment.
+
+        `g` must be finalized (self loops + in_deg). `parts` is [N] int.
+        `n_parts` is the intended device count; defaults to parts.max()+1
+        but must be passed explicitly when trailing partitions could be
+        empty (an empty shard is valid, just wasteful).
+        """
+        n = g.num_nodes
+        parts = parts.astype(np.int32)
+        num_parts = int(n_parts) if n_parts is not None else int(parts.max()) + 1
+        if num_parts < int(parts.max()) + 1:
+            raise ValueError(
+                f"n_parts={num_parts} smaller than max partition id "
+                f"{int(parts.max())}"
+            )
+        train_mask = g.ndata["train_mask"]
+
+        # ---- local ids: train-first within each partition ------------
+        # sort nodes by (part, ~is_train, global id) -> contiguous blocks
+        order = np.lexsort((np.arange(n), ~train_mask, parts))
+        part_sizes = np.bincount(parts, minlength=num_parts)
+        part_starts = np.zeros(num_parts + 1, dtype=np.int64)
+        np.cumsum(part_sizes, out=part_starts[1:])
+        local_id = np.empty(n, dtype=np.int64)
+        local_id[order] = np.arange(n) - part_starts[parts[order]]
+
+        inner_count = part_sizes.astype(np.int32)
+        train_count = np.bincount(
+            parts[train_mask], minlength=num_parts
+        ).astype(np.int32)
+
+        n_max = _round_up(int(part_sizes.max()), pad_to)
+
+        # ---- send lists ----------------------------------------------
+        # cross edges define which (owner node, dest part) pairs exist
+        cross = parts[g.src] != parts[g.dst]
+        cs, cd = g.src[cross], g.dst[cross]
+        pair = np.unique(
+            np.stack([cs, parts[cd].astype(np.int64)], axis=1), axis=0
+        )  # [(node, dest part)] unique
+        p_node, p_dest = pair[:, 0], pair[:, 1].astype(np.int32)
+        p_owner = parts[p_node]
+        # sort by (owner, dest, local id) -> grouped send lists in order
+        skey = np.lexsort((local_id[p_node], p_dest, p_owner))
+        p_node, p_dest, p_owner = p_node[skey], p_dest[skey], p_owner[skey]
+
+        # group starts for each (owner, dest) combination
+        combo = p_owner.astype(np.int64) * num_parts + p_dest
+        send_counts = np.bincount(
+            combo, minlength=num_parts * num_parts
+        ).reshape(num_parts, num_parts)
+        assert np.all(np.diag(send_counts) == 0)
+        b_max = _round_up(int(send_counts.max()), pad_to) if num_parts > 1 else 0
+
+        combo_starts = np.zeros(num_parts * num_parts + 1, dtype=np.int64)
+        np.cumsum(send_counts.reshape(-1), out=combo_starts[1:])
+        rank_in_group = np.arange(pair.shape[0]) - combo_starts[combo]
+
+        # send_idx[r, d-1, k] = local id of k-th node r sends to (r+d)%P
+        # (empty index arrays make these assignments no-ops, so the exact
+        # shape works for P == 1 and b_max == 0 too)
+        send_idx = np.zeros((num_parts, num_parts - 1, b_max), dtype=np.int32)
+        send_mask = np.zeros_like(send_idx, dtype=bool)
+        dist = (p_dest - p_owner) % num_parts  # ring distance in 1..P-1
+        send_idx[p_owner, dist - 1, rank_in_group] = local_id[p_node].astype(
+            np.int32
+        )
+        send_mask[p_owner, dist - 1, rank_in_group] = True
+
+        # ---- halo slot lookup for cross-edge sources ------------------
+        # For an edge (u, v) on device r=part(v): slot index of u is
+        # n_max + (dist-1)*b_max + rank of (u, r) in u's-owner send list.
+        # Build a lookup from pair -> rank via a dict-free merge: the pair
+        # array is sorted by (owner, dest, local id); edges can be matched
+        # with searchsorted over a fused key.
+        fused_pair = p_node.astype(np.int64) * num_parts + p_dest
+        fused_sorted_order = np.argsort(fused_pair, kind="stable")
+        fused_sorted = fused_pair[fused_sorted_order]
+
+        # ---- per-device edges ----------------------------------------
+        edge_owner = parts[g.dst]  # device that owns each edge
+        e_sizes = np.bincount(edge_owner, minlength=num_parts)
+        e_max = _round_up(int(e_sizes.max()), 128)
+
+        dst_local_all = local_id[g.dst].astype(np.int64)
+        src_inner = parts[g.src] == parts[g.dst]
+        # inner source -> local id; halo source -> slot
+        edge_fused = g.src.astype(np.int64) * num_parts + parts[g.dst]
+        loc = np.searchsorted(fused_sorted, edge_fused)
+        # (only valid where cross; guard indices)
+        loc = np.clip(loc, 0, max(fused_sorted.size - 1, 0))
+        if fused_sorted.size:
+            halo_rank = rank_in_group[fused_sorted_order][loc]
+            halo_dist = dist[fused_sorted_order][loc]
+        else:
+            halo_rank = np.zeros_like(edge_fused)
+            halo_dist = np.ones_like(edge_fused)
+        src_local_all = np.where(
+            src_inner,
+            local_id[g.src],
+            n_max + (halo_dist - 1) * b_max + halo_rank,
+        ).astype(np.int64)
+
+        # scatter edges into per-device padded arrays
+        e_order = np.argsort(edge_owner, kind="stable")
+        e_starts = np.zeros(num_parts + 1, dtype=np.int64)
+        np.cumsum(e_sizes, out=e_starts[1:])
+        edge_src = np.zeros((num_parts, e_max), dtype=np.int32)
+        edge_dst = np.full((num_parts, e_max), n_max, dtype=np.int32)
+        pos_in_dev = np.arange(g.num_edges) - e_starts[edge_owner[e_order]]
+        edge_src[edge_owner[e_order], pos_in_dev] = src_local_all[e_order]
+        edge_dst[edge_owner[e_order], pos_in_dev] = dst_local_all[e_order]
+
+        # ---- per-device node data ------------------------------------
+        def scatter_nodes(x: np.ndarray, fill) -> np.ndarray:
+            shape = (num_parts, n_max) + x.shape[1:]
+            out = np.full(shape, fill, dtype=x.dtype)
+            out[parts, local_id] = x
+            return out
+
+        feat = scatter_nodes(g.ndata["feat"].astype(np.float32), 0.0)
+        label_arr = g.ndata["label"]
+        multilabel = label_arr.ndim == 2
+        if multilabel:
+            label = scatter_nodes(label_arr.astype(np.float32), 0.0)
+            n_class = int(label_arr.shape[1])
+        else:
+            label = scatter_nodes(label_arr.astype(np.int64), 0)
+            n_class = int(label_arr.max()) + 1
+        tm = scatter_nodes(train_mask.astype(bool), False)
+        vm = scatter_nodes(
+            g.ndata.get("val_mask", np.zeros(n, bool)).astype(bool), False
+        )
+        sm = scatter_nodes(
+            g.ndata.get("test_mask", np.zeros(n, bool)).astype(bool), False
+        )
+        # degrees of the graph being partitioned (reference utils.py:142);
+        # finalize()/node_subgraph keep ndata['in_deg'] consistent with the
+        # attached graph, so prefer it over an O(E) recompute
+        deg = g.ndata.get("in_deg")
+        if deg is None:
+            deg = g.in_degrees()
+        in_deg = scatter_nodes(deg.astype(np.float32), 1.0)
+        in_deg[in_deg == 0] = 1.0
+        gnid = scatter_nodes(np.arange(n, dtype=np.int64), -1)
+
+        return ShardedGraph(
+            num_parts=num_parts,
+            n_max=n_max,
+            b_max=b_max,
+            e_max=e_max,
+            n_train_global=int(train_mask.sum()),
+            n_feat=int(feat.shape[-1]),
+            n_class=n_class,
+            multilabel=multilabel,
+            inner_count=inner_count,
+            train_count=train_count,
+            edge_count=e_sizes.astype(np.int32),
+            send_counts=send_counts[
+                np.arange(num_parts)[:, None],
+                (np.arange(num_parts)[:, None] + np.arange(1, max(num_parts, 2)))
+                % num_parts,
+            ].astype(np.int32) if num_parts > 1 else np.zeros((1, 0), np.int32),
+            edge_src=edge_src,
+            edge_dst=edge_dst,
+            send_idx=send_idx,
+            send_mask=send_mask,
+            feat=feat,
+            label=label,
+            train_mask=tm,
+            val_mask=vm,
+            test_mask=sm,
+            in_deg=in_deg,
+            global_nid=gnid,
+        )
+
+    # ------------------------------------------------------------------
+    # Partition artifact on disk (reference: dgl partition JSON + per-part
+    # files, helper/utils.py:132-144 / 99-129; enables --skip-partition).
+
+    _ARRAYS = [
+        "inner_count", "train_count", "edge_count", "send_counts",
+        "edge_src", "edge_dst", "send_idx", "send_mask", "feat", "label",
+        "train_mask", "val_mask", "test_mask", "in_deg", "global_nid",
+    ]
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        manifest = {
+            "format_version": 1,
+            "num_parts": self.num_parts,
+            "n_max": self.n_max,
+            "b_max": self.b_max,
+            "e_max": self.e_max,
+            "n_train_global": self.n_train_global,
+            "n_feat": self.n_feat,
+            "n_class": self.n_class,
+            "multilabel": self.multilabel,
+        }
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        np.savez_compressed(
+            os.path.join(path, "arrays.npz"),
+            **{k: getattr(self, k) for k in self._ARRAYS},
+        )
+
+    @staticmethod
+    def load(path: str) -> "ShardedGraph":
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        manifest.pop("format_version", None)
+        arrays = np.load(os.path.join(path, "arrays.npz"))
+        return ShardedGraph(**manifest, **{k: arrays[k] for k in
+                                           ShardedGraph._ARRAYS})
+
+    @staticmethod
+    def exists(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "manifest.json"))

@@ -1,0 +1,218 @@
+"""Graph partitioner.
+
+Replaces the reference's dependency on METIS via a customized DGL fork
+(reference helper/utils.py:132-144; the fork exists only to pass
+`objtype` through to METIS, README.md:62). Supported surface is the same:
+
+    method = 'metis' | 'random'     (reference helper/parser.py:39-42)
+    obj    = 'vol' | 'cut'
+
+'metis' here is a self-contained locality-aware partitioner, fully
+vectorized so it scales to 100M+ edge graphs on host:
+
+    1. BFS ordering of the whole graph (random restart per connected
+       component) — nodes close in the graph are close in the order;
+    2. contiguous balanced blocks of that order as the initial partition;
+    3. parallel greedy refinement sweeps moving boundary nodes to the
+       neighboring partition with the best objective gain, subject to a
+       balance cap (a vectorized, conflict-tolerant variant of
+       Fiduccia–Mattheyses, in the spirit of parallel refiners like Jet).
+
+It is not METIS, but fills the same role; partition quality affects
+communication volume, not correctness. A native C++ multilevel
+implementation can be swapped in behind the same signature.
+
+Objectives:
+    'cut' — minimize the number of edges crossing partitions.
+    'vol' — minimize total communication volume: the number of distinct
+            (node, foreign-partition) pairs, i.e. how many halo rows get
+            exchanged per layer. This is the objective that matters for
+            PipeGCN-style training.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..graph.csr import Graph
+
+
+def partition_graph(
+    g: Graph,
+    n_parts: int,
+    method: str = "metis",
+    obj: str = "vol",
+    seed: int = 0,
+    refine_iters: int = 10,
+    imbalance: float = 1.05,
+) -> np.ndarray:
+    """Assign each node to one of `n_parts` partitions.
+
+    Returns an int32 array [num_nodes] of partition ids. Every partition is
+    guaranteed non-empty (each device must own at least one node).
+    """
+    if n_parts <= 0:
+        raise ValueError(f"n_parts must be positive, got {n_parts}")
+    if method not in ("metis", "random"):
+        raise ValueError(f"unknown partition method: {method}")
+    if obj not in ("vol", "cut"):
+        raise ValueError(f"unknown partition objective: {obj}")
+    if n_parts > g.num_nodes:
+        raise ValueError(
+            f"n_parts={n_parts} exceeds num_nodes={g.num_nodes}"
+        )
+    if n_parts == 1:
+        return np.zeros(g.num_nodes, dtype=np.int32)
+
+    rng = np.random.default_rng(seed)
+    if method == "random":
+        # Balanced random assignment (reference part_method='random').
+        parts = np.repeat(
+            np.arange(n_parts, dtype=np.int32), -(-g.num_nodes // n_parts)
+        )[: g.num_nodes]
+        rng.shuffle(parts)
+        return parts
+
+    adj = _sym_adj(g)
+    order = _bfs_order(adj, rng)
+    # contiguous balanced blocks of the BFS order
+    parts = np.empty(g.num_nodes, dtype=np.int32)
+    parts[order] = (
+        np.arange(g.num_nodes, dtype=np.int64) * n_parts // g.num_nodes
+    ).astype(np.int32)
+    parts = _refine(adj, parts, n_parts, obj, refine_iters, imbalance, rng)
+    return parts
+
+
+def _sym_adj(g: Graph) -> sp.csr_matrix:
+    """Symmetric 0/1 adjacency without self loops."""
+    non_loop = g.src != g.dst
+    u = np.concatenate([g.src[non_loop], g.dst[non_loop]])
+    v = np.concatenate([g.dst[non_loop], g.src[non_loop]])
+    n = g.num_nodes
+    a = sp.csr_matrix(
+        (np.ones(u.shape[0], dtype=np.int32), (u, v)), shape=(n, n)
+    )
+    a.data[:] = 1  # collapse duplicate edges
+    return a
+
+
+def _bfs_order(adj: sp.csr_matrix, rng) -> np.ndarray:
+    """Vectorized BFS ordering covering all components (restart at a random
+    unvisited node per component)."""
+    n = adj.shape[0]
+    visited = np.zeros(n, dtype=bool)
+    order = np.empty(n, dtype=np.int64)
+    pos = 0
+    # restart cursor over a fixed random permutation: amortized O(N) over
+    # all components instead of an O(N) scan per component
+    restart_perm = rng.permutation(n)
+    cursor = 0
+    while pos < n:
+        while cursor < n and visited[restart_perm[cursor]]:
+            cursor += 1
+        start = int(restart_perm[cursor])
+        frontier = np.array([start])
+        visited[start] = True
+        order[pos] = start
+        pos += 1
+        while frontier.size:
+            # union of neighbors of the frontier, via one sparse matvec
+            ind = np.unique(adj[frontier].indices)
+            ind = ind[~visited[ind]]
+            if ind.size == 0:
+                break
+            visited[ind] = True
+            order[pos: pos + ind.size] = ind
+            pos += ind.size
+            frontier = ind
+    return order
+
+
+def _refine(
+    adj: sp.csr_matrix,
+    parts: np.ndarray,
+    n_parts: int,
+    obj: str,
+    iters: int,
+    imbalance: float,
+    rng,
+) -> np.ndarray:
+    """Parallel greedy refinement. Each sweep computes, for every node, its
+    neighbor count per partition (one sparse-dense matmul), derives move
+    gains for the requested objective, and applies the highest-gain moves
+    subject to the per-partition balance cap."""
+    n = adj.shape[0]
+    parts = parts.astype(np.int32).copy()
+    cap = int(imbalance * (-(-n // n_parts)))
+    arange = np.arange(n)
+
+    for _ in range(iters):
+        onehot = sp.csr_matrix(
+            (np.ones(n, dtype=np.float32), (arange, parts)),
+            shape=(n, n_parts),
+        )
+        counts = np.asarray((adj @ onehot).todense())  # [N, P]
+        own = counts[arange, parts]
+        if obj == "cut":
+            gains = counts - own[:, None]
+        else:  # vol: also count the halo pairs this node creates/removes
+            gains = (
+                counts
+                - own[:, None]
+                + (counts > 0).astype(np.float32)
+                - (own > 0).astype(np.float32)[:, None]
+            )
+        gains[arange, parts] = -np.inf
+        target = np.argmax(gains, axis=1).astype(np.int32)
+        gain = gains[arange, target]
+        movers = np.nonzero(gain > 0)[0]
+        if movers.size == 0:
+            break
+
+        # enforce balance: admit the best movers into each target part up
+        # to its remaining room, and never drain a part empty
+        sizes = np.bincount(parts, minlength=n_parts)
+        room = np.maximum(cap - sizes, 0)
+        # sort movers by (target, -gain); rank within target group
+        key = np.lexsort((-gain[movers], target[movers]))
+        movers = movers[key]
+        tgt = target[movers]
+        grp_start = np.searchsorted(tgt, np.arange(n_parts))
+        rank = arange[: movers.size] - grp_start[tgt]
+        admitted = movers[rank < room[tgt]]
+        if admitted.size == 0:
+            break
+        parts[admitted] = target[admitted]
+        _fill_empty_parts(parts, n_parts)
+    _fill_empty_parts(parts, n_parts)
+    return parts
+
+
+def _fill_empty_parts(parts: np.ndarray, n_parts: int) -> None:
+    """Ensure every partition owns at least one node (each device must hold
+    a shard); steal single nodes from the currently largest partition."""
+    sizes = np.bincount(parts, minlength=n_parts)
+    for p in np.nonzero(sizes == 0)[0]:
+        donor = int(np.argmax(sizes))
+        parts[np.nonzero(parts == donor)[0][0]] = p
+        sizes[donor] -= 1
+        sizes[p] += 1
+
+
+def edge_cut(g: Graph, parts: np.ndarray) -> int:
+    """Number of non-self-loop directed edges crossing partitions."""
+    non_loop = g.src != g.dst
+    return int((parts[g.src[non_loop]] != parts[g.dst[non_loop]]).sum())
+
+
+def comm_volume(g: Graph, parts: np.ndarray) -> int:
+    """Total halo pairs: distinct (node, foreign partition consuming it)."""
+    non_loop = g.src != g.dst
+    src, dst = g.src[non_loop], g.dst[non_loop]
+    cross = parts[src] != parts[dst]
+    pairs = np.unique(
+        np.stack([src[cross], parts[dst[cross]].astype(np.int64)], 1), axis=0
+    )
+    return int(pairs.shape[0])
