@@ -1,0 +1,3 @@
+from .sage import ModelConfig, init_params, forward, init_norm_state
+
+__all__ = ["ModelConfig", "init_params", "forward", "init_norm_state"]

@@ -1,0 +1,253 @@
+"""GraphSAGE model family — pure-JAX functional implementation.
+
+Behavioral parity with the reference (module/model.py:25-58,
+module/layer.py:8-62, module/sync_bn.py:7-56), re-architected for TPU:
+parameters are explicit pytrees, communication is an injected callback
+(`comm_update`) instead of a process-global buffer singleton
+(reference helper/context.py:4-5), and distributed normalization takes an
+injected `psum` so the same code runs single-device (psum = identity) and
+inside `shard_map` (psum over the mesh axis).
+
+Layer stack (reference module/model.py:29-38): `n_layers - n_linear`
+graph layers followed by `n_linear` plain dense layers; LayerNorm or
+SyncBatchNorm + activation between all but the last layer. Per-layer
+training order (module/model.py:43-57): comm update -> dropout -> layer
+-> norm -> activation.
+
+Graph layer semantics (module/layer.py:40-62):
+  training:  ah = spmm(fbuf)/in_deg;  h = fbuf[:n_dst] @ W1 + ah @ W2 (+b)
+             (first layer under use_pp: h = fbuf @ W (+b), input is the
+             precomputed [feat, mean-neighbor-feat] concat of width 2F)
+  eval:      same weights on a full homogeneous graph, degrees from the
+             graph itself; use_pp layer computes concat(feat, ah) @ W.
+
+Init (module/layer.py:24-36): U(-1/sqrt(fan_in), +1/sqrt(fan_in)) for all
+weights and biases (the dense tail's torch default has the same bounds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.spmm import spmm_mean
+
+Params = dict
+PsumFn = Callable[[jax.Array], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    layer_sizes: Tuple[int, ...]   # [in_feat, hidden..., n_class]
+    n_linear: int = 0              # dense tail layers (Yelp uses 2)
+    use_pp: bool = False
+    norm: Optional[str] = "layer"  # 'layer' | 'batch' | None
+    dropout: float = 0.5
+    train_size: int = 0            # global n_train (SyncBN divisor, loss)
+    spmm_chunk: Optional[int] = None
+    sorted_edges: bool = False     # edge_dst ascending (CSR order)
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layer_sizes) - 1
+
+    @property
+    def n_graph_layers(self) -> int:
+        return self.n_layers - self.n_linear
+
+
+def _uniform(rng, shape, bound):
+    return jax.random.uniform(
+        rng, shape, minval=-bound, maxval=bound, dtype=jnp.float32
+    )
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Parameter pytree: {'layers': [...], 'norms': [...]}.
+
+    Graph layers hold {'w1','b1','w2','b2'} (or {'w','b'} for the pp first
+    layer); dense tail layers hold {'w','b'}; norm entries hold
+    {'scale','bias'}. Weights are stored [in, out] (right-multiply).
+    """
+    layers: List[dict] = []
+    norms: List[dict] = []
+    use_pp = cfg.use_pp
+    for i in range(cfg.n_layers):
+        d_in, d_out = cfg.layer_sizes[i], cfg.layer_sizes[i + 1]
+        rng, k1, k2, k3, k4 = jax.random.split(rng, 5)
+        if i < cfg.n_graph_layers:
+            if use_pp and i == 0:
+                bound = 1.0 / (2 * d_in) ** 0.5
+                layers.append({
+                    "w": _uniform(k1, (2 * d_in, d_out), bound),
+                    "b": _uniform(k2, (d_out,), bound),
+                })
+            else:
+                bound = 1.0 / d_in ** 0.5
+                layers.append({
+                    "w1": _uniform(k1, (d_in, d_out), bound),
+                    "b1": _uniform(k2, (d_out,), bound),
+                    "w2": _uniform(k3, (d_in, d_out), bound),
+                    "b2": _uniform(k4, (d_out,), bound),
+                })
+        else:
+            bound = 1.0 / d_in ** 0.5
+            layers.append({
+                "w": _uniform(k1, (d_in, d_out), bound),
+                "b": _uniform(k2, (d_out,), bound),
+            })
+        if i < cfg.n_layers - 1 and cfg.norm is not None:
+            norms.append({
+                "scale": jnp.ones((d_out,), jnp.float32),
+                "bias": jnp.zeros((d_out,), jnp.float32),
+            })
+    return {"layers": layers, "norms": norms}
+
+
+def init_norm_state(cfg: ModelConfig) -> List[dict]:
+    """Running mean/var for SyncBatchNorm (reference sync_bn.py:44-47);
+    empty list unless norm == 'batch'."""
+    if cfg.norm != "batch":
+        return []
+    return [
+        {
+            "mean": jnp.zeros((cfg.layer_sizes[i + 1],), jnp.float32),
+            "var": jnp.ones((cfg.layer_sizes[i + 1],), jnp.float32),
+        }
+        for i in range(cfg.n_layers - 1)
+    ]
+
+
+def _layer_norm(h, scale, bias, eps=1e-5):
+    mu = h.mean(axis=-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (h - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _sync_batch_norm_train(h, scale, bias, state, whole_size, psum,
+                           row_mask=None, momentum=0.1, eps=1e-5):
+    """Distributed BN over all rows across devices (reference
+    sync_bn.py:13-22): statistics = psum of per-device sums divided by the
+    global train size. `row_mask` excludes padded rows, whose values are
+    nonzero layer outputs here (the reference has no padding; its rows are
+    exactly the inner nodes). Returns (out, new_state)."""
+    hm = h if row_mask is None else h * row_mask[:, None]
+    sum_x = psum(hm.sum(axis=0))
+    sum_x2 = psum((hm * hm).sum(axis=0))
+    mean = sum_x / whole_size
+    var = (sum_x2 - mean * sum_x) / whole_size
+    new_state = {
+        "mean": state["mean"] * (1 - momentum) + mean * momentum,
+        "var": state["var"] * (1 - momentum) + var * momentum,
+    }
+    x_hat = (h - mean) * jax.lax.rsqrt(var + eps)
+    return x_hat * scale + bias, new_state
+
+
+def _sync_batch_norm_eval(h, scale, bias, state, eps=1e-5):
+    x_hat = (h - state["mean"]) * jax.lax.rsqrt(state["var"] + eps)
+    return x_hat * scale + bias
+
+
+def _dropout(rng, h, rate):
+    if rate <= 0.0:
+        return h
+    keep = jax.random.bernoulli(rng, 1.0 - rate, h.shape)
+    return jnp.where(keep, h / (1.0 - rate), 0.0)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    h: jax.Array,
+    edge_src: jax.Array,
+    edge_dst: jax.Array,
+    in_deg: jax.Array,
+    n_dst: int,
+    *,
+    training: bool,
+    rng: Optional[jax.Array] = None,
+    comm_update: Optional[Callable[[int, jax.Array], jax.Array]] = None,
+    norm_state: Optional[List[dict]] = None,
+    psum: PsumFn = lambda x: x,
+    eval_pp_agg: bool = False,
+    row_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, List[dict]]:
+    """Run the GraphSAGE stack; returns (logits [n_dst, n_class],
+    updated norm_state).
+
+    Training (`training=True`): `comm_update(i, h)` must return the
+    aggregation source buffer (inner rows + halo rows) for graph layer i;
+    it is skipped for layer 0 under use_pp (reference model.py:45-46).
+    `in_deg` are the precomputed full-graph degrees.
+
+    Eval (`training=False`): the graph is the full homogeneous graph
+    (edge_src == edge_dst space, no halo), `in_deg` its own degrees, no
+    dropout, running stats for BN. `eval_pp_agg=True` makes the first
+    layer compute concat(feat, ah) @ W (use_pp eval path,
+    module/layer.py:58-60).
+    """
+    norm_state = norm_state if norm_state is not None else []
+    new_norm_state: List[dict] = []
+    use_norm = cfg.norm is not None
+
+    for i in range(cfg.n_layers):
+        is_graph = i < cfg.n_graph_layers
+        if training and cfg.dropout > 0:
+            rng, sub = jax.random.split(rng)
+        if is_graph:
+            if training:
+                if (i > 0 or not cfg.use_pp) and comm_update is not None:
+                    h = comm_update(i, h)
+                if cfg.dropout > 0:
+                    h = _dropout(sub, h, cfg.dropout)
+                lp = params["layers"][i]
+                if cfg.use_pp and i == 0:
+                    h = h @ lp["w"] + lp["b"]
+                else:
+                    ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,
+                                   cfg.spmm_chunk, cfg.sorted_edges)
+                    h = (h[:n_dst] @ lp["w1"] + lp["b1"]
+                         + ah @ lp["w2"] + lp["b2"])
+            else:
+                lp = params["layers"][i]
+                ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,
+                               cfg.spmm_chunk, cfg.sorted_edges)
+                if cfg.use_pp and i == 0:
+                    if not eval_pp_agg:
+                        raise ValueError(
+                            "use_pp model evaluated without eval_pp_agg"
+                        )
+                    h = jnp.concatenate([h, ah], axis=1) @ lp["w"] + lp["b"]
+                else:
+                    h = h @ lp["w1"] + lp["b1"] + ah @ lp["w2"] + lp["b2"]
+        else:
+            if training and cfg.dropout > 0:
+                h = _dropout(sub, h, cfg.dropout)
+            lp = params["layers"][i]
+            h = h @ lp["w"] + lp["b"]
+
+        if i < cfg.n_layers - 1:
+            if use_norm:
+                np_ = params["norms"][i]
+                if cfg.norm == "layer":
+                    h = _layer_norm(h, np_["scale"], np_["bias"])
+                else:  # batch
+                    if training:
+                        h, ns = _sync_batch_norm_train(
+                            h, np_["scale"], np_["bias"], norm_state[i],
+                            cfg.train_size, psum, row_mask,
+                        )
+                        new_norm_state.append(ns)
+                    else:
+                        h = _sync_batch_norm_eval(
+                            h, np_["scale"], np_["bias"], norm_state[i]
+                        )
+            h = jax.nn.relu(h)
+
+    if training and cfg.norm == "batch":
+        return h, new_norm_state
+    return h, norm_state

@@ -1,0 +1,3 @@
+from .spmm import spmm_sum, spmm_mean
+
+__all__ = ["spmm_sum", "spmm_mean"]

@@ -1,0 +1,14 @@
+from .mesh import make_mesh, PARTS_AXIS
+from .halo import halo_exchange, exchange_blocks, return_blocks, make_stale_concat
+from .trainer import Trainer, TrainConfig
+
+__all__ = [
+    "make_mesh",
+    "PARTS_AXIS",
+    "halo_exchange",
+    "exchange_blocks",
+    "return_blocks",
+    "make_stale_concat",
+    "Trainer",
+    "TrainConfig",
+]

@@ -1,0 +1,142 @@
+"""Device-side halo (boundary node) exchange.
+
+The TPU-native replacement for the reference's entire comm stack —
+ring-staggered gloo isend/irecv with pinned CPU staging, CUDA streams,
+events and message tags (helper/feature_buffer.py:165-206) — expressed as
+gather -> `lax.ppermute` -> concat inside `shard_map`. XLA differentiates
+it (gather transposes to scatter-add, ppermute to the reverse ring), so
+the vanilla path needs no hand-written backward; race-freedom is by
+construction, and the event/stream/tag apparatus disappears.
+
+Functions here run *inside* shard_map: array args are per-device blocks.
+
+Ring layout (see partition.halo.ShardedGraph): at distance d, device r
+sends `h[send_idx[d-1]]` to (r+d) mod P and receives the block whose rows
+belong to owner (r-d) mod P; received blocks concatenate behind the inner
+rows in distance order, matching the precomputed halo slot numbering.
+
+`make_stale_concat` is the pipelined (staleness-1) variant: consuming
+last epoch's halo features, injecting last epoch's boundary gradients
+into this epoch's backward (reference feature_buffer.py:153-163,228-236),
+and exposing this epoch's halo cotangent through a probe input so the
+train step can ship it to owners for the next epoch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _fwd_perm(num_parts: int, d: int):
+    return [(r, (r + d) % num_parts) for r in range(num_parts)]
+
+
+def _bwd_perm(num_parts: int, d: int):
+    return [(r, (r - d) % num_parts) for r in range(num_parts)]
+
+
+def exchange_blocks(
+    h: jax.Array,
+    send_idx: jax.Array,
+    send_mask: jax.Array,
+    axis_name: str,
+    num_parts: int,
+) -> jax.Array:
+    """Gather boundary rows and ring-exchange them.
+
+    h: [N, F] inner rows; send_idx/mask: [P-1, B]. Returns the halo block
+    [(P-1)*B, F]: distance-d rows hold features owned by (r-d) mod P.
+    """
+    blocks = []
+    for d in range(1, num_parts):
+        blk = jnp.take(h, send_idx[d - 1], axis=0)
+        blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
+        blocks.append(jax.lax.ppermute(blk, axis_name, _fwd_perm(num_parts, d)))
+    if not blocks:
+        return jnp.zeros((0, h.shape[-1]), h.dtype)
+    return jnp.concatenate(blocks, axis=0)
+
+
+def halo_exchange(
+    h: jax.Array,
+    send_idx: jax.Array,
+    send_mask: jax.Array,
+    axis_name: str,
+    num_parts: int,
+) -> jax.Array:
+    """[N, F] -> [N + (P-1)*B, F]: inner rows followed by halo rows.
+    Fully differentiable (synchronous/vanilla mode,
+    reference feature_buffer.py:145-152)."""
+    if num_parts == 1:
+        return h
+    return jnp.concatenate(
+        [h, exchange_blocks(h, send_idx, send_mask, axis_name, num_parts)],
+        axis=0,
+    )
+
+
+def return_blocks(
+    halo_grad: jax.Array,
+    axis_name: str,
+    num_parts: int,
+    b_max: int,
+) -> jax.Array:
+    """Route halo cotangents back to their owners.
+
+    halo_grad: [(P-1)*B, F] in distance order. The distance-d block came
+    from owner (r-d); after the reverse permute, the device holds — in the
+    same [(P-1)*B, F] layout — the gradients its peers computed for the
+    rows listed in its own send_idx (block d-1 <- peer (r+d))."""
+    outs = []
+    for d in range(1, num_parts):
+        blk = jax.lax.dynamic_slice_in_dim(
+            halo_grad, (d - 1) * b_max, b_max, axis=0
+        )
+        outs.append(jax.lax.ppermute(blk, axis_name, _bwd_perm(num_parts, d)))
+    if not outs:
+        return jnp.zeros_like(halo_grad)
+    return jnp.concatenate(outs, axis=0)
+
+
+def make_stale_concat(send_idx: jax.Array, send_mask: jax.Array, n_dst: int):
+    """Build the staleness-1 concat op for one graph layer.
+
+    f(h, stale_halo, stale_bgrad, probe) -> [N + H, F] buffer equal to
+    concat(h, stale_halo + probe), with a custom VJP:
+
+      d_h     = g[:N] + scatter_add(send positions, stale_bgrad)
+                  (inject *last* epoch's boundary grads — reference
+                   feature_buffer.py:228-236 / __update_grad :208-217)
+      d_probe = g[N:]  (this epoch's halo cotangent, for the caller to
+                   ship to owners; probe itself is zeros)
+      d_stale_halo = d_stale_bgrad = 0  (stale values are carry state,
+                   not differentiation targets)
+
+    send_idx/mask: [P-1, B] for this device; their flattened order matches
+    the [(P-1)*B] halo/bgrad row order.
+    """
+    flat_idx = send_idx.reshape(-1)
+    flat_mask = send_mask.reshape(-1)
+
+    @jax.custom_vjp
+    def stale_concat(h, stale_halo, stale_bgrad, probe):
+        return jnp.concatenate([h, stale_halo + probe], axis=0)
+
+    def fwd(h, stale_halo, stale_bgrad, probe):
+        return stale_concat(h, stale_halo, stale_bgrad, probe), (stale_bgrad,)
+
+    def bwd(res, g):
+        (stale_bgrad,) = res
+        inj = jnp.where(flat_mask[:, None], stale_bgrad, 0.0)
+        d_h = g[:n_dst].at[flat_idx].add(inj)
+        d_probe = g[n_dst:]
+        return (
+            d_h,
+            jnp.zeros_like(d_probe),
+            jnp.zeros_like(stale_bgrad),
+            d_probe,
+        )
+
+    stale_concat.defvjp(fwd, bwd)
+    return stale_concat

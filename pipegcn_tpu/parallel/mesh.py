@@ -1,0 +1,29 @@
+"""Device mesh utilities.
+
+The reference runs one OS process per partition connected by gloo
+(main.py:44-59, train.py:408-416). Here the whole job is a single SPMD
+program over a 1-D `jax.sharding.Mesh` with axis 'parts' — one device per
+graph partition; collectives ride ICI/DCN and XLA schedules the overlap.
+Multi-host works the same way: `jax.distributed.initialize` makes
+`jax.devices()` span hosts, and the mesh covers the global device list.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+PARTS_AXIS = "parts"
+
+
+def make_mesh(n_parts: int, devices=None) -> Mesh:
+    """1-D mesh over the first `n_parts` devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_parts:
+        raise ValueError(
+            f"need {n_parts} devices for {n_parts} partitions, have "
+            f"{len(devices)} (hint: XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count=N emulates N devices on CPU)"
+        )
+    return Mesh(np.array(devices[:n_parts]), (PARTS_AXIS,))

@@ -1,0 +1,442 @@
+"""SPMD trainer: the whole training job as one jitted program per epoch.
+
+Replaces the reference's per-rank `run()` (train.py:242-400) — N Python
+processes, gloo collectives, autograd hooks, CUDA streams and a thread
+pool — with a single `jit(shard_map(...))` train step over a 1-D device
+mesh. Everything the reference wove through mutable global state becomes
+explicit dataflow in the step's carry:
+
+  reference                                  here
+  ---------                                  ----
+  ctx.buffer halo recv buffers               comm carry (halo/bgrad/EMA)
+  per-param backward hooks + Reducer         lax.psum(grads)/n_train
+  SyncBatchNorm dist.all_reduce              psum inside the model
+  epoch-pipelined transfers (threads/tags)   staleness-1 carry swap
+  torch.optim.Adam                           in-repo adam (train.optim)
+
+Pipelined mode (--enable-pipeline): graph layer i consumes the halo
+features exchanged during the *previous* epoch's step and injects the
+boundary gradients received then (staleness 1, zeros at epoch 0 —
+reference feature_buffer.py:153-163, 219-236); this epoch's halo blocks
+and boundary grads are computed alongside and carried forward. Because
+next epoch's exchange does not depend on this epoch's loss, XLA can
+overlap the collectives with compute inside the step. Optional EMA
+smoothing of stale features/grads (--feat-corr/--grad-corr, momentum
+`corr_momentum` — reference feature_buffer.py:186-191, parser.py:44-47).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..graph.csr import Graph
+from ..models.sage import ModelConfig, forward, init_norm_state, init_params
+from ..ops.spmm import spmm_mean
+from ..partition.halo import ShardedGraph
+from ..train.losses import bce_logits_sum, cross_entropy_sum
+from ..train.metrics import calc_acc
+from ..train.optim import adam_init, adam_update
+from .halo import (
+    exchange_blocks,
+    halo_exchange,
+    make_stale_concat,
+    return_blocks,
+)
+from .mesh import PARTS_AXIS, make_mesh
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    lr: float = 1e-2
+    weight_decay: float = 0.0
+    n_epochs: int = 100
+    enable_pipeline: bool = False
+    feat_corr: bool = False
+    grad_corr: bool = False
+    corr_momentum: float = 0.95
+    log_every: int = 10
+    seed: int = 0
+    eval: bool = True
+
+
+class Trainer:
+    """Owns mesh, device data, jitted step/eval, and the epoch loop."""
+
+    def __init__(
+        self,
+        sg: ShardedGraph,
+        cfg: ModelConfig,
+        tcfg: TrainConfig,
+        devices=None,
+    ):
+        self.sg = sg
+        # training arrays from ShardedGraph are CSR-ordered per device
+        self.cfg = dataclasses.replace(cfg, sorted_edges=True)
+        self._eval_cfg = dataclasses.replace(cfg, sorted_edges=True)
+        self.tcfg = tcfg
+        self.P = sg.num_parts
+        self.mesh = make_mesh(self.P, devices)
+        self._shard = NamedSharding(self.mesh, PartitionSpec(PARTS_AXIS))
+        self._repl = NamedSharding(self.mesh, PartitionSpec())
+
+        self.data = self._put_data()
+        if cfg.use_pp:
+            self.data["feat"] = self._precompute_pp()
+
+        rng = jax.random.PRNGKey(tcfg.seed)
+        params = init_params(rng, cfg)
+        self.state = {
+            "params": jax.device_put(params, self._repl),
+            "opt": jax.device_put(adam_init(params), self._repl),
+            "norm": jax.device_put(init_norm_state(cfg), self._repl),
+            "comm": jax.device_put(self._init_comm(), self._shard),
+        }
+        self._step = self._build_step()
+        self._eval_cache: Dict[int, Any] = {}
+
+        @partial(jax.jit, static_argnames=("n",))
+        def _eval_run(params, norm, feat, es, ed, deg, n):
+            logits, _ = forward(
+                params, self._eval_cfg, feat, es, ed, deg, n,
+                training=False, norm_state=norm,
+                eval_pp_agg=self._eval_cfg.use_pp,
+            )
+            return logits
+
+        self._eval_run = _eval_run
+
+    # ---------------- data placement ----------------------------------
+
+    def _put_data(self) -> Dict[str, jax.Array]:
+        sg = self.sg
+        arrs = {
+            "feat": sg.feat,
+            "label": sg.label,
+            "train_mask": sg.train_mask,
+            "in_deg": sg.in_deg,
+            "edge_src": sg.edge_src.astype(np.int32),
+            "edge_dst": sg.edge_dst.astype(np.int32),
+            "send_idx": sg.send_idx.astype(np.int32),
+            "send_mask": sg.send_mask,
+            # True for real inner rows, False for padding (BN statistics)
+            "row_mask": (
+                np.arange(sg.n_max)[None, :] < sg.inner_count[:, None]
+            ).astype(np.float32),
+        }
+        return {
+            k: jax.device_put(jnp.asarray(v), self._shard)
+            for k, v in arrs.items()
+        }
+
+    # ---------------- comm carry state --------------------------------
+
+    def _graph_layer_range(self):
+        """Graph layers that exchange halos: skip layer 0 under use_pp
+        (reference feature_buffer.py:60-61, model.py:45-46)."""
+        start = 1 if self.cfg.use_pp else 0
+        return range(start, self.cfg.n_graph_layers)
+
+    def _layer_width(self, i: int) -> int:
+        # input width of graph layer i as seen by the exchange; under
+        # use_pp the layer-0 input is the 2F concat but layer 0 never
+        # exchanges, so plain layer_sizes applies to all exchanged layers
+        return self.cfg.layer_sizes[i]
+
+    def _init_comm(self):
+        """Per-device stacked [P, ...] zero buffers for pipelined mode."""
+        if not self.tcfg.enable_pipeline:
+            return {}
+        H = self.sg.halo_size
+        comm = {"halo": {}, "bgrad": {}}
+        if self.tcfg.feat_corr:
+            comm["favg"] = {}
+        if self.tcfg.grad_corr:
+            comm["bavg"] = {}
+        for i in self._graph_layer_range():
+            f = self._layer_width(i)
+            z = np.zeros((self.P, H, f), np.float32)
+            comm["halo"][str(i)] = z
+            comm["bgrad"][str(i)] = z.copy()
+            if self.tcfg.feat_corr:
+                comm["favg"][str(i)] = z.copy()
+            if self.tcfg.grad_corr:
+                comm["bavg"][str(i)] = z.copy()
+        return comm
+
+    # ---------------- pp precompute -----------------------------------
+
+    def _precompute_pp(self) -> jax.Array:
+        """One-time halo exchange + mean aggregation of raw features,
+        stored as concat([feat, mean_neigh]) so layer 0 needs no
+        training-time communication (reference train.py:169-189)."""
+        sg = self.sg
+        n_max = sg.n_max
+
+        def pp(feat, edge_src, edge_dst, in_deg, send_idx, send_mask):
+            feat, edge_src, edge_dst = feat[0], edge_src[0], edge_dst[0]
+            in_deg, send_idx, send_mask = in_deg[0], send_idx[0], send_mask[0]
+            fbuf = halo_exchange(feat, send_idx, send_mask, PARTS_AXIS, self.P)
+            ah = spmm_mean(fbuf, edge_src, edge_dst, in_deg, n_max,
+                           self.cfg.spmm_chunk)
+            return jnp.concatenate([feat, ah], axis=1)[None]
+
+        spec = PartitionSpec(PARTS_AXIS)
+        fn = jax.jit(
+            jax.shard_map(
+                pp, mesh=self.mesh,
+                in_specs=(spec,) * 6, out_specs=spec,
+            )
+        )
+        d = self.data
+        return fn(d["feat"], d["edge_src"], d["edge_dst"], d["in_deg"],
+                  d["send_idx"], d["send_mask"])
+
+    # ---------------- the train step ----------------------------------
+
+    def _build_step(self):
+        sg, cfg, tcfg, P = self.sg, self.cfg, self.tcfg, self.P
+        n_max, b_max, H = sg.n_max, sg.b_max, sg.halo_size
+        n_train = float(sg.n_train_global)
+        multilabel = sg.multilabel
+        pipeline = tcfg.enable_pipeline
+        glayers = list(self._graph_layer_range())
+        momentum = tcfg.corr_momentum
+
+        def step(state, data, rng):
+            # strip the leading size-1 device axis of sharded blocks
+            d = {k: v[0] for k, v in data.items()}
+            comm = {
+                grp: {k: v[0] for k, v in bufs.items()}
+                for grp, bufs in state["comm"].items()
+            }
+            params, opt, norm = state["params"], state["opt"], state["norm"]
+            rank = jax.lax.axis_index(PARTS_AXIS)
+            rng = jax.random.fold_in(rng, rank)
+            psum = lambda x: jax.lax.psum(x, PARTS_AXIS)
+
+            fresh_halo: Dict[str, jax.Array] = {}
+
+            if pipeline:
+                # probes must be marked device-varying: their cotangents
+                # (the per-device halo grads) vary over the mesh axis
+                probes = {
+                    str(i): jax.lax.pcast(
+                        jnp.zeros((H, self._layer_width(i)), jnp.float32),
+                        PARTS_AXIS, to="varying",
+                    )
+                    for i in glayers
+                }
+
+                def comm_update(i, h):
+                    k = str(i)
+                    stale_halo = (
+                        comm["favg"][k] if tcfg.feat_corr else comm["halo"][k]
+                    )
+                    stale_bgrad = (
+                        comm["bavg"][k] if tcfg.grad_corr else comm["bgrad"][k]
+                    )
+                    op = make_stale_concat(d["send_idx"], d["send_mask"], n_max)
+                    fbuf = op(h, stale_halo, stale_bgrad, probes_in[k])
+                    # this epoch's exchange, consumed next epoch; aux only
+                    fresh_halo[k] = exchange_blocks(
+                        jax.lax.stop_gradient(h), d["send_idx"],
+                        d["send_mask"], PARTS_AXIS, P,
+                    )
+                    return fbuf
+            else:
+                probes = {}
+
+                def comm_update(i, h):
+                    return halo_exchange(
+                        h, d["send_idx"], d["send_mask"], PARTS_AXIS, P
+                    )
+
+            def loss_fn(params, probes_arg):
+                nonlocal probes_in
+                probes_in = probes_arg
+                logits, new_norm = forward(
+                    params, cfg, d["feat"], d["edge_src"], d["edge_dst"],
+                    d["in_deg"], n_max, training=True, rng=rng,
+                    comm_update=comm_update, norm_state=norm, psum=psum,
+                    row_mask=d["row_mask"],
+                )
+                if multilabel:
+                    loss = bce_logits_sum(logits, d["label"], d["train_mask"])
+                else:
+                    loss = cross_entropy_sum(logits, d["label"],
+                                             d["train_mask"])
+                return loss, new_norm
+
+            probes_in = probes
+            (loss, new_norm), grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(params, probes)
+            pgrads, probe_grads = grads
+
+            # gradient reduction: psum of sum-loss grads / global n_train
+            # (reference reducer.py:24-31 semantics, minus the threads)
+            pgrads = jax.tree_util.tree_map(lambda g: psum(g) / n_train,
+                                            pgrads)
+            new_params, new_opt = adam_update(
+                pgrads, opt, params, lr=tcfg.lr,
+                weight_decay=tcfg.weight_decay,
+            )
+
+            new_comm = {}
+            if pipeline:
+                new_comm = {"halo": {}, "bgrad": {}}
+                if tcfg.feat_corr:
+                    new_comm["favg"] = {}
+                if tcfg.grad_corr:
+                    new_comm["bavg"] = {}
+                for i in glayers:
+                    k = str(i)
+                    new_comm["halo"][k] = fresh_halo[k]
+                    # ship this epoch's halo cotangents to their owners
+                    bg = return_blocks(probe_grads[k], PARTS_AXIS, P, b_max)
+                    new_comm["bgrad"][k] = bg
+                    if tcfg.feat_corr:
+                        new_comm["favg"][k] = (
+                            momentum * comm["favg"][k]
+                            + (1 - momentum) * fresh_halo[k]
+                        )
+                    if tcfg.grad_corr:
+                        new_comm["bavg"][k] = (
+                            momentum * comm["bavg"][k] + (1 - momentum) * bg
+                        )
+                new_comm = {
+                    grp: {k: v[None] for k, v in bufs.items()}
+                    for grp, bufs in new_comm.items()
+                }
+
+            loss_out = psum(loss) / n_train
+            new_state = {
+                "params": new_params,
+                "opt": new_opt,
+                "norm": new_norm,
+                "comm": new_comm,
+            }
+            return new_state, loss_out
+
+        data_spec = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(PARTS_AXIS), self.data
+        )
+        state_spec = {
+            "params": jax.tree_util.tree_map(
+                lambda _: PartitionSpec(), self.state["params"]
+            ),
+            "opt": jax.tree_util.tree_map(
+                lambda _: PartitionSpec(), self.state["opt"]
+            ),
+            "norm": jax.tree_util.tree_map(
+                lambda _: PartitionSpec(), self.state["norm"]
+            ),
+            "comm": jax.tree_util.tree_map(
+                lambda _: PartitionSpec(PARTS_AXIS), self.state["comm"]
+            ),
+        }
+        smapped = jax.shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(state_spec, data_spec, PartitionSpec()),
+            out_specs=(state_spec, PartitionSpec()),
+        )
+        return jax.jit(smapped, donate_argnums=(0,))
+
+    # ---------------- public API --------------------------------------
+
+    def train_epoch(self, epoch: int) -> float:
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.tcfg.seed + 17), epoch
+        )
+        self.state, loss = self._step(self.state, self.data, rng)
+        return float(loss)
+
+    def fit(
+        self,
+        eval_graphs: Optional[Dict[str, Tuple[Graph, str]]] = None,
+        log_fn=print,
+    ) -> Dict[str, Any]:
+        """Epoch loop with periodic evaluation and best-val tracking
+        (reference train.py:327-400). `eval_graphs` maps split name ->
+        (graph, mask key); must contain 'val' (and usually 'test')."""
+        tcfg = self.tcfg
+        best_val, best_params, best_epoch = 0.0, None, -1
+        durs = []
+        history = []
+        for epoch in range(tcfg.n_epochs):
+            t0 = time.perf_counter()
+            loss = self.train_epoch(epoch)
+            jax.block_until_ready(self.state["params"])
+            dur = time.perf_counter() - t0
+            # epochs <5 excluded from averaged timings (reference
+            # train.py:364)
+            if epoch >= 5:
+                durs.append(dur)
+            if (epoch + 1) % tcfg.log_every == 0:
+                msg = (f"Epoch {epoch + 1:05d} | Time(s) {np.mean(durs or [dur]):.4f} "
+                       f"| Loss {loss:.4f}")
+                if tcfg.eval and eval_graphs and "val" in eval_graphs:
+                    g, mask = eval_graphs["val"]
+                    acc = self.evaluate(g, mask)
+                    msg += f" | Val {acc:.4f}"
+                    history.append((epoch + 1, loss, acc))
+                    if acc > best_val:
+                        best_val = acc
+                        best_epoch = epoch + 1
+                        best_params = jax.device_get(self.state["params"])
+                else:
+                    history.append((epoch + 1, loss, None))
+                log_fn(msg)
+        result = {
+            "best_val": best_val,
+            "best_epoch": best_epoch,
+            "best_params": best_params,
+            "epoch_time": float(np.mean(durs)) if durs else None,
+            "history": history,
+        }
+        if tcfg.eval and eval_graphs and "test" in eval_graphs and \
+                best_params is not None:
+            g, mask = eval_graphs["test"]
+            result["test_acc"] = self.evaluate(g, mask, params=best_params)
+        return result
+
+    # ---------------- evaluation --------------------------------------
+
+    def evaluate(self, g: Graph, mask_key: str, params=None) -> float:
+        """Full-graph eval on one device (reference evaluates the full
+        graph on rank 0's CPU, train.py:20-61; we use the accelerator)."""
+        key = id(g)
+        if key not in self._eval_cache:
+            n = g.num_nodes
+            # CSR-sort eval edges so the sorted segment reduction applies
+            order = np.argsort(g.dst, kind="stable")
+            self._eval_cache[key] = {
+                "graph": g,  # strong ref: keeps id(g) valid while cached
+                "feat": jnp.asarray(g.ndata["feat"]),
+                "label": g.ndata["label"],
+                "edge_src": jnp.asarray(g.src[order].astype(np.int32)),
+                "edge_dst": jnp.asarray(g.dst[order].astype(np.int32)),
+                "in_deg": jnp.asarray(
+                    np.maximum(g.in_degrees(), 1).astype(np.float32)
+                ),
+                "n": n,
+            }
+        c = self._eval_cache[key]
+        if params is None:
+            params = self.state["params"]
+        norm = self.state["norm"]
+        logits = np.asarray(
+            self._eval_run(params, norm, c["feat"], c["edge_src"],
+                           c["edge_dst"], c["in_deg"], c["n"])
+        )
+        m = np.asarray(g.ndata[mask_key])
+        return calc_acc(logits[m], c["label"][m])

@@ -196,8 +196,10 @@ class ShardedGraph:
             n_max + (halo_dist - 1) * b_max + halo_rank,
         ).astype(np.int64)
 
-        # scatter edges into per-device padded arrays
-        e_order = np.argsort(edge_owner, kind="stable")
+        # scatter edges into per-device padded arrays, sorted by local dst
+        # within each device (CSR order — lets kernels rely on contiguous
+        # destination segments; padding dst = n_max sorts to the tail)
+        e_order = np.lexsort((dst_local_all, edge_owner))
         e_starts = np.zeros(num_parts + 1, dtype=np.int64)
         np.cumsum(e_sizes, out=e_starts[1:])
         edge_src = np.zeros((num_parts, e_max), dtype=np.int32)

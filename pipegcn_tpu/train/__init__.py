@@ -1,0 +1,13 @@
+from .losses import cross_entropy_sum, bce_logits_sum
+from .metrics import accuracy, micro_f1, calc_acc
+from .optim import adam_init, adam_update
+
+__all__ = [
+    "cross_entropy_sum",
+    "bce_logits_sum",
+    "accuracy",
+    "micro_f1",
+    "calc_acc",
+    "adam_init",
+    "adam_update",
+]

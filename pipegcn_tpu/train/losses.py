@@ -1,0 +1,37 @@
+"""Loss functions.
+
+Sum-reduced (not mean) losses over masked train rows, matching the
+reference exactly: CrossEntropyLoss(reduction='sum') for single-label
+datasets, BCEWithLogitsLoss(reduction='sum') for multi-label/Yelp
+(reference train.py:317-320). The 1/n_train normalization happens on the
+*gradients* during reduction (reference helper/reducer.py:27), not here —
+so per-partition loss sums psum to the global sum.
+
+Masks make the padded-row/static-shape scheme work: every function takes
+the full padded [N, ...] arrays and a boolean row mask.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_sum(logits: jax.Array, labels: jax.Array,
+                      mask: jax.Array) -> jax.Array:
+    """Sum of CE over rows where mask is True. labels: int [N]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    # clip labels so padded rows (label 0 or -1) index validly; masked out
+    safe = jnp.clip(labels, 0, logits.shape[-1] - 1)
+    picked = jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
+    return -(picked * mask).sum()
+
+
+def bce_logits_sum(logits: jax.Array, labels: jax.Array,
+                   mask: jax.Array) -> jax.Array:
+    """Sum of element-wise binary CE with logits over masked rows.
+    labels: float [N, C] in {0, 1}."""
+    # numerically stable: max(x,0) - x*y + log1p(exp(-|x|))
+    x = logits
+    per_elem = jnp.maximum(x, 0.0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return (per_elem * mask[:, None]).sum()

@@ -1,0 +1,60 @@
+"""Adam optimizer, in-repo pure JAX.
+
+Matches torch.optim.Adam semantics used by the reference
+(train.py:321-323): L2 weight decay folded into the gradient (not
+decoupled/AdamW), bias-corrected first/second moments, update
+lr * m_hat / (sqrt(v_hat) + eps). Implemented here rather than via optax
+so optimizer state is a plain pytree the checkpoint/restore and SPMD
+paths fully control.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+OptState = dict
+
+
+def adam_init(params: Params) -> OptState:
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return {
+        "mu": zeros,
+        "nu": jax.tree_util.tree_map(jnp.zeros_like, params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def adam_update(
+    grads: Params,
+    state: OptState,
+    params: Params,
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> Tuple[Params, OptState]:
+    """One Adam step; returns (new_params, new_state)."""
+    step = state["step"] + 1
+    if weight_decay:
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g + weight_decay * p, grads, params
+        )
+    mu = jax.tree_util.tree_map(
+        lambda m, g: b1 * m + (1 - b1) * g, state["mu"], grads
+    )
+    nu = jax.tree_util.tree_map(
+        lambda v, g: b2 * v + (1 - b2) * (g * g), state["nu"], grads
+    )
+    t = step.astype(jnp.float32)
+    bc1 = 1 - b1 ** t
+    bc2 = 1 - b2 ** t
+    new_params = jax.tree_util.tree_map(
+        lambda p, m, v: p - lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps),
+        params, mu, nu,
+    )
+    return new_params, {"mu": mu, "nu": nu, "step": step}

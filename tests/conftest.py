@@ -2,7 +2,9 @@
 sharding (the TPU analogue of the reference's localhost-gloo multiprocess
 testing, SURVEY.md §4) is exercised without TPU hardware.
 
-Must run before jax is imported anywhere in the test process.
+XLA_FLAGS must be set before the CPU backend initializes; the platform
+choice is applied via jax.config (the environment's site hook pins
+JAX_PLATFORMS, so the env var alone is not enough).
 """
 
 import os
@@ -10,4 +12,7 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
-os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,202 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pipegcn_tpu.graph import karate_club, synthetic_graph
+from pipegcn_tpu.models import ModelConfig, forward, init_norm_state, init_params
+from pipegcn_tpu.ops import spmm_mean, spmm_sum
+
+
+@pytest.fixture(scope="module")
+def small_graph():
+    return karate_club(n_feat=8)
+
+
+def _graph_arrays(g):
+    """Full-graph edge arrays with one pad edge exercising the sentinel."""
+    n = g.num_nodes
+    src = np.concatenate([g.src, [0]]).astype(np.int32)
+    dst = np.concatenate([g.dst, [n]]).astype(np.int32)  # sentinel
+    return jnp.array(src), jnp.array(dst), jnp.array(
+        g.ndata["in_deg"].astype(np.float32)
+    )
+
+
+def test_spmm_sum_matches_dense(small_graph):
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    x = jnp.array(np.random.default_rng(0).normal(size=(n, 8)).astype(np.float32))
+    out = spmm_sum(x, src, dst, n)
+    a = np.zeros((n, n), np.float32)
+    np.add.at(a, (g.dst, g.src), 1.0)
+    np.testing.assert_allclose(out, a @ np.asarray(x), rtol=1e-4, atol=1e-4)
+
+
+def test_spmm_chunked_matches_unchunked(small_graph):
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    x = jnp.array(np.random.default_rng(1).normal(size=(n, 8)).astype(np.float32))
+    full = spmm_mean(x, src, dst, deg, n)
+    for chunk in (7, 64, 128):
+        np.testing.assert_allclose(
+            spmm_mean(x, src, dst, deg, n, chunk=chunk), full,
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_spmm_gradient(small_graph):
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    x = jnp.ones((n, 4), jnp.float32)
+
+    def f(x):
+        return spmm_sum(x, src, dst, n).sum()
+
+    grad = jax.grad(f)(x)
+    # d/dx_u of sum over edges = out-degree of u (incl. pad edge's src 0
+    # being dropped via the sentinel segment)
+    np.testing.assert_allclose(
+        np.asarray(grad)[:, 0], g.out_degrees().astype(np.float32), rtol=1e-5
+    )
+
+
+def _cfg(g, hidden=16, n_layers=3, **kw):
+    n_class = int(g.ndata["label"].max()) + 1
+    sizes = (g.ndata["feat"].shape[1],) + (hidden,) * (n_layers - 1) + (n_class,)
+    kw.setdefault("train_size", int(g.ndata["train_mask"].sum()))
+    return ModelConfig(layer_sizes=sizes, **kw)
+
+
+def test_init_param_shapes_and_bounds(small_graph):
+    cfg = _cfg(small_graph, norm="layer", n_linear=1)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(params["layers"]) == 3
+    assert set(params["layers"][0]) == {"w1", "b1", "w2", "b2"}
+    assert set(params["layers"][2]) == {"w", "b"}  # linear tail
+    assert len(params["norms"]) == 2
+    w1 = params["layers"][0]["w1"]
+    bound = 1.0 / np.sqrt(w1.shape[0])
+    assert float(jnp.abs(w1).max()) <= bound
+    assert float(jnp.abs(w1).max()) > 0.5 * bound  # actually spread out
+
+
+def test_train_eval_parity_no_dropout(small_graph):
+    """With dropout=0 and a trivial comm (full graph as one shard), the
+    training path must equal the eval path exactly."""
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    feat = jnp.array(g.ndata["feat"])
+    cfg = _cfg(g, dropout=0.0, norm="layer")
+    params = init_params(jax.random.PRNGKey(1), cfg)
+
+    train_out, _ = forward(
+        params, cfg, feat, src, dst, deg, n,
+        training=True, rng=jax.random.PRNGKey(0),
+        comm_update=lambda i, h: h,
+    )
+    eval_out, _ = forward(
+        params, cfg, feat, src, dst, deg, n, training=False,
+    )
+    np.testing.assert_allclose(train_out, eval_out, rtol=1e-4, atol=1e-5)
+
+
+def test_use_pp_parity(small_graph):
+    """Training with precomputed concat input == eval recomputing the
+    first-layer aggregation on the fly (module/layer.py:41-42 vs 58-60)."""
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    feat = jnp.array(g.ndata["feat"])
+    cfg = _cfg(g, dropout=0.0, norm="layer", use_pp=True)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+
+    ah = spmm_mean(feat, src, dst, deg, n)
+    pp_input = jnp.concatenate([feat, ah], axis=1)
+    train_out, _ = forward(
+        params, cfg, pp_input, src, dst, deg, n,
+        training=True, rng=jax.random.PRNGKey(0),
+        comm_update=lambda i, h: h,
+    )
+    eval_out, _ = forward(
+        params, cfg, feat, src, dst, deg, n, training=False,
+        eval_pp_agg=True,
+    )
+    np.testing.assert_allclose(train_out, eval_out, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_changes_output_and_is_seeded(small_graph):
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    feat = jnp.array(g.ndata["feat"])
+    cfg = _cfg(g, dropout=0.5)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+
+    def run(seed):
+        out, _ = forward(
+            params, cfg, feat, src, dst, deg, n,
+            training=True, rng=jax.random.PRNGKey(seed),
+            comm_update=lambda i, h: h,
+        )
+        return np.asarray(out)
+
+    a, b, a2 = run(0), run(1), run(0)
+    assert not np.allclose(a, b)
+    np.testing.assert_array_equal(a, a2)
+
+
+def test_sync_batch_norm_single_device(small_graph):
+    """psum=identity SyncBN must match plain batch normalization when
+    train_size equals the row count."""
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    feat = jnp.array(g.ndata["feat"])
+    cfg = _cfg(g, dropout=0.0, norm="batch", train_size=n)
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    state = init_norm_state(cfg)
+    assert len(state) == 2
+
+    out, new_state = forward(
+        params, cfg, feat, src, dst, deg, n,
+        training=True, rng=jax.random.PRNGKey(0),
+        comm_update=lambda i, h: h, norm_state=state,
+    )
+    assert out.shape == (n, 2)
+    # running stats moved toward the batch stats (momentum 0.1)
+    assert not np.allclose(np.asarray(new_state[0]["mean"]), 0.0)
+    # eval path consumes running stats without error
+    eval_out, _ = forward(
+        params, cfg, feat, src, dst, deg, n, training=False,
+        norm_state=new_state,
+    )
+    assert eval_out.shape == (n, 2)
+
+
+def test_gradients_flow_everywhere(small_graph):
+    g = small_graph
+    n = g.num_nodes
+    src, dst, deg = _graph_arrays(g)
+    feat = jnp.array(g.ndata["feat"])
+    labels = jnp.array(g.ndata["label"])
+    cfg = _cfg(g, dropout=0.0, norm="layer", n_linear=1)
+    params = init_params(jax.random.PRNGKey(5), cfg)
+
+    def loss_fn(p):
+        logits, _ = forward(
+            p, cfg, feat, src, dst, deg, n,
+            training=True, rng=jax.random.PRNGKey(0),
+            comm_update=lambda i, h: h,
+        )
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        return -(jax.nn.log_softmax(logits) * onehot).sum()
+
+    grads = jax.grad(loss_fn)(params)
+    flat, _ = jax.tree_util.tree_flatten(grads)
+    assert all(np.isfinite(np.asarray(x)).all() for x in flat)
+    assert all(float(jnp.abs(x).max()) > 0 for x in flat)

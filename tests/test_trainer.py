@@ -1,0 +1,160 @@
+"""Integration tests for the SPMD trainer on a virtual 8-device CPU mesh
+(the analogue of the reference's localhost-gloo multiprocess testing,
+SURVEY.md §4)."""
+
+import numpy as np
+import jax
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.graph.datasets import inductive_split
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+
+def _setup(g, n_parts, *, dropout=0.0, norm="layer", use_pp=False,
+           n_linear=0, hidden=16, n_layers=2, **tkw):
+    parts = partition_graph(g, n_parts, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=n_parts)
+    n_class = sg.n_class
+    sizes = (sg.n_feat,) + (hidden,) * (n_layers - 1) + (n_class,)
+    cfg = ModelConfig(
+        layer_sizes=sizes, n_linear=n_linear, use_pp=use_pp, norm=norm,
+        dropout=dropout, train_size=sg.n_train_global,
+    )
+    tcfg = TrainConfig(**tkw)
+    return Trainer(sg, cfg, tcfg)
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12,
+                           n_class=4, seed=11)
+
+
+def test_vanilla_distributed_matches_single_device(graph):
+    """SURVEY §7 step 5 gate: the P=4 vanilla run must match the P=1 run
+    numerically (same init, same data, no dropout)."""
+    t1 = _setup(graph, 1, seed=3)
+    t4 = _setup(graph, 4, seed=3)
+    for epoch in range(5):
+        l1 = t1.train_epoch(epoch)
+        l4 = t4.train_epoch(epoch)
+        assert np.isfinite(l1) and np.isfinite(l4)
+        np.testing.assert_allclose(l1, l4, rtol=2e-4)
+    # params also agree
+    p1 = jax.device_get(t1.state["params"])
+    p4 = jax.device_get(t4.state["params"])
+    flat1 = jax.tree_util.tree_leaves(p1)
+    flat4 = jax.tree_util.tree_leaves(p4)
+    for a, b in zip(flat1, flat4):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-5)
+
+
+def test_pipeline_epoch0_matches_vanilla_forward(graph):
+    """At epoch 0 the pipelined forward concats zero buffers
+    (reference feature_buffer.py:153-163) — its loss must differ from
+    vanilla (halo contributions missing) but the *second* epoch consumes
+    epoch 0's real features."""
+    tv = _setup(graph, 4, seed=3)
+    tp = _setup(graph, 4, seed=3, enable_pipeline=True)
+    lv0 = tv.train_epoch(0)
+    lp0 = tp.train_epoch(0)
+    # epoch 0 pipelined sees zeros in halo slots -> different loss
+    assert abs(lv0 - lp0) > 1e-6
+    # convergence is preserved over a few epochs
+    for e in range(1, 30):
+        lv = tv.train_epoch(e)
+        lp = tp.train_epoch(e)
+    assert np.isfinite(lp)
+    assert lp < lp0  # pipelined training reduces loss
+
+
+def test_pipeline_staleness_exactness(graph):
+    """Epoch e of the pipelined run must consume exactly epoch e-1's halo
+    features: with frozen params (lr=0), epoch e's loss equals the
+    vanilla loss from one epoch earlier once buffers are warm."""
+    tv = _setup(graph, 4, seed=3, lr=0.0)
+    tp = _setup(graph, 4, seed=3, lr=0.0, enable_pipeline=True)
+    lv = [tv.train_epoch(e) for e in range(4)]
+    lp = [tp.train_epoch(e) for e in range(4)]
+    # with lr=0 params never change; vanilla loss is constant
+    np.testing.assert_allclose(lv[0], lv[1], rtol=1e-5)
+    # with 2 exchanged layers the stale buffers become exact after 2
+    # epochs (layer i's halo is exact once its producer epoch was exact):
+    # epoch >= 2 losses equal the vanilla loss under frozen params
+    np.testing.assert_allclose(lp[2], lv[0], rtol=1e-4)
+    np.testing.assert_allclose(lp[3], lv[0], rtol=1e-4)
+    # epochs 0 (zero buffers) and 1 (half-warm) differ
+    assert abs(lp[0] - lv[0]) > 1e-6
+    assert abs(lp[1] - lv[0]) > 1e-6
+
+
+def test_corrections_smoke(graph):
+    t = _setup(graph, 4, seed=3, enable_pipeline=True, feat_corr=True,
+               grad_corr=True, corr_momentum=0.95)
+    losses = [t.train_epoch(e) for e in range(10)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[1]
+
+
+def test_use_pp_trains_and_skips_layer0_comm(graph):
+    t = _setup(graph, 4, seed=3, use_pp=True, enable_pipeline=True)
+    # layer 0 must have no comm buffers
+    assert "0" not in t.state["comm"]["halo"]
+    losses = [t.train_epoch(e) for e in range(10)]
+    assert losses[-1] < losses[0]
+    # pp feature width doubled
+    assert t.data["feat"].shape[-1] == 2 * t.sg.n_feat
+
+
+def test_pipeline_with_dropout_use_pp_corrections(graph):
+    """Regression: pipelined + dropout + use_pp + corrections (the probe
+    cotangents are device-varying; unvarying probes fail shard_map's VMA
+    check)."""
+    t = _setup(graph, 4, seed=3, dropout=0.3, use_pp=True, n_layers=3,
+               enable_pipeline=True, feat_corr=True, grad_corr=True)
+    losses = [t.train_epoch(e) for e in range(8)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_fit_eval_convergence_transductive(graph):
+    t = _setup(graph, 4, seed=3, dropout=0.1, n_epochs=60, log_every=20,
+               hidden=32)
+    res = t.fit(eval_graphs={"val": (graph, "val_mask"),
+                             "test": (graph, "test_mask")},
+                log_fn=lambda m: None)
+    assert res["best_val"] > 0.75  # homophilous synthetic graph is easy
+    assert res["test_acc"] > 0.75
+    assert res["best_params"] is not None
+
+
+def test_fit_inductive(graph):
+    train_g, val_g, test_g = inductive_split(graph)
+    t = _setup(train_g, 4, seed=3, n_epochs=40, log_every=20, hidden=32)
+    res = t.fit(eval_graphs={"val": (val_g, "val_mask"),
+                             "test": (test_g, "test_mask")},
+                log_fn=lambda m: None)
+    assert res["best_val"] > 0.7
+
+
+def test_multilabel_bce(graph):
+    g = synthetic_graph(num_nodes=300, avg_degree=8, n_feat=10, n_class=5,
+                        multilabel=True, seed=13)
+    t = _setup(g, 2, norm="layer", n_linear=1, n_layers=3)
+    losses = [t.train_epoch(e) for e in range(15)]
+    assert losses[-1] < losses[0]
+    acc = t.evaluate(g, "val_mask")
+    assert 0.0 <= acc <= 1.0
+
+
+def test_sync_batch_norm_distributed_matches_single(graph):
+    """SyncBN: P=4 must equal P=1 (psum makes stats global)."""
+    t1 = _setup(graph, 1, norm="batch")
+    t4 = _setup(graph, 4, norm="batch")
+    for e in range(3):
+        l1 = t1.train_epoch(e)
+        l4 = t4.train_epoch(e)
+        np.testing.assert_allclose(l1, l4, rtol=2e-3)
