@@ -1,0 +1,117 @@
+"""Benchmark: per-epoch training time at Reddit scale.
+
+Reproduces the reference's headline measurement — per-epoch wall-clock of
+a 4-layer x 256 GraphSAGE with --enable-pipeline --use-pp on Reddit
+(232,965 nodes / ~114.6M directed edges / 602 features / 41 classes;
+reference README.md:93-94 reports 0.266 s/epoch on 2 GPUs) — on TPU,
+using a synthetic graph with Reddit's shape statistics (the real dataset
+needs a download this environment does not allow).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline > 1 means faster than the reference's 0.266 s/epoch.
+
+The partition/build artifact is cached under partitions/ so repeat runs
+skip the ~minutes of host-side preprocessing. Use --small for a quick
+smoke-scale run, --parts N to shard over N devices.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EPOCH_S = 0.266  # reference README.md:93-94 (2x GPU)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="10k-node smoke config instead of Reddit scale")
+    ap.add_argument("--parts", type=int, default=0,
+                    help="partitions (default: all available devices)")
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--no-pipeline", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from pipegcn_tpu.graph import load_data
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import Trainer, TrainConfig
+    from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+    n_parts = args.parts or len(jax.devices())
+    if args.small:
+        dataset = "synthetic:10000:20:64:16"
+        hidden, n_layers = 64, 3
+        spmm_chunk = None
+        name = f"bench-small-{n_parts}"
+    else:
+        dataset = "synthetic-reddit"
+        hidden, n_layers = 256, 4
+        spmm_chunk = 2_097_152  # bound gathered messages to [2M, F]
+        # ([2M, 602] f32 = 4.8 GB peak for the pp precompute gather)
+        name = f"bench-reddit-{n_parts}"
+
+    part_path = os.path.join("partitions", name)
+    t0 = time.perf_counter()
+    if ShardedGraph.exists(part_path):
+        sg = ShardedGraph.load(part_path)
+        print(f"# loaded cached partitions ({time.perf_counter()-t0:.1f}s)",
+              file=sys.stderr)
+    else:
+        g = load_data(dataset)
+        parts = partition_graph(g, n_parts, method="metis", obj="vol", seed=0)
+        sg = ShardedGraph.build(g, parts, n_parts=n_parts)
+        sg.save(part_path)
+        print(f"# built partitions ({time.perf_counter()-t0:.1f}s)",
+              file=sys.stderr)
+
+    cfg = ModelConfig(
+        layer_sizes=(sg.n_feat,) + (hidden,) * (n_layers - 1) + (sg.n_class,),
+        use_pp=True, norm="layer", dropout=0.5,
+        train_size=sg.n_train_global, spmm_chunk=spmm_chunk,
+    )
+    tcfg = TrainConfig(
+        lr=0.01, n_epochs=args.epochs,
+        enable_pipeline=not args.no_pipeline, seed=0, eval=False,
+    )
+    t0 = time.perf_counter()
+    trainer = Trainer(sg, cfg, tcfg)
+    print(f"# trainer setup ({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
+
+    # warmup (compile + pipeline fill)
+    t0 = time.perf_counter()
+    for e in range(args.warmup):
+        trainer.train_epoch(e)
+    jax.block_until_ready(trainer.state["params"])
+    print(f"# warmup/compile ({time.perf_counter()-t0:.1f}s)",
+          file=sys.stderr)
+
+    times = []
+    for e in range(args.warmup, args.warmup + args.epochs):
+        t0 = time.perf_counter()
+        loss = trainer.train_epoch(e)
+        jax.block_until_ready(trainer.state["params"])
+        times.append(time.perf_counter() - t0)
+    epoch_s = float(np.median(times))
+    print(f"# median epoch {epoch_s:.4f}s over {len(times)} epochs, "
+          f"final loss {loss:.4f}", file=sys.stderr)
+
+    metric = "reddit_scale_epoch_time" if not args.small else \
+        "small_epoch_time"
+    print(json.dumps({
+        "metric": metric,
+        "value": round(epoch_s, 4),
+        "unit": "s/epoch",
+        "vs_baseline": round(BASELINE_EPOCH_S / epoch_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

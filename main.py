@@ -1,0 +1,9 @@
+"""Drop-in launcher: `python main.py <reference flags>` runs PipeGCN-TPU
+with the reference's CLI surface (so the reference's scripts/*.sh work
+unchanged — reference main.py:8-63, minus the process spawning that SPMD
+makes unnecessary)."""
+
+from pipegcn_tpu.cli.main import cli_entry
+
+if __name__ == "__main__":
+    cli_entry()

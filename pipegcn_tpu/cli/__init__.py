@@ -1,0 +1,4 @@
+from .parser import create_parser
+from .main import run, cli_entry
+
+__all__ = ["create_parser", "run", "cli_entry"]

@@ -1,0 +1,115 @@
+"""Command-line flag surface.
+
+Accepts the exact flag set of the reference (helper/parser.py:4-71, every
+flag with both `-` and `_` spellings) so the reference's `scripts/*.sh`
+run unchanged, plus TPU-specific extensions listed at the bottom.
+Differences in meaning:
+
+  --backend        'xla' (default) — the only real backend; 'gloo' is
+                   accepted for script compatibility and treated as xla
+                   (the reference's nccl/mpi raise NotImplementedError,
+                   main.py:60-63; here they are rejected the same way).
+  --master-addr/--port/--node-rank/--parts-per-node
+                   map to `jax.distributed.initialize` coordinator
+                   config for multi-host SPMD instead of gloo rendezvous.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def create_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="PipeGCN-TPU")
+
+    parser.add_argument("--dataset", type=str, default="reddit",
+                        help="the input dataset")
+    parser.add_argument("--graph-name", "--graph_name", type=str, default="")
+
+    parser.add_argument("--model", type=str, default="graphsage",
+                        help="model for training")
+    parser.add_argument("--dropout", type=float, default=0.5,
+                        help="dropout probability")
+    parser.add_argument("--lr", type=float, default=1e-2,
+                        help="learning rate")
+    parser.add_argument("--n-epochs", "--n_epochs", type=int, default=200,
+                        help="the number of training epochs")
+    parser.add_argument("--n-partitions", "--n_partitions", type=int,
+                        default=2, help="the number of partitions")
+    parser.add_argument("--n-hidden", "--n_hidden", type=int, default=16,
+                        help="the number of hidden units")
+    parser.add_argument("--n-layers", "--n_layers", type=int, default=2,
+                        help="the number of GCN layers")
+    parser.add_argument("--n-linear", "--n_linear", type=int, default=0,
+                        help="the number of linear layers")
+    parser.add_argument("--norm", choices=["layer", "batch", "none"],
+                        default="layer", help="normalization method")
+    parser.add_argument("--weight-decay", "--weight_decay", type=float,
+                        default=0, help="weight for L2 loss")
+
+    parser.add_argument("--n-feat", "--n_feat", type=int, default=0)
+    parser.add_argument("--n-class", "--n_class", type=int, default=0)
+    parser.add_argument("--n-train", "--n_train", type=int, default=0)
+    parser.add_argument("--skip-partition", "--skip_partition",
+                        action="store_true",
+                        help="reuse the on-disk partition artifact")
+
+    parser.add_argument("--partition-obj", "--partition_obj",
+                        choices=["vol", "cut"], default="vol",
+                        help="partition objective function")
+    parser.add_argument("--partition-method", "--partition_method",
+                        choices=["metis", "random"], default="metis",
+                        help="the method for graph partition")
+
+    parser.add_argument("--enable-pipeline", "--enable_pipeline",
+                        action="store_true")
+    parser.add_argument("--feat-corr", "--feat_corr", action="store_true")
+    parser.add_argument("--grad-corr", "--grad_corr", action="store_true")
+    parser.add_argument("--corr-momentum", "--corr_momentum", type=float,
+                        default=0.95)
+
+    parser.add_argument("--use-pp", "--use_pp", action="store_true",
+                        help="whether to use precomputation")
+    parser.add_argument("--inductive", action="store_true",
+                        help="inductive learning setting")
+    parser.add_argument("--fix-seed", "--fix_seed", action="store_true",
+                        help="fix random seed")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--log-every", "--log_every", type=int, default=10)
+
+    parser.add_argument("--backend", type=str, default="xla")
+    parser.add_argument("--port", type=int, default=18118,
+                        help="coordinator port for multi-host")
+    parser.add_argument("--master-addr", "--master_addr", type=str,
+                        default="127.0.0.1")
+    parser.add_argument("--node-rank", "--node_rank", type=int, default=0)
+    parser.add_argument("--parts-per-node", "--parts_per_node", type=int,
+                        default=10)
+
+    parser.add_argument("--eval", action="store_true",
+                        help="enable evaluation")
+    parser.add_argument("--no-eval", action="store_false", dest="eval",
+                        help="disable evaluation")
+    parser.set_defaults(eval=True)
+
+    # ---- TPU-native extensions (not in the reference) ----
+    parser.add_argument("--data-root", "--data_root", type=str, default=None,
+                        help="dataset root (default $PIPEGCN_DATA or ./dataset)")
+    parser.add_argument("--partition-dir", "--partition_dir", type=str,
+                        default="partitions",
+                        help="directory for partition artifacts")
+    parser.add_argument("--model-dir", "--model_dir", type=str,
+                        default="model", help="directory for saved models")
+    parser.add_argument("--results-dir", "--results_dir", type=str,
+                        default="results", help="directory for result logs")
+    parser.add_argument("--spmm-chunk", "--spmm_chunk", type=int, default=0,
+                        help="edge-chunk size bounding SpMM memory "
+                             "(0 = unchunked)")
+    parser.add_argument("--checkpoint-dir", "--checkpoint_dir", type=str,
+                        default="",
+                        help="enable periodic checkpointing to this dir")
+    parser.add_argument("--checkpoint-every", "--checkpoint_every", type=int,
+                        default=100)
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from --checkpoint-dir")
+    return parser

@@ -133,7 +133,15 @@ def _sync_batch_norm_train(h, scale, bias, state, whole_size, psum,
     sync_bn.py:13-22): statistics = psum of per-device sums divided by the
     global train size. `row_mask` excludes padded rows, whose values are
     nonzero layer outputs here (the reference has no padding; its rows are
-    exactly the inner nodes). Returns (out, new_state)."""
+    exactly the inner nodes).
+
+    Intentional deviation: the reference all-reduces dweight/dbias inside
+    the BN backward (sync_bn.py:35-36) AND again in the per-parameter
+    reduce hook (reducer.py:30), making BN affine gradients P times the
+    true distributed gradient. Here autodiff + the single grad psum yield
+    the mathematically correct gradient (no double reduction).
+
+    Returns (out, new_state)."""
     hm = h if row_mask is None else h * row_mask[:, None]
     sum_x = psum(hm.sum(axis=0))
     sum_x2 = psum((hm * hm).sum(axis=0))

@@ -62,16 +62,20 @@ def spmm_sum(
     main_src = edge_src[: n_full * chunk].reshape(n_full, chunk)
     main_dst = edge_dst[: n_full * chunk].reshape(n_full, chunk)
 
-    def body(acc, sd):
-        s, d = sd
+    def _chunk_sum(s, d):
         msgs = jnp.take(fbuf, s, axis=0)
-        return acc + jax.ops.segment_sum(
+        return jax.ops.segment_sum(
             msgs, d, num_segments=n_out + 1,
             indices_are_sorted=sorted_edges,
-        ), None
+        )
 
-    acc0 = jnp.zeros((n_out + 1, fbuf.shape[-1]), fbuf.dtype)
-    acc, _ = jax.lax.scan(body, acc0, (main_src, main_dst))
+    def body(acc, sd):
+        return acc + _chunk_sum(*sd), None
+
+    # seed the scan carry with the first chunk (not zeros) so the carry
+    # inherits fbuf's varying-over-mesh type inside shard_map
+    acc0 = _chunk_sum(main_src[0], main_dst[0])
+    acc, _ = jax.lax.scan(body, acc0, (main_src[1:], main_dst[1:]))
     rem = e - n_full * chunk
     if rem:
         msgs = jnp.take(fbuf, edge_src[n_full * chunk :], axis=0)

@@ -185,7 +185,7 @@ class Trainer:
             in_deg, send_idx, send_mask = in_deg[0], send_idx[0], send_mask[0]
             fbuf = halo_exchange(feat, send_idx, send_mask, PARTS_AXIS, self.P)
             ah = spmm_mean(fbuf, edge_src, edge_dst, in_deg, n_max,
-                           self.cfg.spmm_chunk)
+                           self.cfg.spmm_chunk, self.cfg.sorted_edges)
             return jnp.concatenate([feat, ah], axis=1)[None]
 
         spec = PartitionSpec(PARTS_AXIS)
@@ -369,7 +369,7 @@ class Trainer:
         (reference train.py:327-400). `eval_graphs` maps split name ->
         (graph, mask key); must contain 'val' (and usually 'test')."""
         tcfg = self.tcfg
-        best_val, best_params, best_epoch = 0.0, None, -1
+        best_val, best_params, best_norm, best_epoch = 0.0, None, None, -1
         durs = []
         history = []
         for epoch in range(tcfg.n_epochs):
@@ -392,7 +392,11 @@ class Trainer:
                     if acc > best_val:
                         best_val = acc
                         best_epoch = epoch + 1
+                        # snapshot BN running stats with the params (the
+                        # reference deep-copies the whole model incl.
+                        # buffers, train.py:383)
                         best_params = jax.device_get(self.state["params"])
+                        best_norm = jax.device_get(self.state["norm"])
                 else:
                     history.append((epoch + 1, loss, None))
                 log_fn(msg)
@@ -400,18 +404,21 @@ class Trainer:
             "best_val": best_val,
             "best_epoch": best_epoch,
             "best_params": best_params,
+            "best_norm": best_norm,
             "epoch_time": float(np.mean(durs)) if durs else None,
             "history": history,
         }
         if tcfg.eval and eval_graphs and "test" in eval_graphs and \
                 best_params is not None:
             g, mask = eval_graphs["test"]
-            result["test_acc"] = self.evaluate(g, mask, params=best_params)
+            result["test_acc"] = self.evaluate(g, mask, params=best_params,
+                                               norm=best_norm)
         return result
 
     # ---------------- evaluation --------------------------------------
 
-    def evaluate(self, g: Graph, mask_key: str, params=None) -> float:
+    def evaluate(self, g: Graph, mask_key: str, params=None,
+                 norm=None) -> float:
         """Full-graph eval on one device (reference evaluates the full
         graph on rank 0's CPU, train.py:20-61; we use the accelerator)."""
         key = id(g)
@@ -433,7 +440,8 @@ class Trainer:
         c = self._eval_cache[key]
         if params is None:
             params = self.state["params"]
-        norm = self.state["norm"]
+        if norm is None:
+            norm = self.state["norm"]
         logits = np.asarray(
             self._eval_run(params, norm, c["feat"], c["edge_src"],
                            c["edge_dst"], c["in_deg"], c["n"])

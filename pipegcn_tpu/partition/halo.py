@@ -281,10 +281,14 @@ class ShardedGraph:
         "train_mask", "val_mask", "test_mask", "in_deg", "global_nid",
     ]
 
+    # format history: v1 edges grouped by device only; v2 adds the per-
+    # device dst-sorted (CSR) edge order that spmm's sorted path relies on
+    FORMAT_VERSION = 2
+
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         manifest = {
-            "format_version": 1,
+            "format_version": self.FORMAT_VERSION,
             "num_parts": self.num_parts,
             "n_max": self.n_max,
             "b_max": self.b_max,
@@ -305,7 +309,13 @@ class ShardedGraph:
     def load(path: str) -> "ShardedGraph":
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
-        manifest.pop("format_version", None)
+        version = manifest.pop("format_version", 0)
+        if version != ShardedGraph.FORMAT_VERSION:
+            raise ValueError(
+                f"partition artifact at {path} has format v{version}, "
+                f"expected v{ShardedGraph.FORMAT_VERSION}; re-partition "
+                f"(delete the directory or drop --skip-partition)"
+            )
         arrays = np.load(os.path.join(path, "arrays.npz"))
         return ShardedGraph(**manifest, **{k: arrays[k] for k in
                                            ShardedGraph._ARRAYS})

@@ -1,0 +1,78 @@
+"""Checkpointing: pytrees <-> npz files.
+
+The reference only saves the best model's state_dict at the end of
+training (train.py:397) — and into a directory it never creates (latent
+crash, SURVEY.md §2a). Here: directories are created, and full training
+state (params + optimizer moments + norm state + pipelined comm buffers +
+epoch) can be checkpointed and resumed, which the reference cannot do.
+
+Format: one .npz per pytree, leaves keyed by their tree path; loading
+restores into the structure of a caller-provided template pytree (shapes
+and paths must match).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    arrays = {_path_str(p): np.asarray(v) for p, v in leaves}
+    np.savez_compressed(path, **arrays)
+
+
+def load_pytree(path: str, template: Any) -> Any:
+    """Load arrays saved by save_pytree into template's structure."""
+    data = np.load(path)
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for p, tmpl in paths:
+        key = _path_str(p)
+        if key not in data:
+            raise KeyError(f"checkpoint {path} missing leaf {key}")
+        arr = data[key]
+        if tuple(arr.shape) != tuple(np.shape(tmpl)):
+            raise ValueError(
+                f"checkpoint leaf {key}: shape {arr.shape} != template "
+                f"{np.shape(tmpl)}"
+            )
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int) -> None:
+    """Save full training state for resume."""
+    os.makedirs(directory, exist_ok=True)
+    save_pytree(os.path.join(directory, "state.npz"), state)
+    with open(os.path.join(directory, "epoch.txt"), "w") as f:
+        f.write(str(epoch))
+
+
+def load_checkpoint(directory: str, template: Dict[str, Any]):
+    """Returns (state, next_epoch) restored from save_checkpoint."""
+    state = load_pytree(os.path.join(directory, "state.npz"), template)
+    with open(os.path.join(directory, "epoch.txt")) as f:
+        epoch = int(f.read().strip())
+    return state, epoch
+
+
+def checkpoint_exists(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, "state.npz"))

@@ -1,0 +1,105 @@
+"""CLI surface + end-to-end run tests (programmatic args, CPU mesh)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.cli.main import derive_graph_name, result_file_name, run
+from pipegcn_tpu.cli.parser import create_parser
+
+
+def _args(tmp_path, extra):
+    base = [
+        "--dataset", "synthetic:600:8:16:4",
+        "--n-partitions", "4",
+        "--n-epochs", "25",
+        "--n-layers", "2",
+        "--n-hidden", "32",
+        "--dropout", "0.2",
+        "--log-every", "10",
+        "--fix-seed", "--seed", "7",
+        "--partition-dir", str(tmp_path / "partitions"),
+        "--model-dir", str(tmp_path / "model"),
+        "--results-dir", str(tmp_path / "results"),
+    ]
+    return create_parser().parse_args(base + extra)
+
+
+def test_parser_reference_surface():
+    """Every reference flag (helper/parser.py:4-71) parses, both
+    spellings."""
+    p = create_parser()
+    a = p.parse_args([
+        "--dataset", "reddit", "--graph_name", "x", "--model", "graphsage",
+        "--dropout", "0.5", "--lr", "0.01", "--n_epochs", "3000",
+        "--n-partitions", "2", "--n_hidden", "256", "--n-layers", "4",
+        "--n_linear", "0", "--norm", "layer", "--weight_decay", "0",
+        "--n-feat", "602", "--n_class", "41", "--n-train", "153431",
+        "--skip-partition", "--partition_obj", "vol",
+        "--partition-method", "metis", "--enable_pipeline", "--feat-corr",
+        "--grad_corr", "--corr-momentum", "0.95", "--use_pp", "--inductive",
+        "--fix_seed", "--seed", "1", "--log_every", "10", "--backend",
+        "xla", "--port", "18118", "--master_addr", "127.0.0.1",
+        "--node-rank", "0", "--parts_per_node", "10", "--no-eval",
+    ])
+    assert a.n_epochs == 3000 and a.enable_pipeline and not a.eval
+    assert a.graph_name == "x"
+
+
+def test_graph_name_and_result_file():
+    a = create_parser().parse_args(
+        ["--dataset", "reddit", "--n-partitions", "2", "--inductive",
+         "--enable-pipeline", "--grad-corr"])
+    assert derive_graph_name(a) == "reddit-2-metis-vol-induc"
+    assert result_file_name(a).endswith("reddit_n2_p1_grad.txt")
+
+
+def test_cli_end_to_end_transductive(tmp_path):
+    res = run(_args(tmp_path, ["--enable-pipeline", "--use-pp"]))
+    assert res["best_val"] > 0.7
+    assert res["test_acc"] > 0.7
+    # artifacts: partition cache, results file, model file
+    assert os.path.exists(res["model_path"])
+    rfile = result_file_name(_args(tmp_path, ["--enable-pipeline",
+                                              "--use-pp"]))
+    lines = open(rfile).read().strip().splitlines()
+    assert len(lines) >= 2
+    assert "Validation Accuracy" in lines[0]
+
+
+def test_cli_inductive_and_skip_partition(tmp_path):
+    args = _args(tmp_path, ["--inductive"])
+    res1 = run(args)
+    assert res1["best_val"] > 0.6
+    # second run reuses the partition artifact
+    args2 = _args(tmp_path, ["--inductive", "--skip-partition"])
+    res2 = run(args2)
+    assert res2["best_val"] > 0.6
+    rfile = result_file_name(args)
+    assert "Accuracy" in open(rfile).read()
+
+
+def test_cli_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    args = _args(tmp_path, ["--checkpoint-dir", ckpt,
+                            "--checkpoint-every", "10"])
+    run(args)
+    assert os.path.exists(os.path.join(ckpt, "state.npz"))
+    # resume picks up at the saved epoch and trains further
+    args2 = _args(tmp_path, ["--checkpoint-dir", ckpt, "--resume",
+                             "--skip-partition", "--n-epochs", "45"])
+    res = run(args2)
+    assert res["best_val"] > 0.6
+
+
+def test_cli_rejects_bad_backend(tmp_path):
+    with pytest.raises(NotImplementedError):
+        run(_args(tmp_path, ["--backend", "nccl"]))
+    with pytest.raises(ValueError):
+        run(_args(tmp_path, ["--backend", "smoke"]))
+
+
+def test_cli_rejects_bad_model(tmp_path):
+    with pytest.raises(ValueError):
+        run(_args(tmp_path, ["--model", "gat"]))
