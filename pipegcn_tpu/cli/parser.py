@@ -112,4 +112,8 @@ def create_parser() -> argparse.ArgumentParser:
                         default=100)
     parser.add_argument("--resume", action="store_true",
                         help="resume from --checkpoint-dir")
+    parser.add_argument("--profile-dir", "--profile_dir", type=str,
+                        default="",
+                        help="write a jax.profiler trace of a few epochs "
+                             "to this directory (TensorBoard format)")
     return parser

@@ -368,15 +368,20 @@ class Trainer:
         """Epoch loop with periodic evaluation and best-val tracking
         (reference train.py:327-400). `eval_graphs` maps split name ->
         (graph, mask key); must contain 'val' (and usually 'test')."""
+        from ..utils.timer import CommTimer
+
         tcfg = self.tcfg
         best_val, best_params, best_norm, best_epoch = 0.0, None, None, -1
         durs = []
+        eval_durs = []
         history = []
+        timer = CommTimer()
         for epoch in range(tcfg.n_epochs):
-            t0 = time.perf_counter()
-            loss = self.train_epoch(epoch)
-            jax.block_until_ready(self.state["params"])
-            dur = time.perf_counter() - t0
+            timer.clear()
+            with timer.timer("step"):
+                loss = self.train_epoch(epoch)
+                jax.block_until_ready(self.state["params"])
+            dur = timer.durations()["step"]
             # epochs <5 excluded from averaged timings (reference
             # train.py:364)
             if epoch >= 5:
@@ -386,7 +391,9 @@ class Trainer:
                        f"| Loss {loss:.4f}")
                 if tcfg.eval and eval_graphs and "val" in eval_graphs:
                     g, mask = eval_graphs["val"]
-                    acc = self.evaluate(g, mask)
+                    with timer.timer("eval"):
+                        acc = self.evaluate(g, mask)
+                    eval_durs.append(timer.durations()["eval"])
                     msg += f" | Val {acc:.4f}"
                     history.append((epoch + 1, loss, acc))
                     if acc > best_val:
@@ -406,6 +413,7 @@ class Trainer:
             "best_params": best_params,
             "best_norm": best_norm,
             "epoch_time": float(np.mean(durs)) if durs else None,
+            "eval_time": float(np.mean(eval_durs)) if eval_durs else None,
             "history": history,
         }
         if tcfg.eval and eval_graphs and "test" in eval_graphs and \
@@ -414,6 +422,66 @@ class Trainer:
             result["test_acc"] = self.evaluate(g, mask, params=best_params,
                                                norm=best_norm)
         return result
+
+    # ---------------- comm cost measurement ---------------------------
+
+    def measure_comm(self, repeats: int = 5) -> Dict[str, float]:
+        """Standalone timing of the step's collectives: per-layer halo
+        exchange ('comm', the analogue of the reference's exposed
+        forward/backward transfer waits, helper/timer/comm_timer.py) and
+        the gradient psum ('reduce', reference reducer timing
+        train.py:359-361). In pipelined mode the real step overlaps these
+        with compute, so this measures the collective cost, not exposed
+        wait time."""
+        P = self.P
+        spec = PartitionSpec(PARTS_AXIS)
+
+        def comm_fn(feat, send_idx, send_mask):
+            feat, send_idx, send_mask = feat[0], send_idx[0], send_mask[0]
+            outs = []
+            for i in self._graph_layer_range():
+                w = self._layer_width(i)
+                h = feat[:, :1] * jnp.ones((1, w), jnp.float32)
+                blocks = exchange_blocks(h, send_idx, send_mask,
+                                         PARTS_AXIS, P)
+                outs.append(blocks.sum())
+            return jnp.stack(outs).sum()[None] if outs else \
+                jnp.zeros((1,), jnp.float32)
+
+        comm_jit = jax.jit(jax.shard_map(
+            comm_fn, mesh=self.mesh, in_specs=(spec,) * 3, out_specs=spec,
+        ))
+
+        def reduce_fn(params):
+            return jax.tree_util.tree_map(
+                lambda p: jax.lax.psum(p, PARTS_AXIS), params
+            )
+
+        reduce_jit = jax.jit(jax.shard_map(
+            reduce_fn, mesh=self.mesh,
+            in_specs=(jax.tree_util.tree_map(
+                lambda _: PartitionSpec(), self.state["params"]),),
+            out_specs=jax.tree_util.tree_map(
+                lambda _: PartitionSpec(), self.state["params"]),
+        ))
+
+        d = self.data
+        args = (d["feat"], d["send_idx"], d["send_mask"])
+        jax.block_until_ready(comm_jit(*args))  # compile
+        jax.block_until_ready(reduce_jit(self.state["params"]))
+
+        def _med(fn, *a):
+            ts = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*a))
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        return {
+            "comm": _med(comm_jit, *args),
+            "reduce": _med(reduce_jit, self.state["params"]),
+        }
 
     # ---------------- evaluation --------------------------------------
 

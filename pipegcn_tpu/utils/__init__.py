@@ -1,3 +1,10 @@
 from .checkpoint import save_pytree, load_pytree, save_checkpoint, load_checkpoint
+from .timer import CommTimer
 
-__all__ = ["save_pytree", "load_pytree", "save_checkpoint", "load_checkpoint"]
+__all__ = [
+    "save_pytree",
+    "load_pytree",
+    "save_checkpoint",
+    "load_checkpoint",
+    "CommTimer",
+]

@@ -1,0 +1,40 @@
+"""Timing utilities.
+
+CommTimer mirrors the reference's helper/timer/comm_timer.py API (spans
+keyed 'forward_{layer}'/'backward_{layer}', duplicate keys raise,
+`tot_time()` summed per epoch, `clear()` between epochs) so tooling built
+against the reference's log discipline keeps working. In the SPMD design
+the per-layer comm is inside one jitted step, so these spans wrap
+host-blocking regions (step dispatch, eval) rather than gloo waits; the
+per-collective breakdown comes from `Trainer.measure_comm()` (standalone
+timing of the exchange/reduce collectives) and `jax.profiler` traces
+(--profile-dir).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+
+class CommTimer:
+    def __init__(self):
+        self._durs: Dict[str, float] = {}
+
+    @contextmanager
+    def timer(self, key: str):
+        if key in self._durs:
+            raise RuntimeError(f"duplicate timer key: {key}")
+        t0 = time.perf_counter()
+        yield
+        self._durs[key] = time.perf_counter() - t0
+
+    def tot_time(self) -> float:
+        return sum(self._durs.values())
+
+    def durations(self) -> Dict[str, float]:
+        return dict(self._durs)
+
+    def clear(self) -> None:
+        self._durs.clear()

@@ -1,0 +1,54 @@
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+from pipegcn_tpu.utils import load_pytree, save_pytree
+from pipegcn_tpu.utils.timer import CommTimer
+
+
+def test_comm_timer_spans_and_parity_semantics():
+    t = CommTimer()
+    with t.timer("forward_0"):
+        pass
+    with t.timer("backward_0"):
+        pass
+    assert t.tot_time() >= 0
+    assert set(t.durations()) == {"forward_0", "backward_0"}
+    # duplicate key raises (reference comm_timer.py:14-15)
+    with pytest.raises(RuntimeError):
+        with t.timer("forward_0"):
+            pass
+    t.clear()
+    assert t.tot_time() == 0.0
+
+
+def test_pytree_roundtrip(tmp_path):
+    tree = {"a": np.arange(6).reshape(2, 3), "b": [np.ones(4), {"c": np.zeros(2)}]}
+    p = str(tmp_path / "t.npz")
+    save_pytree(p, tree)
+    back = load_pytree(p, tree)
+    np.testing.assert_array_equal(back["a"], tree["a"])
+    np.testing.assert_array_equal(back["b"][1]["c"], tree["b"][1]["c"])
+    # shape mismatch is rejected
+    bad = {"a": np.zeros((3, 3)), "b": tree["b"]}
+    with pytest.raises(ValueError):
+        load_pytree(p, bad)
+    # missing leaf is rejected
+    with pytest.raises(KeyError):
+        load_pytree(p, {"a": tree["a"], "zz": np.zeros(1)})
+
+
+def test_measure_comm():
+    g = synthetic_graph(num_nodes=300, avg_degree=6, n_feat=8, n_class=3,
+                        seed=1)
+    parts = partition_graph(g, 4, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4)
+    cfg = ModelConfig(layer_sizes=(8, 16, 3), dropout=0.0,
+                      train_size=sg.n_train_global)
+    t = Trainer(sg, cfg, TrainConfig(n_epochs=1))
+    cost = t.measure_comm(repeats=2)
+    assert cost["comm"] > 0 and cost["reduce"] > 0
+    assert cost["comm"] < 5 and cost["reduce"] < 5
