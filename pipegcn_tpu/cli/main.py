@@ -26,22 +26,14 @@ from __future__ import annotations
 import math
 import os
 import random
-import time
 import warnings
-from typing import Optional
 
-import numpy as np
 
 from ..graph.datasets import inductive_split, load_data
 from ..models.sage import ModelConfig
 from ..partition.halo import ShardedGraph
 from ..partition.partitioner import partition_graph
-from ..utils.checkpoint import (
-    checkpoint_exists,
-    load_checkpoint,
-    save_checkpoint,
-    save_pytree,
-)
+from ..utils.checkpoint import checkpoint_exists, load_checkpoint, save_pytree
 
 
 def derive_graph_name(args) -> str:
@@ -148,7 +140,6 @@ def run(args) -> dict:
     _maybe_init_distributed(args)
 
     from ..parallel.trainer import TrainConfig, Trainer
-    from ..train.metrics import calc_acc
 
     sg, eval_graphs = prepare(args)
 
@@ -164,6 +155,7 @@ def run(args) -> dict:
         dropout=args.dropout,
         train_size=n_train,
         spmm_chunk=args.spmm_chunk or None,
+        spmm_impl=args.spmm_impl,
     )
     tcfg = TrainConfig(
         lr=args.lr,
@@ -197,88 +189,32 @@ def run(args) -> dict:
         }
         print(f"resumed from {args.checkpoint_dir} at epoch {start_epoch}")
 
-    best_val, best_params, best_norm, best_epoch = 0.0, None, None, -1
-    train_dur = []
-    comm_cost = {"comm": 0.0, "reduce": 0.0}
-    profiling = False
-
-    for epoch in range(start_epoch, args.n_epochs):
-        if args.profile_dir and epoch == start_epoch + 6 and not profiling:
-            jax.profiler.start_trace(args.profile_dir)
-            profiling = True
-        t0 = time.perf_counter()
-        loss = trainer.train_epoch(epoch)
-        jax.block_until_ready(trainer.state["params"])
-        dur = time.perf_counter() - t0
-        if profiling and epoch >= start_epoch + 8:
-            jax.profiler.stop_trace()
-            profiling = False
-            print(f"profiler trace written to {args.profile_dir}")
-        if epoch >= 5 and epoch % args.log_every != 0:
-            train_dur.append(dur)
-        if epoch == start_epoch + 5:
-            # standalone collective cost, measured once after compile
-            # (the reference reports per-epoch exposed comm/reduce waits,
-            # train.py:366-371; in SPMD those are overlapped inside the
-            # step, so we report the collectives' own cost)
-            comm_cost = trainer.measure_comm()
-
-        if (epoch + 1) % 10 == 0:
-            # reference log line format (train.py:369-371); rank is
-            # always 0 in SPMD (one controller)
-            print("Process {:03d} | Epoch {:05d} | Time(s) {:.4f} | "
-                  "Comm(s) {:.4f} | Reduce(s) {:.4f} | Loss {:.4f}".format(
-                      0, epoch, float(np.mean(train_dur or [dur])),
-                      comm_cost["comm"], comm_cost["reduce"], loss))
-
-        if args.eval and eval_graphs and (epoch + 1) % args.log_every == 0:
-            g, mask = eval_graphs["val"]
-            acc = trainer.evaluate(g, mask)
-            if args.inductive:
-                # reference evaluate_induc format (train.py:33-39)
-                buf = "Epoch {:05d} | Accuracy {:.2%}".format(epoch, acc)
-            else:
-                # reference evaluate_trans format (train.py:54-60)
-                tg, tmask = eval_graphs["test"]
-                t_acc = trainer.evaluate(tg, tmask)
-                buf = ("Epoch {:05d} | Validation Accuracy {:.2%} | "
-                       "Test Accuracy {:.2%}".format(epoch, acc, t_acc))
-            with open(rfile, "a+") as f:
-                f.write(buf + "\n")
-            print(buf)
-            if acc > best_val:
-                best_val = acc
-                best_epoch = epoch
-                best_params = jax.device_get(trainer.state["params"])
-                best_norm = jax.device_get(trainer.state["norm"])
-
-        if args.checkpoint_dir and (epoch + 1) % args.checkpoint_every == 0:
-            save_checkpoint(
-                args.checkpoint_dir, jax.device_get(trainer.state), epoch + 1
-            )
-
-    if profiling:
-        # run ended inside the trace window; finalize the trace
-        jax.profiler.stop_trace()
-        print(f"profiler trace written to {args.profile_dir}")
+    fit_res = trainer.fit(
+        eval_graphs,
+        start_epoch=start_epoch,
+        reference_logs=True,
+        result_file=rfile,
+        inductive=args.inductive,
+        checkpoint_dir=args.checkpoint_dir or None,
+        checkpoint_every=args.checkpoint_every,
+        profile_dir=args.profile_dir or None,
+        measure_comm_cost=True,
+    )
 
     result = {
         "graph_name": graph_name,
-        "epoch_time": float(np.mean(train_dur)) if train_dur else None,
-        "best_val": best_val,
-        "best_epoch": best_epoch,
+        "epoch_time": fit_res["epoch_time"],
+        "best_val": fit_res["best_val"],
+        "best_epoch": fit_res["best_epoch"],
     }
-    if args.eval and best_params is not None:
+    if args.eval and fit_res["best_params"] is not None:
         os.makedirs(args.model_dir, exist_ok=True)
         model_path = os.path.join(args.model_dir, f"{graph_name}_final.npz")
-        save_pytree(model_path, best_params)
+        save_pytree(model_path, fit_res["best_params"])
         print("model saved")
-        print("Validation accuracy {:.2%}".format(best_val))
-        g, mask = eval_graphs["test"]
-        test_acc = trainer.evaluate(g, mask, params=best_params,
-                                    norm=best_norm)
-        print("Test Result | Accuracy {:.2%}".format(test_acc))
-        result["test_acc"] = test_acc
+        print("Validation accuracy {:.2%}".format(fit_res["best_val"]))
+        print("Test Result | Accuracy {:.2%}".format(fit_res["test_acc"]))
+        result["test_acc"] = fit_res["test_acc"]
         result["model_path"] = model_path
     return result
 

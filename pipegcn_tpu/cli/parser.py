@@ -105,6 +105,11 @@ def create_parser() -> argparse.ArgumentParser:
     parser.add_argument("--spmm-chunk", "--spmm_chunk", type=int, default=0,
                         help="edge-chunk size bounding SpMM memory "
                              "(0 = unchunked)")
+    parser.add_argument("--spmm-impl", "--spmm_impl",
+                        choices=["xla", "pallas", "auto"], default="xla",
+                        help="aggregation kernel: XLA gather+segment-sum, "
+                             "the Pallas VMEM-resident CSR kernel, or "
+                             "auto-select by shard size")
     parser.add_argument("--checkpoint-dir", "--checkpoint_dir", type=str,
                         default="",
                         help="enable periodic checkpointing to this dir")

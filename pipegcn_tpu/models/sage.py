@@ -49,6 +49,7 @@ class ModelConfig:
     train_size: int = 0            # global n_train (SyncBN divisor, loss)
     spmm_chunk: Optional[int] = None
     sorted_edges: bool = False     # edge_dst ascending (CSR order)
+    spmm_impl: str = "xla"         # 'xla' | 'pallas' | 'auto'
 
     @property
     def n_layers(self) -> int:
@@ -183,6 +184,7 @@ def forward(
     psum: PsumFn = lambda x: x,
     eval_pp_agg: bool = False,
     row_mask: Optional[jax.Array] = None,
+    spmm_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
 ) -> Tuple[jax.Array, List[dict]]:
     """Run the GraphSAGE stack; returns (logits [n_dst, n_class],
     updated norm_state).
@@ -216,8 +218,14 @@ def forward(
                 if cfg.use_pp and i == 0:
                     h = h @ lp["w"] + lp["b"]
                 else:
-                    ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,
-                                   cfg.spmm_chunk, cfg.sorted_edges)
+                    # spmm_fn (e.g. the Pallas VMEM-resident kernel)
+                    # returns the mean directly when injected
+                    if spmm_fn is not None:
+                        ah = spmm_fn(h)
+                    else:
+                        ah = spmm_mean(h, edge_src, edge_dst, in_deg,
+                                       n_dst, cfg.spmm_chunk,
+                                       cfg.sorted_edges)
                     h = (h[:n_dst] @ lp["w1"] + lp["b1"]
                          + ah @ lp["w2"] + lp["b2"])
             else:

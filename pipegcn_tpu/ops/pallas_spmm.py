@@ -1,0 +1,248 @@
+"""Pallas CSR SpMM (mean aggregation) for VMEM-resident shards.
+
+The TPU-native replacement for DGL's CUDA SpMM kernel (reference
+module/layer.py:47-49) in the regime where it pays off: when a device's
+feature buffer fits in VMEM (~16 MB/core). With P partitions over a
+large graph, per-shard fbuf shrinks as 1/P, so the many-chip scaling
+case — the whole point of PipeGCN — is exactly the regime this kernel
+targets. Keeping fbuf on-chip makes the per-edge source-row reads VMEM
+loads instead of random HBM traffic; destination rows are produced
+row-block by row-block with edges streamed via one DMA per block.
+
+Outside that regime (fbuf larger than the VMEM budget), the XLA
+gather + sorted-segment-sum path in ops/spmm.py is the right algorithm
+— TPU's hardware gather beats anything a hand-written per-edge DMA loop
+can do over HBM — and the trainer's spmm_impl='auto' falls back to it
+(parallel/trainer.py _setup_pallas_spmm).
+
+Layout contract (per device, produced by partition.halo.ShardedGraph):
+edges sorted by destination (CSR); `row_ptr[i]` = first edge of dst row
+i. The kernel grid walks row blocks of 8 destinations; each step DMAs
+that block's edge-source indices into a VMEM scratch and accumulates
+its 8 output rows with an unrolled per-row edge loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_BLOCK = 8           # dst rows per grid step (fp32 sublane tile)
+VMEM_BUDGET = 12 << 20  # conservative fbuf budget (bytes) of ~16MB VMEM
+
+
+def build_row_ptr(edge_dst: np.ndarray, n_out: int) -> np.ndarray:
+    """CSR row pointers from dst-sorted edges (padding rows whose dst is
+    the sentinel `n_out` fall beyond row_ptr[n_out] and are ignored)."""
+    return np.searchsorted(edge_dst, np.arange(n_out + 1)).astype(np.int32)
+
+
+def _block_tables(row_ptr: np.ndarray, n_out: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Per-row start/end tables padded to the row-block grid, plus the
+    max edges any block touches (the edge-scratch/DMA size)."""
+    n_blocks = -(-n_out // ROW_BLOCK)
+    n_pad = n_blocks * ROW_BLOCK
+    starts = np.full(n_pad, row_ptr[-1], dtype=np.int32)
+    ends = np.full(n_pad, row_ptr[-1], dtype=np.int32)
+    starts[:n_out] = row_ptr[:-1]
+    ends[:n_out] = row_ptr[1:]
+    blk_start = starts.reshape(n_blocks, ROW_BLOCK)[:, 0]
+    blk_end = ends.reshape(n_blocks, ROW_BLOCK)[:, -1]
+    max_e = int((blk_end - blk_start).max()) if n_blocks else 0
+    max_e = max(-(-max_e // 128) * 128, 128)
+    return starts, ends, max_e
+
+
+def _kernel(starts_ref, ends_ref, deg_ref, esrc_hbm, fbuf_ref, out_ref,
+            eidx, sem, *, max_e, n_feat):
+    s0 = starts_ref[0]
+    # one DMA brings every edge-source index this block can touch
+    cp = pltpu.make_async_copy(esrc_hbm.at[pl.ds(s0, max_e)], eidx, sem)
+    cp.start()
+    cp.wait()
+
+    def row_body(r):
+        lo = starts_ref[r] - s0
+        hi = ends_ref[r] - s0
+
+        def edge_body(k, acc):
+            src = eidx[k]
+            return acc + fbuf_ref[src, :]
+
+        acc = jax.lax.fori_loop(
+            lo, hi, edge_body, jnp.zeros((n_feat,), jnp.float32)
+        )
+        out_ref[r, :] = acc / deg_ref[r]
+
+    for r in range(ROW_BLOCK):  # static unroll over the 8 block rows
+        row_body(r)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_out", "max_e", "interpret", "vma")
+)
+def _spmm_pallas_call(fbuf, edge_src_padded, starts, ends, in_deg_padded,
+                      n_out, max_e, interpret=False, vma=None):
+    n_blocks = starts.shape[0] // ROW_BLOCK
+    n_feat = fbuf.shape[-1]
+    kernel = functools.partial(_kernel, max_e=max_e, n_feat=n_feat)
+    out_shape = (n_blocks * ROW_BLOCK, n_feat)
+    if vma is not None:
+        # inside shard_map with check_vma the output's varying mesh axes
+        # must be declared explicitly
+        out_sds = jax.ShapeDtypeStruct(out_shape, jnp.float32, vma=vma)
+    else:
+        out_sds = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((ROW_BLOCK,), lambda b: (b,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((ROW_BLOCK,), lambda b: (b,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((ROW_BLOCK,), lambda b: (b,)),
+            pl.BlockSpec(memory_space=pl.ANY),      # edge_src in HBM
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # fbuf resident
+        ],
+        out_specs=pl.BlockSpec((ROW_BLOCK, n_feat), lambda b: (b, 0)),
+        out_shape=out_sds,
+        scratch_shapes=[
+            pltpu.VMEM((max_e,), jnp.int32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(starts, ends, in_deg_padded, edge_src_padded, fbuf)
+    return out[:n_out]
+
+
+class PallasSpmm:
+    """Host-side plan + callable for one shard's CSR layout.
+
+    Precomputes the block tables once (they depend only on the graph);
+    `__call__(fbuf)` then runs the kernel. `applicable` is False when
+    fbuf exceeds the VMEM budget or the edge scratch would be outsized
+    (extreme hub blocks) — callers should fall back to ops.spmm then.
+    """
+
+    def __init__(self, edge_src: np.ndarray, edge_dst: np.ndarray,
+                 in_deg: np.ndarray, n_out: int, n_src_rows: int,
+                 n_feat: int, interpret: bool = False):
+        row_ptr = build_row_ptr(np.asarray(edge_dst), n_out)
+        starts, ends, max_e = _block_tables(row_ptr, n_out)
+        self.n_out = n_out
+        self.max_e = max_e
+        self.interpret = interpret
+        n_pad = starts.shape[0]
+        # pad the edge array so the fixed-size DMA never over-reads
+        esrc = np.asarray(edge_src, dtype=np.int32)
+        self._esrc = jnp.asarray(
+            np.concatenate([esrc, np.zeros(max_e, np.int32)])
+        )
+        self._starts = jnp.asarray(starts)
+        self._ends = jnp.asarray(ends)
+        deg = np.ones(n_pad, np.float32)
+        deg[:n_out] = np.asarray(in_deg, np.float32)[:n_out]
+        self._deg = jnp.asarray(deg)
+        fbuf_bytes = n_src_rows * n_feat * 4
+        self.applicable = (
+            fbuf_bytes <= VMEM_BUDGET and max_e * 4 <= (2 << 20)
+        )
+
+    def __call__(self, fbuf: jax.Array) -> jax.Array:
+        return _spmm_pallas_call(
+            fbuf, self._esrc, self._starts, self._ends, self._deg,
+            self.n_out, self.max_e, self.interpret,
+        )
+
+
+def build_sharded_tables(sg) -> Tuple[dict, int, int]:
+    """Stacked per-device kernel tables for use inside shard_map.
+
+    Returns ({'spmm_starts','spmm_ends','spmm_esrc','spmm_deg'} each with
+    leading device axis, global max_e, max fbuf rows). Tables differ per
+    device, so they ship as sharded step inputs rather than plan-object
+    closures. max_e is the global maximum so the traced program is
+    identical on every device.
+    """
+    P = sg.num_parts
+    n_src_rows = sg.n_max + sg.halo_size
+    all_starts, all_ends, max_e = [], [], 128
+    t_gather = np.zeros_like(sg.edge_dst, dtype=np.int32)
+    t_scatter = np.zeros_like(sg.edge_src, dtype=np.int32)
+    for r in range(P):
+        row_ptr = build_row_ptr(np.asarray(sg.edge_dst[r]), sg.n_max)
+        s, e, me = _block_tables(row_ptr, sg.n_max)
+        all_starts.append(s)
+        all_ends.append(e)
+        max_e = max(max_e, me)
+        # transpose tables for the backward pass: gradient flows dst->src
+        # (gather rows of the cotangent by dst, scatter-add into source
+        # rows); pad edges (dst == sentinel n_max) must scatter into the
+        # dropped segment n_src_rows, not into node 0
+        src_r = np.asarray(sg.edge_src[r], dtype=np.int64)
+        dst_r = np.asarray(sg.edge_dst[r], dtype=np.int64)
+        is_pad = dst_r == sg.n_max
+        scat = np.where(is_pad, n_src_rows, src_r)
+        gath = np.where(is_pad, 0, dst_r)
+        order = np.argsort(scat, kind="stable")
+        t_gather[r] = gath[order].astype(np.int32)
+        t_scatter[r] = scat[order].astype(np.int32)
+    n_pad = all_starts[0].shape[0]
+    esrc = np.concatenate(
+        [sg.edge_src.astype(np.int32),
+         np.zeros((P, max_e), np.int32)], axis=1,
+    )
+    deg = np.ones((P, n_pad), np.float32)
+    deg[:, : sg.n_max] = sg.in_deg
+    tables = {
+        "spmm_starts": np.stack(all_starts),
+        "spmm_ends": np.stack(all_ends),
+        "spmm_esrc": esrc,
+        "spmm_deg": deg,
+        "spmm_t_gather": t_gather,
+        "spmm_t_scatter": t_scatter,
+    }
+    return tables, max_e, n_src_rows
+
+
+def make_device_spmm_fn(d: dict, n_max: int, n_src_rows: int, max_e: int,
+                        interpret: bool, chunk: Optional[int] = None,
+                        axis_name: str = "parts"):
+    """Differentiable per-device mean-SpMM closure over sharded tables
+    (call inside shard_map). Forward = the Pallas kernel; backward = the
+    transpose aggregation via the XLA sorted-segment path."""
+    from .spmm import spmm_sum
+
+    deg_col = d["spmm_deg"][:n_max][:, None]
+    vma = frozenset((axis_name,))
+
+    @jax.custom_vjp
+    def f(fbuf):
+        return _spmm_pallas_call(
+            fbuf, d["spmm_esrc"], d["spmm_starts"], d["spmm_ends"],
+            d["spmm_deg"], n_max, max_e, interpret, vma,
+        )
+
+    def fwd(fbuf):
+        return f(fbuf), None
+
+    def bwd(_, g):
+        gd = g / deg_col
+        d_fbuf = spmm_sum(gd, d["spmm_t_gather"], d["spmm_t_scatter"],
+                          n_src_rows, chunk, sorted_edges=True)
+        return (d_fbuf,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def sharded_applicable(n_src_rows: int, n_feat_max: int, max_e: int) -> bool:
+    return (n_src_rows * n_feat_max * 4 <= VMEM_BUDGET
+            and max_e * 4 <= (2 << 20))

@@ -87,6 +87,7 @@ class Trainer:
         self._shard = NamedSharding(self.mesh, PartitionSpec(PARTS_AXIS))
         self._repl = NamedSharding(self.mesh, PartitionSpec())
 
+        self._setup_pallas_spmm()
         self.data = self._put_data()
         if cfg.use_pp:
             self.data["feat"] = self._precompute_pp()
@@ -113,6 +114,42 @@ class Trainer:
 
         self._eval_run = _eval_run
 
+    # ---------------- pallas spmm selection ---------------------------
+
+    def _setup_pallas_spmm(self) -> None:
+        """Resolve cfg.spmm_impl: 'pallas' forces the VMEM-resident CSR
+        kernel (ops/pallas_spmm.py), 'auto' uses it when the shard fits
+        the VMEM budget, 'xla' (default) keeps gather+segment-sum."""
+        from ..ops.pallas_spmm import build_sharded_tables, sharded_applicable
+
+        impl = self.cfg.spmm_impl
+        self._pallas_tables = None
+        self._pallas_max_e = 0
+        if impl not in ("xla", "pallas", "auto"):
+            raise ValueError(f"unknown spmm_impl: {impl}")
+        if impl == "xla":
+            return
+        tables, max_e, n_src_rows = build_sharded_tables(self.sg)
+        widths = [
+            self._layer_width(i)
+            for i in range(1 if self.cfg.use_pp else 0,
+                           self.cfg.n_graph_layers)
+        ]
+        fits = sharded_applicable(n_src_rows, max(widths, default=1), max_e)
+        if impl == "auto" and not fits:
+            return
+        if impl == "pallas" and not fits:
+            import warnings
+
+            warnings.warn(
+                "spmm_impl='pallas' forced but the shard exceeds the VMEM "
+                "budget; expect compile failure or spills"
+            )
+        self._pallas_tables = tables
+        self._pallas_max_e = max_e
+        # interpret mode off TPU so tests exercise the same kernel
+        self._pallas_interpret = jax.default_backend() == "cpu"
+
     # ---------------- data placement ----------------------------------
 
     def _put_data(self) -> Dict[str, jax.Array]:
@@ -131,6 +168,8 @@ class Trainer:
                 np.arange(sg.n_max)[None, :] < sg.inner_count[:, None]
             ).astype(np.float32),
         }
+        if self._pallas_tables is not None:
+            arrs.update(self._pallas_tables)
         return {
             k: jax.device_put(jnp.asarray(v), self._shard)
             for k, v in arrs.items()
@@ -209,6 +248,9 @@ class Trainer:
         pipeline = tcfg.enable_pipeline
         glayers = list(self._graph_layer_range())
         momentum = tcfg.corr_momentum
+        use_pallas = self._pallas_tables is not None
+        pallas_max_e = self._pallas_max_e
+        pallas_interp = getattr(self, "_pallas_interpret", False)
 
         def step(state, data, rng):
             # strip the leading size-1 device axis of sharded blocks
@@ -259,6 +301,15 @@ class Trainer:
                         h, d["send_idx"], d["send_mask"], PARTS_AXIS, P
                     )
 
+            spmm_fn = None
+            if use_pallas:
+                from ..ops.pallas_spmm import make_device_spmm_fn
+
+                spmm_fn = make_device_spmm_fn(
+                    d, n_max, n_max + H, pallas_max_e, pallas_interp,
+                    cfg.spmm_chunk,
+                )
+
             def loss_fn(params, probes_arg):
                 nonlocal probes_in
                 probes_in = probes_arg
@@ -266,7 +317,7 @@ class Trainer:
                     params, cfg, d["feat"], d["edge_src"], d["edge_dst"],
                     d["in_deg"], n_max, training=True, rng=rng,
                     comm_update=comm_update, norm_state=norm, psum=psum,
-                    row_mask=d["row_mask"],
+                    row_mask=d["row_mask"], spmm_fn=spmm_fn,
                 )
                 if multilabel:
                     loss = bce_logits_sum(logits, d["label"], d["train_mask"])
@@ -343,11 +394,15 @@ class Trainer:
                 lambda _: PartitionSpec(PARTS_AXIS), self.state["comm"]
             ),
         }
+        # pallas interpret mode (CPU testing) hits an internal VMA
+        # mismatch in jax's HLO interpreter; relax the check there only
+        check_vma = not (use_pallas and pallas_interp)
         smapped = jax.shard_map(
             step,
             mesh=self.mesh,
             in_specs=(state_spec, data_spec, PartitionSpec()),
             out_specs=(state_spec, PartitionSpec()),
+            check_vma=check_vma,
         )
         return jax.jit(smapped, donate_argnums=(0,))
 
@@ -364,10 +419,26 @@ class Trainer:
         self,
         eval_graphs: Optional[Dict[str, Tuple[Graph, str]]] = None,
         log_fn=print,
+        *,
+        start_epoch: int = 0,
+        reference_logs: bool = False,
+        result_file: Optional[str] = None,
+        inductive: bool = False,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 100,
+        profile_dir: Optional[str] = None,
+        measure_comm_cost: bool = False,
     ) -> Dict[str, Any]:
-        """Epoch loop with periodic evaluation and best-val tracking
-        (reference train.py:327-400). `eval_graphs` maps split name ->
-        (graph, mask key); must contain 'val' (and usually 'test')."""
+        """The single epoch loop (reference train.py:327-400): periodic
+        evaluation, best-val/BN-stats tracking, timing with <5-epoch
+        warmup exclusion, and — for the CLI — reference-format log lines
+        with measured Comm/Reduce collective costs, result files
+        (train.py:33-39/54-60 formats), jax.profiler traces, and
+        periodic checkpointing.
+
+        `eval_graphs` maps split name -> (graph, mask key); must contain
+        'val' (and usually 'test')."""
+        from ..utils.checkpoint import save_checkpoint
         from ..utils.timer import CommTimer
 
         tcfg = self.tcfg
@@ -375,26 +446,75 @@ class Trainer:
         durs = []
         eval_durs = []
         history = []
+        comm_cost = {"comm": 0.0, "reduce": 0.0}
+        comm_measured = False
         timer = CommTimer()
-        for epoch in range(tcfg.n_epochs):
+        profiling = False
+        n_epochs = tcfg.n_epochs
+
+        for epoch in range(start_epoch, n_epochs):
+            if profile_dir and not profiling and \
+                    epoch == min(start_epoch + 6, n_epochs - 1):
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
             timer.clear()
             with timer.timer("step"):
                 loss = self.train_epoch(epoch)
                 jax.block_until_ready(self.state["params"])
             dur = timer.durations()["step"]
+            if profiling and epoch >= start_epoch + 8:
+                jax.profiler.stop_trace()
+                profiling = False
+                log_fn(f"profiler trace written to {profile_dir}")
             # epochs <5 excluded from averaged timings (reference
             # train.py:364)
-            if epoch >= 5:
+            if epoch >= 5 and epoch % tcfg.log_every != 0:
                 durs.append(dur)
+            if measure_comm_cost and not comm_measured and \
+                    epoch >= min(start_epoch + 5, n_epochs - 1):
+                # standalone collective cost, measured once post-compile
+                # (the reference reports per-epoch exposed comm/reduce
+                # waits, train.py:366-371; SPMD overlaps those inside
+                # the step, so we report the collectives' own cost)
+                comm_cost = self.measure_comm()
+                comm_measured = True
+
+            if reference_logs and (epoch + 1) % 10 == 0:
+                # reference log line format (train.py:369-371); rank is
+                # always 0 in SPMD (one controller)
+                log_fn("Process {:03d} | Epoch {:05d} | Time(s) {:.4f} | "
+                       "Comm(s) {:.4f} | Reduce(s) {:.4f} | Loss {:.4f}"
+                       .format(0, epoch, float(np.mean(durs or [dur])),
+                               comm_cost["comm"], comm_cost["reduce"],
+                               loss))
+
             if (epoch + 1) % tcfg.log_every == 0:
-                msg = (f"Epoch {epoch + 1:05d} | Time(s) {np.mean(durs or [dur]):.4f} "
-                       f"| Loss {loss:.4f}")
-                if tcfg.eval and eval_graphs and "val" in eval_graphs:
+                do_eval = tcfg.eval and eval_graphs and "val" in eval_graphs
+                if do_eval:
                     g, mask = eval_graphs["val"]
                     with timer.timer("eval"):
                         acc = self.evaluate(g, mask)
                     eval_durs.append(timer.durations()["eval"])
-                    msg += f" | Val {acc:.4f}"
+                    if reference_logs:
+                        if inductive:
+                            # reference evaluate_induc format (:33-39)
+                            buf = "Epoch {:05d} | Accuracy {:.2%}".format(
+                                epoch, acc)
+                        else:
+                            # reference evaluate_trans format (:54-60)
+                            tg, tmask = eval_graphs["test"]
+                            t_acc = self.evaluate(tg, tmask)
+                            buf = ("Epoch {:05d} | Validation Accuracy "
+                                   "{:.2%} | Test Accuracy {:.2%}".format(
+                                       epoch, acc, t_acc))
+                        if result_file:
+                            with open(result_file, "a+") as f:
+                                f.write(buf + "\n")
+                        log_fn(buf)
+                    else:
+                        log_fn(f"Epoch {epoch + 1:05d} | Time(s) "
+                               f"{np.mean(durs or [dur]):.4f} | Loss "
+                               f"{loss:.4f} | Val {acc:.4f}")
                     history.append((epoch + 1, loss, acc))
                     if acc > best_val:
                         best_val = acc
@@ -406,7 +526,23 @@ class Trainer:
                         best_norm = jax.device_get(self.state["norm"])
                 else:
                     history.append((epoch + 1, loss, None))
-                log_fn(msg)
+                    if not reference_logs:
+                        log_fn(f"Epoch {epoch + 1:05d} | Time(s) "
+                               f"{np.mean(durs or [dur]):.4f} | Loss "
+                               f"{loss:.4f}")
+
+            if checkpoint_dir and (epoch + 1) % checkpoint_every == 0:
+                save_checkpoint(checkpoint_dir,
+                                jax.device_get(self.state), epoch + 1)
+
+        if profiling:
+            # run ended inside the trace window; finalize the trace
+            jax.profiler.stop_trace()
+            log_fn(f"profiler trace written to {profile_dir}")
+        if profile_dir and not profiling and \
+                n_epochs - start_epoch <= 0:
+            log_fn("warning: run too short, no profiler trace captured")
+
         result = {
             "best_val": best_val,
             "best_epoch": best_epoch,
@@ -414,6 +550,7 @@ class Trainer:
             "best_norm": best_norm,
             "epoch_time": float(np.mean(durs)) if durs else None,
             "eval_time": float(np.mean(eval_durs)) if eval_durs else None,
+            "comm_cost": comm_cost if comm_measured else None,
             "history": history,
         }
         if tcfg.eval and eval_graphs and "test" in eval_graphs and \
