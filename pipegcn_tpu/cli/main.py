@@ -97,18 +97,51 @@ def prepare(args):
                 f"{sg.num_parts} parts, requested {args.n_partitions}"
             )
     else:
-        assert g is not None
-        # inductive mode partitions the train subgraph only
-        # (reference main.py:34-35)
-        pg = train_g if args.inductive else g
-        parts = partition_graph(
-            pg, args.n_partitions, method=args.partition_method,
-            obj=args.partition_obj, seed=args.seed if args.fix_seed else 0,
-        )
-        sg = ShardedGraph.build(pg, parts, n_parts=args.n_partitions)
-        os.makedirs(args.partition_dir, exist_ok=True)
-        sg.save(part_path)
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # multi-host: only process 0 partitions (the reference
+            # partitions on node_rank 0 only, main.py:32-40); peers poll
+            # the shared filesystem for the finished artifact so every
+            # process trains on the SAME partition (the partitioner is
+            # deterministic per host but not across toolchains)
+            sg = _await_partition_artifact(part_path, args.n_partitions)
+        else:
+            assert g is not None
+            # inductive mode partitions the train subgraph only
+            # (reference main.py:34-35)
+            pg = train_g if args.inductive else g
+            parts = partition_graph(
+                pg, args.n_partitions, method=args.partition_method,
+                obj=args.partition_obj,
+                seed=args.seed if args.fix_seed else 0,
+            )
+            sg = ShardedGraph.build(pg, parts, n_parts=args.n_partitions)
+            os.makedirs(args.partition_dir, exist_ok=True)
+            sg.save(part_path)
     return sg, eval_graphs
+
+
+def _await_partition_artifact(part_path: str, n_partitions: int,
+                              timeout_s: float = 3600.0,
+                              poll_s: float = 2.0):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while not ShardedGraph.exists(part_path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"timed out waiting for partition artifact at {part_path} "
+                f"(is the partition dir on a shared filesystem?)"
+            )
+        time.sleep(poll_s)
+    sg = ShardedGraph.load(part_path)
+    if sg.num_parts != n_partitions:
+        raise ValueError(
+            f"partition artifact at {part_path} has {sg.num_parts} parts, "
+            f"requested {n_partitions}"
+        )
+    return sg
 
 
 def run(args) -> dict:

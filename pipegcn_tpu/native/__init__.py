@@ -1,0 +1,158 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+The reference's host-side heavy lifting is native code it links against —
+METIS partitioning (C) and DGL's C++ graph/partition machinery
+(SURVEY.md §2b). This package holds the framework's own native
+equivalents, compiled on demand from the bundled C++ sources with the
+system toolchain (g++), no third-party deps.
+
+Loading policy: the first call to `get_lib()` compiles (if needed) and
+dlopens the shared library. Failures — no compiler, read-only install —
+degrade gracefully: callers check `available()` and fall back to the
+pure-numpy implementations. Set PIPEGCN_NATIVE=0 to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["partitioner.cpp", "halo_builder.cpp"]
+_LIB_NAME = "libpipegcn_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build(lib_path: str) -> bool:
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES
+            if os.path.exists(os.path.join(_DIR, s))]
+    if not srcs:
+        return False
+    # compile to a unique temp name in the destination dir, then rename:
+    # rename is atomic, so concurrent processes never dlopen a half-
+    # written library (the per-process lock can't serialize across
+    # processes)
+    tmp_path = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o", tmp_path,
+           *srcs]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if res.returncode != 0:
+            import sys
+            print(f"pipegcn_tpu.native build failed:\n{res.stderr}",
+                  file=sys.stderr)
+            return False
+        os.replace(tmp_path, lib_path)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+    return True
+
+
+def _lib_path() -> str:
+    # prefer in-package (cached across runs); fall back to a per-user
+    # cache dir if the install is read-only (never a shared temp dir —
+    # a world-writable predictable path would let another local user
+    # plant a library that we would dlopen)
+    cand = os.path.join(_DIR, _LIB_NAME)
+    if os.path.exists(cand) or os.access(_DIR, os.W_OK):
+        return cand
+    cache = os.environ.get("XDG_CACHE_HOME",
+                           os.path.join(os.path.expanduser("~"), ".cache"))
+    d = os.path.join(cache, "pipegcn_tpu")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    return os.path.join(d, _LIB_NAME)
+
+
+def _stale(lib_path: str) -> bool:
+    if not os.path.exists(lib_path):
+        return True
+    lib_mtime = os.path.getmtime(lib_path)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime
+        for s in _SOURCES if os.path.exists(os.path.join(_DIR, s))
+    )
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if os.environ.get("PIPEGCN_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _lib_path()
+        if _stale(path) and not _build(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        _declare(lib)
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    c_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.pgt_partition.restype = ctypes.c_int
+    lib.pgt_partition.argtypes = [
+        ctypes.c_int64, c_i64p, c_i32p,          # n, indptr, indices
+        ctypes.c_int32, ctypes.c_int,            # n_parts, objective
+        ctypes.c_uint64, ctypes.c_double,        # seed, imbalance
+        ctypes.c_int, c_i32p,                    # refine_iters, out
+    ]
+
+
+def native_partition(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    n_parts: int,
+    obj: str = "vol",
+    seed: int = 0,
+    imbalance: float = 1.05,
+    refine_iters: int = 10,
+) -> np.ndarray:
+    """Multilevel k-way partition of a symmetric CSR adjacency.
+
+    Native equivalent of the reference's METIS call (helper/utils.py:143
+    with objtype passthrough). Raises RuntimeError if the native library
+    is unavailable — callers should check available() first.
+    """
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = indptr.shape[0] - 1
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    out = np.empty(n, dtype=np.int32)
+    rc = lib.pgt_partition(
+        n, indptr, indices, np.int32(n_parts),
+        1 if obj == "vol" else 0, np.uint64(seed), float(imbalance),
+        int(refine_iters), out,
+    )
+    if rc != 0:
+        raise RuntimeError(f"pgt_partition failed with code {rc}")
+    return out

@@ -1,0 +1,333 @@
+// Native multilevel k-way graph partitioner.
+//
+// TPU-native replacement for the METIS C library the reference reaches
+// through its customized DGL fork (reference helper/utils.py:132-144,
+// README.md:62 — the fork exists only to pass objtype='vol'|'cut' through
+// to METIS). Same role, same objective surface:
+//
+//   objective = 0 ('cut')  minimize edges crossing partitions
+//   objective = 1 ('vol')  minimize communication volume: distinct
+//                          (node, foreign-partition) halo pairs — the
+//                          quantity PipeGCN-style training exchanges
+//                          every layer.
+//
+// Classic multilevel scheme (Karypis & Kumar style, independent
+// implementation):
+//   1. coarsen by randomized heavy-edge matching, accumulating edge and
+//      node weights, until the graph is small;
+//   2. initial k-way partition on the coarsest graph: BFS-grown
+//      contiguous blocks balanced by node weight;
+//   3. uncoarsen, at every level running boundary FM-style refinement:
+//      greedy positive-gain moves under a node-weight balance cap, with
+//      the gain formula matching the requested objective.
+//
+// Deterministic for a fixed seed. Single-threaded C++17, no deps.
+//
+// C API (ctypes-friendly): pgt_partition() at the bottom.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Csr {
+  int64_t n = 0;
+  std::vector<int64_t> indptr;   // [n+1]
+  std::vector<int32_t> indices;  // [m] neighbor ids
+  std::vector<int64_t> ewgt;     // [m] edge weights
+  std::vector<int64_t> nwgt;     // [n] node weights
+};
+
+// ---------------------------------------------------------------------
+// Coarsening: randomized heavy-edge matching.
+
+// Returns coarse graph + mapping fine node -> coarse node.
+Csr coarsen(const Csr& g, std::mt19937_64& rng, std::vector<int32_t>& map) {
+  const int64_t n = g.n;
+  map.assign(n, -1);
+  std::vector<int32_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::shuffle(order.begin(), order.end(), rng);
+
+  // heavy-edge matching: visit nodes in random order, match each
+  // unmatched node with its unmatched neighbor of max edge weight
+  int32_t nc = 0;
+  std::vector<int32_t> match(n, -1);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t u = order[i];
+    if (match[u] != -1) continue;
+    int32_t best = -1;
+    int64_t best_w = -1;
+    for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+      int32_t v = g.indices[e];
+      if (v == u || match[v] != -1) continue;
+      if (g.ewgt[e] > best_w) { best_w = g.ewgt[e]; best = v; }
+    }
+    match[u] = (best == -1) ? u : best;
+    if (best != -1) match[best] = u;
+    map[u] = nc;
+    if (best != -1) map[best] = nc;
+    ++nc;
+  }
+
+  // build coarse graph: aggregate parallel edges, drop self loops
+  Csr c;
+  c.n = nc;
+  c.nwgt.assign(nc, 0);
+  for (int64_t u = 0; u < n; ++u) c.nwgt[map[u]] += g.nwgt[u];
+
+  // count then fill, merging duplicates with a per-node scratch table
+  std::vector<int64_t> scratch_w(nc, 0);
+  std::vector<int32_t> scratch_nbr;
+  scratch_nbr.reserve(256);
+
+  // two passes over fine edges grouped by coarse node; build fine-node
+  // lists per coarse node first
+  std::vector<int64_t> cstart(nc + 1, 0);
+  for (int64_t u = 0; u < n; ++u) cstart[map[u] + 1]++;
+  for (int32_t i = 0; i < nc; ++i) cstart[i + 1] += cstart[i];
+  std::vector<int32_t> members(n);
+  {
+    std::vector<int64_t> cur(cstart.begin(), cstart.end() - 1);
+    for (int64_t u = 0; u < n; ++u) members[cur[map[u]]++] = (int32_t)u;
+  }
+
+  c.indptr.assign(nc + 1, 0);
+  // pass 1: count distinct coarse neighbors
+  for (int32_t cu = 0; cu < nc; ++cu) {
+    scratch_nbr.clear();
+    for (int64_t mi = cstart[cu]; mi < cstart[cu + 1]; ++mi) {
+      int32_t u = members[mi];
+      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+        int32_t cv = map[g.indices[e]];
+        if (cv == cu) continue;
+        if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
+        scratch_w[cv] += g.ewgt[e];
+      }
+    }
+    c.indptr[cu + 1] = c.indptr[cu] + (int64_t)scratch_nbr.size();
+    for (int32_t cv : scratch_nbr) scratch_w[cv] = 0;
+  }
+  c.indices.resize(c.indptr[nc]);
+  c.ewgt.resize(c.indptr[nc]);
+  // pass 2: fill
+  for (int32_t cu = 0; cu < nc; ++cu) {
+    scratch_nbr.clear();
+    for (int64_t mi = cstart[cu]; mi < cstart[cu + 1]; ++mi) {
+      int32_t u = members[mi];
+      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+        int32_t cv = map[g.indices[e]];
+        if (cv == cu) continue;
+        if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
+        scratch_w[cv] += g.ewgt[e];
+      }
+    }
+    int64_t pos = c.indptr[cu];
+    for (int32_t cv : scratch_nbr) {
+      c.indices[pos] = cv;
+      c.ewgt[pos] = scratch_w[cv];
+      scratch_w[cv] = 0;
+      ++pos;
+    }
+  }
+  return c;
+}
+
+// ---------------------------------------------------------------------
+// Initial partition on the coarsest graph: BFS order, contiguous blocks
+// balanced by node weight.
+
+void initial_partition(const Csr& g, int32_t k, std::mt19937_64& rng,
+                       std::vector<int32_t>& parts) {
+  const int64_t n = g.n;
+  parts.assign(n, 0);
+  std::vector<int32_t> order;
+  order.reserve(n);
+  std::vector<char> visited(n, 0);
+  std::vector<int32_t> restart(n);
+  std::iota(restart.begin(), restart.end(), 0);
+  std::shuffle(restart.begin(), restart.end(), rng);
+  int64_t cursor = 0;
+  std::vector<int32_t> queue;
+  while ((int64_t)order.size() < n) {
+    while (cursor < n && visited[restart[cursor]]) ++cursor;
+    int32_t s = restart[cursor];
+    visited[s] = 1;
+    queue.assign(1, s);
+    size_t qh = 0;
+    order.push_back(s);
+    while (qh < queue.size()) {
+      int32_t u = queue[qh++];
+      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+        int32_t v = g.indices[e];
+        if (!visited[v]) {
+          visited[v] = 1;
+          queue.push_back(v);
+          order.push_back(v);
+        }
+      }
+    }
+  }
+  int64_t total_w = 0;
+  for (int64_t u = 0; u < n; ++u) total_w += g.nwgt[u];
+  // walk the BFS order filling part 0, then 1, ... by weight quota
+  int64_t acc = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t p = (int32_t)std::min<int64_t>((acc * k) / std::max<int64_t>(total_w, 1),
+                                           k - 1);
+    parts[order[i]] = p;
+    acc += g.nwgt[order[i]];
+  }
+}
+
+// ---------------------------------------------------------------------
+// Refinement: FM-style greedy boundary passes.
+//
+// For 'cut', gain(u, p) = w(u->p) - w(u->own).
+// For 'vol', add the change in distinct halo pairs: moving u to p removes
+// the (u, p) pair, creates a (u, own) pair if u keeps neighbors there —
+// approximated (as in the Python refiner) with indicator terms
+// [w(u->p) > 0] - [w(u->own) > 0]; neighbor-side pair changes are second
+// order and ignored.
+
+void refine(const Csr& g, int32_t k, int objective, int iters,
+            double imbalance, std::vector<int32_t>& parts,
+            std::mt19937_64& rng) {
+  const int64_t n = g.n;
+  int64_t total_w = 0;
+  for (int64_t u = 0; u < n; ++u) total_w += g.nwgt[u];
+  const int64_t cap =
+      (int64_t)(imbalance * (double)((total_w + k - 1) / k)) + 1;
+
+  std::vector<int64_t> psize(k, 0);
+  for (int64_t u = 0; u < n; ++u) psize[parts[u]] += g.nwgt[u];
+
+  std::vector<int64_t> conn(k, 0);  // edge weight to each part, per node
+  std::vector<int32_t> touched;
+  touched.reserve(64);
+  std::vector<int32_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+
+  for (int it = 0; it < iters; ++it) {
+    std::shuffle(order.begin(), order.end(), rng);
+    int64_t moved = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t u = order[i];
+      int32_t pu = parts[u];
+      if (psize[pu] - g.nwgt[u] <= 0) continue;  // never drain a part
+      touched.clear();
+      bool boundary = false;
+      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+        int32_t pv = parts[g.indices[e]];
+        if (conn[pv] == 0) touched.push_back(pv);
+        conn[pv] += g.ewgt[e];
+        if (pv != pu) boundary = true;
+      }
+      if (boundary) {
+        int64_t own = conn[pu];
+        int64_t best_gain = 0;
+        int32_t best_p = -1;
+        for (int32_t p : touched) {
+          if (p == pu || psize[p] + g.nwgt[u] > cap) continue;
+          int64_t gain = conn[p] - own;
+          if (objective == 1)
+            gain += (conn[p] > 0 ? 1 : 0) - (own > 0 ? 1 : 0);
+          if (gain > best_gain ||
+              (gain == best_gain && best_p != -1 && psize[p] < psize[best_p])) {
+            best_gain = gain;
+            best_p = p;
+          }
+        }
+        if (best_p != -1 && best_gain > 0) {
+          psize[pu] -= g.nwgt[u];
+          psize[best_p] += g.nwgt[u];
+          parts[u] = best_p;
+          ++moved;
+        }
+      }
+      for (int32_t p : touched) conn[p] = 0;
+    }
+    if (moved == 0) break;
+  }
+}
+
+void ensure_nonempty(const Csr& g, int32_t k, std::vector<int32_t>& parts) {
+  std::vector<int64_t> count(k, 0);
+  for (int64_t u = 0; u < g.n; ++u) count[parts[u]]++;
+  for (int32_t p = 0; p < k; ++p) {
+    if (count[p] > 0) continue;
+    int32_t donor =
+        (int32_t)(std::max_element(count.begin(), count.end()) - count.begin());
+    for (int64_t u = 0; u < g.n; ++u) {
+      if (parts[u] == donor) {
+        parts[u] = p;
+        count[donor]--;
+        count[p]++;
+        break;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Partition a symmetric CSR graph (no self loops required; they are
+// ignored) into n_parts. Writes int32 partition ids to out_parts[n].
+// Returns 0 on success.
+int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
+                  int32_t n_parts, int objective, uint64_t seed,
+                  double imbalance, int refine_iters, int32_t* out_parts) {
+  if (n <= 0 || n_parts <= 0) return 1;
+  if (n_parts == 1) {
+    std::memset(out_parts, 0, sizeof(int32_t) * (size_t)n);
+    return 0;
+  }
+  std::mt19937_64 rng(seed);
+
+  // levels[i] may be relocated by push_back — never hold references into it
+  std::vector<Csr> levels(1);
+  levels[0].n = n;
+  levels[0].indptr.assign(indptr, indptr + n + 1);
+  levels[0].indices.assign(indices, indices + indptr[n]);
+  levels[0].ewgt.assign(indptr[n], 1);
+  levels[0].nwgt.assign(n, 1);
+
+  // coarsen until small or stalled
+  std::vector<std::vector<int32_t>> maps;
+  const int64_t target = std::max<int64_t>((int64_t)n_parts * 32, 2048);
+  while (levels.back().n > target) {
+    std::vector<int32_t> map;
+    Csr c = coarsen(levels.back(), rng, map);
+    if (c.n > (int64_t)(0.95 * (double)levels.back().n)) break;  // stalled
+    maps.push_back(std::move(map));
+    levels.push_back(std::move(c));
+  }
+
+  // initial partition at the coarsest level
+  std::vector<int32_t> parts;
+  initial_partition(levels.back(), n_parts, rng, parts);
+  refine(levels.back(), n_parts, objective, refine_iters, imbalance, parts,
+         rng);
+
+  // uncoarsen with refinement at every level
+  for (int64_t lvl = (int64_t)maps.size() - 1; lvl >= 0; --lvl) {
+    const std::vector<int32_t>& map = maps[lvl];
+    std::vector<int32_t> fine(levels[lvl].n);
+    for (int64_t u = 0; u < levels[lvl].n; ++u) fine[u] = parts[map[u]];
+    parts = std::move(fine);
+    refine(levels[lvl], n_parts, objective, refine_iters, imbalance, parts,
+           rng);
+  }
+
+  ensure_nonempty(levels[0], n_parts, parts);
+  std::memcpy(out_parts, parts.data(), sizeof(int32_t) * (size_t)n);
+  return 0;
+}
+
+}  // extern "C"

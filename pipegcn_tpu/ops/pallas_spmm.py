@@ -150,10 +150,7 @@ class PallasSpmm:
         deg = np.ones(n_pad, np.float32)
         deg[:n_out] = np.asarray(in_deg, np.float32)[:n_out]
         self._deg = jnp.asarray(deg)
-        fbuf_bytes = n_src_rows * n_feat * 4
-        self.applicable = (
-            fbuf_bytes <= VMEM_BUDGET and max_e * 4 <= (2 << 20)
-        )
+        self.applicable = sharded_applicable(n_src_rows, n_feat, max_e)
 
     def __call__(self, fbuf: jax.Array) -> jax.Array:
         return _spmm_pallas_call(

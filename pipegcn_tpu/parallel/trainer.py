@@ -129,13 +129,19 @@ class Trainer:
             raise ValueError(f"unknown spmm_impl: {impl}")
         if impl == "xla":
             return
-        tables, max_e, n_src_rows = build_sharded_tables(self.sg)
+        # cheap VMEM gate first (needs only shapes) — skip the O(E) table
+        # build when 'auto' will reject the shard anyway
+        n_src_rows = self.sg.n_max + self.sg.halo_size
         widths = [
             self._layer_width(i)
             for i in range(1 if self.cfg.use_pp else 0,
                            self.cfg.n_graph_layers)
         ]
-        fits = sharded_applicable(n_src_rows, max(widths, default=1), max_e)
+        w_max = max(widths, default=1)
+        if impl == "auto" and not sharded_applicable(n_src_rows, w_max, 0):
+            return
+        tables, max_e, n_src_rows = build_sharded_tables(self.sg)
+        fits = sharded_applicable(n_src_rows, w_max, max_e)
         if impl == "auto" and not fits:
             return
         if impl == "pallas" and not fits:
@@ -466,9 +472,12 @@ class Trainer:
                 jax.profiler.stop_trace()
                 profiling = False
                 log_fn(f"profiler trace written to {profile_dir}")
-            # epochs <5 excluded from averaged timings (reference
-            # train.py:364)
-            if epoch >= 5 and epoch % tcfg.log_every != 0:
+            # first 5 epochs after (re)start excluded from averaged
+            # timings — they include jit compilation (the reference
+            # excludes epochs <5 and log epochs, train.py:364; here eval
+            # runs outside the timed span so log epochs don't need
+            # excluding)
+            if epoch >= start_epoch + 5:
                 durs.append(dur)
             if measure_comm_cost and not comm_measured and \
                     epoch >= min(start_epoch + 5, n_epochs - 1):

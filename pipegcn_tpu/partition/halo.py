@@ -298,12 +298,15 @@ class ShardedGraph:
             "n_class": self.n_class,
             "multilabel": self.multilabel,
         }
-        with open(os.path.join(path, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=2)
+        # arrays first, manifest last: exists() keys off the manifest, so
+        # a reader polling a shared filesystem (multi-host prepare) never
+        # observes a half-written artifact
         np.savez_compressed(
             os.path.join(path, "arrays.npz"),
             **{k: getattr(self, k) for k in self._ARRAYS},
         )
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
 
     @staticmethod
     def load(path: str) -> "ShardedGraph":

@@ -1,0 +1,92 @@
+"""Native C++ component tests.
+
+The native library is built on demand from bundled sources (g++ is part
+of the supported toolchain); these tests exercise the ctypes surface and
+check the multilevel partitioner beats the quality of random assignment
+and respects the same invariants as the Python fallback.
+"""
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.partition.partitioner import (
+    _sym_adj,
+    comm_volume,
+    edge_cut,
+    partition_graph,
+)
+
+native = pytest.importorskip("pipegcn_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable here"
+)
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return synthetic_graph(
+        num_nodes=3000, avg_degree=10, n_feat=8, n_class=4, seed=1
+    )
+
+
+def _native_parts(g, n_parts, obj="vol", seed=0):
+    adj = _sym_adj(g)
+    return native.native_partition(
+        adj.indptr.astype(np.int64), adj.indices.astype(np.int32),
+        n_parts, obj=obj, seed=seed,
+    )
+
+
+def test_partition_valid_and_balanced(graph):
+    for k in (2, 4, 7):
+        parts = _native_parts(graph, k)
+        assert parts.shape == (graph.num_nodes,)
+        assert parts.min() >= 0 and parts.max() < k
+        sizes = np.bincount(parts, minlength=k)
+        assert sizes.min() > 0
+        # balance cap: 1.05 imbalance plus slack for integer rounding
+        assert sizes.max() <= 1.10 * (graph.num_nodes / k) + 2
+
+
+def test_partition_deterministic(graph):
+    a = _native_parts(graph, 4, seed=7)
+    b = _native_parts(graph, 4, seed=7)
+    assert np.array_equal(a, b)
+
+
+def test_partition_beats_random(graph):
+    random_parts = partition_graph(graph, 4, method="random", seed=0)
+    for obj, metric in (("cut", edge_cut), ("vol", comm_volume)):
+        parts = _native_parts(graph, 4, obj=obj)
+        assert metric(graph, parts) < 0.7 * metric(graph, random_parts)
+
+
+def test_partition_graph_dispatches_to_native(graph, monkeypatch):
+    """method='metis' must route through the native partitioner when it is
+    available and produce identical output to a direct call."""
+    via_api = partition_graph(graph, 4, method="metis", obj="vol", seed=3)
+    direct = _native_parts(graph, 4, obj="vol", seed=3)
+    assert np.array_equal(via_api, direct)
+
+
+def test_python_fallback_when_disabled(graph, monkeypatch):
+    monkeypatch.setenv("PIPEGCN_NATIVE", "0")
+    # get_lib caches; bypass by checking the partition API still works with
+    # the cached lib regardless, then the env var path on a fresh state
+    import importlib
+
+    import pipegcn_tpu.native as nat
+
+    importlib.reload(nat)
+    assert not nat.available()
+    parts = partition_graph(graph, 4, method="metis", obj="vol", seed=0)
+    sizes = np.bincount(parts, minlength=4)
+    assert sizes.min() > 0
+    importlib.reload(nat)  # restore for other tests
+
+
+def test_single_partition(graph):
+    parts = _native_parts(graph, 1)
+    assert np.array_equal(parts, np.zeros(graph.num_nodes, np.int32))
