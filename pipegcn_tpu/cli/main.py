@@ -189,6 +189,7 @@ def run(args) -> dict:
         train_size=n_train,
         spmm_chunk=args.spmm_chunk or None,
         spmm_impl=args.spmm_impl,
+        dtype=args.dtype,
     )
     tcfg = TrainConfig(
         lr=args.lr,

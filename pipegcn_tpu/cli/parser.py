@@ -110,6 +110,10 @@ def create_parser() -> argparse.ArgumentParser:
                         help="aggregation kernel: XLA gather+segment-sum, "
                              "the Pallas VMEM-resident CSR kernel, or "
                              "auto-select by shard size")
+    parser.add_argument("--dtype", choices=["float32", "bfloat16"],
+                        default="float32",
+                        help="compute dtype for activations/halo exchange "
+                             "(params, optimizer and statistics stay f32)")
     parser.add_argument("--checkpoint-dir", "--checkpoint_dir", type=str,
                         default="",
                         help="enable periodic checkpointing to this dir")

@@ -50,6 +50,7 @@ class ModelConfig:
     spmm_chunk: Optional[int] = None
     sorted_edges: bool = False     # edge_dst ascending (CSR order)
     spmm_impl: str = "xla"         # 'xla' | 'pallas' | 'auto'
+    dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     @property
     def n_layers(self) -> int:
@@ -58,6 +59,20 @@ class ModelConfig:
     @property
     def n_graph_layers(self) -> int:
         return self.n_layers - self.n_linear
+
+    @property
+    def compute_dtype(self):
+        """Mixed precision, TPU style: activations, halo transport and
+        SpMM messages flow in bfloat16 (halving HBM gather traffic and
+        ICI volume; MXU-native matmuls); parameters, optimizer state,
+        normalization statistics, SpMM accumulation and the loss stay
+        float32. The reference has no analogue (torch fp32 throughout);
+        dtype='float32' reproduces that exactly."""
+        if self.dtype == "bfloat16":
+            return jnp.bfloat16
+        if self.dtype == "float32":
+            return jnp.float32
+        raise ValueError(f"unknown dtype: {self.dtype}")
 
 
 def _uniform(rng, shape, bound):
@@ -123,9 +138,12 @@ def init_norm_state(cfg: ModelConfig) -> List[dict]:
 
 
 def _layer_norm(h, scale, bias, eps=1e-5):
-    mu = h.mean(axis=-1, keepdims=True)
-    var = ((h - mu) ** 2).mean(axis=-1, keepdims=True)
-    return (h - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+    # statistics in f32 even when activations flow in bf16
+    hf = h.astype(jnp.float32)
+    mu = hf.mean(axis=-1, keepdims=True)
+    var = ((hf - mu) ** 2).mean(axis=-1, keepdims=True)
+    out = (hf - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+    return out.astype(h.dtype)
 
 
 def _sync_batch_norm_train(h, scale, bias, state, whole_size, psum,
@@ -143,6 +161,8 @@ def _sync_batch_norm_train(h, scale, bias, state, whole_size, psum,
     the mathematically correct gradient (no double reduction).
 
     Returns (out, new_state)."""
+    orig_dtype = h.dtype
+    h = h.astype(jnp.float32)
     hm = h if row_mask is None else h * row_mask[:, None]
     sum_x = psum(hm.sum(axis=0))
     sum_x2 = psum((hm * hm).sum(axis=0))
@@ -153,12 +173,13 @@ def _sync_batch_norm_train(h, scale, bias, state, whole_size, psum,
         "var": state["var"] * (1 - momentum) + var * momentum,
     }
     x_hat = (h - mean) * jax.lax.rsqrt(var + eps)
-    return x_hat * scale + bias, new_state
+    return (x_hat * scale + bias).astype(orig_dtype), new_state
 
 
 def _sync_batch_norm_eval(h, scale, bias, state, eps=1e-5):
-    x_hat = (h - state["mean"]) * jax.lax.rsqrt(state["var"] + eps)
-    return x_hat * scale + bias
+    hf = h.astype(jnp.float32)
+    x_hat = (hf - state["mean"]) * jax.lax.rsqrt(state["var"] + eps)
+    return (x_hat * scale + bias).astype(h.dtype)
 
 
 def _dropout(rng, h, rate):
@@ -203,9 +224,25 @@ def forward(
     norm_state = norm_state if norm_state is not None else []
     new_norm_state: List[dict] = []
     use_norm = cfg.norm is not None
+    cdt = cfg.compute_dtype
+    h = h.astype(cdt)
+
+    def dense(x, w, b, out_dtype):
+        # params live in f32; cast to the compute dtype at use so the
+        # matmul runs on the MXU in bf16 (the cast's transpose returns
+        # f32 parameter cotangents automatically). out_dtype=f32 (the
+        # logits layer) accumulates AND emits f32 from the bf16 matmul
+        # via preferred_element_type, then adds the f32 bias — the
+        # product is never rounded to bf16.
+        y = jnp.matmul(x, w.astype(x.dtype),
+                       preferred_element_type=out_dtype)
+        return y + b.astype(out_dtype)
 
     for i in range(cfg.n_layers):
         is_graph = i < cfg.n_graph_layers
+        # the network's last matmul produces logits in f32 for a stable
+        # loss; hidden layers stay in the compute dtype
+        out_dt = jnp.float32 if i == cfg.n_layers - 1 else cdt
         if training and cfg.dropout > 0:
             rng, sub = jax.random.split(rng)
         if is_graph:
@@ -216,7 +253,7 @@ def forward(
                     h = _dropout(sub, h, cfg.dropout)
                 lp = params["layers"][i]
                 if cfg.use_pp and i == 0:
-                    h = h @ lp["w"] + lp["b"]
+                    h = dense(h, lp["w"], lp["b"], out_dt)
                 else:
                     # spmm_fn (e.g. the Pallas VMEM-resident kernel)
                     # returns the mean directly when injected
@@ -226,8 +263,8 @@ def forward(
                         ah = spmm_mean(h, edge_src, edge_dst, in_deg,
                                        n_dst, cfg.spmm_chunk,
                                        cfg.sorted_edges)
-                    h = (h[:n_dst] @ lp["w1"] + lp["b1"]
-                         + ah @ lp["w2"] + lp["b2"])
+                    h = (dense(h[:n_dst], lp["w1"], lp["b1"], out_dt)
+                         + dense(ah.astype(cdt), lp["w2"], lp["b2"], out_dt))
             else:
                 lp = params["layers"][i]
                 ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,
@@ -237,14 +274,16 @@ def forward(
                         raise ValueError(
                             "use_pp model evaluated without eval_pp_agg"
                         )
-                    h = jnp.concatenate([h, ah], axis=1) @ lp["w"] + lp["b"]
+                    h = dense(jnp.concatenate([h, ah.astype(cdt)], axis=1),
+                              lp["w"], lp["b"], out_dt)
                 else:
-                    h = h @ lp["w1"] + lp["b1"] + ah @ lp["w2"] + lp["b2"]
+                    h = (dense(h, lp["w1"], lp["b1"], out_dt)
+                         + dense(ah.astype(cdt), lp["w2"], lp["b2"], out_dt))
         else:
             if training and cfg.dropout > 0:
                 h = _dropout(sub, h, cfg.dropout)
             lp = params["layers"][i]
-            h = h @ lp["w"] + lp["b"]
+            h = dense(h, lp["w"], lp["b"], out_dt)
 
         if i < cfg.n_layers - 1:
             if use_norm:

@@ -228,13 +228,16 @@ def make_device_spmm_fn(d: dict, n_max: int, n_src_rows: int, max_e: int,
         )
 
     def fwd(fbuf):
-        return f(fbuf), None
+        # zero-size proto carries fbuf's dtype (residuals must be JAX types)
+        return f(fbuf), jnp.zeros((0,), fbuf.dtype)
 
-    def bwd(_, g):
+    def bwd(proto, g):
         gd = g / deg_col
+        # transpose aggregation accumulates in f32 (spmm_sum converts);
+        # cast the cotangent back to the activation dtype once
         d_fbuf = spmm_sum(gd, d["spmm_t_gather"], d["spmm_t_scatter"],
                           n_src_rows, chunk, sorted_edges=True)
-        return (d_fbuf,)
+        return (d_fbuf.astype(proto.dtype),)
 
     f.defvjp(fwd, bwd)
     return f

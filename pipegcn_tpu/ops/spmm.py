@@ -23,10 +23,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _segment_sum_once(fbuf, edge_src, edge_dst, n_out, sorted_edges):
-    msgs = jnp.take(fbuf, edge_src, axis=0)
+    # gather in fbuf's dtype (bf16 halves the random-row HBM traffic),
+    # accumulate in f32 (bf16 sums over ~500-degree rows lose ~9 bits)
+    msgs = jnp.take(fbuf, edge_src, axis=0).astype(jnp.float32)
     return jax.ops.segment_sum(
         msgs, edge_dst, num_segments=n_out + 1,
         indices_are_sorted=sorted_edges,
@@ -63,7 +66,7 @@ def spmm_sum(
     main_dst = edge_dst[: n_full * chunk].reshape(n_full, chunk)
 
     def _chunk_sum(s, d):
-        msgs = jnp.take(fbuf, s, axis=0)
+        msgs = jnp.take(fbuf, s, axis=0).astype(jnp.float32)
         return jax.ops.segment_sum(
             msgs, d, num_segments=n_out + 1,
             indices_are_sorted=sorted_edges,
@@ -78,7 +81,9 @@ def spmm_sum(
     acc, _ = jax.lax.scan(body, acc0, (main_src[1:], main_dst[1:]))
     rem = e - n_full * chunk
     if rem:
-        msgs = jnp.take(fbuf, edge_src[n_full * chunk :], axis=0)
+        msgs = jnp.take(
+            fbuf, edge_src[n_full * chunk :], axis=0
+        ).astype(jnp.float32)
         acc = acc + jax.ops.segment_sum(
             msgs, edge_dst[n_full * chunk :], num_segments=n_out + 1,
             indices_are_sorted=sorted_edges,
@@ -95,11 +100,57 @@ def spmm_mean(
     chunk: Optional[int] = None,
     sorted_edges: bool = False,
 ) -> jax.Array:
-    """Mean aggregation: sum divided by precomputed in-degrees.
+    """Mean aggregation: sum divided by precomputed in-degrees; always
+    returns f32 (accumulation dtype) regardless of fbuf's dtype.
 
     The divisor is the in-degree of the *full* training graph, not the
     local shard (reference semantics: helper/utils.py:142 degrees are
     stored before partitioning and used at module/layer.py:47-50).
+
+    For bf16 fbuf a custom VJP keeps the backward scatter-accumulation
+    in f32 (autodiff through the cast would otherwise accumulate halo
+    gradients in bf16, losing ~log2(degree) bits), casting the final
+    d_fbuf back to bf16 once.
     """
+    if fbuf.dtype == jnp.float32:
+        s = spmm_sum(fbuf, edge_src, edge_dst, n_out, chunk, sorted_edges)
+        return s / in_deg[:, None]
+    return _spmm_mean_lowp(fbuf, edge_src, edge_dst, in_deg, n_out, chunk,
+                           sorted_edges)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _spmm_mean_lowp(fbuf, edge_src, edge_dst, in_deg, n_out, chunk,
+                    sorted_edges):
     s = spmm_sum(fbuf, edge_src, edge_dst, n_out, chunk, sorted_edges)
     return s / in_deg[:, None]
+
+
+def _spmm_mean_lowp_fwd(fbuf, edge_src, edge_dst, in_deg, n_out, chunk,
+                        sorted_edges):
+    out = _spmm_mean_lowp(fbuf, edge_src, edge_dst, in_deg, n_out, chunk,
+                          sorted_edges)
+    # zero-size proto carries fbuf's (static) row count and dtype through
+    # the residuals, which must be JAX types
+    proto = jnp.zeros((fbuf.shape[0], 0), fbuf.dtype)
+    return out, (edge_src, edge_dst, in_deg, proto)
+
+
+def _spmm_mean_lowp_bwd(n_out, chunk, sorted_edges, res, g):
+    edge_src, edge_dst, in_deg, proto = res
+    n_rows, dt = proto.shape[0], proto.dtype
+    gd = g.astype(jnp.float32) / in_deg[:, None]
+    # pad one sentinel row so pad edges (dst == n_out) read zeros; the
+    # transpose aggregation is spmm_sum with edge roles swapped (f32
+    # accumulation; pad edges then scatter harmless zeros into row 0,
+    # their src under the module convention)
+    gd = jnp.concatenate([gd, jnp.zeros((1, gd.shape[-1]), jnp.float32)])
+    d_fbuf = spmm_sum(gd, edge_dst, edge_src, n_rows, chunk,
+                      sorted_edges=False)
+    ft0 = jax.dtypes.float0
+    zint = lambda a: np.zeros(a.shape, ft0)
+    return (d_fbuf.astype(dt), zint(edge_src), zint(edge_dst),
+            jnp.zeros_like(in_deg))
+
+
+_spmm_mean_lowp.defvjp(_spmm_mean_lowp_fwd, _spmm_mean_lowp_bwd)

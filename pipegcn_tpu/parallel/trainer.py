@@ -91,6 +91,11 @@ class Trainer:
         self.data = self._put_data()
         if cfg.use_pp:
             self.data["feat"] = self._precompute_pp()
+        if cfg.compute_dtype != jnp.float32:
+            # store input features in the compute dtype so the per-epoch
+            # HBM read (and layer-0 halo exchange) is half-width; the pp
+            # precompute above still ran in f32
+            self.data["feat"] = self.data["feat"].astype(cfg.compute_dtype)
 
         rng = jax.random.PRNGKey(tcfg.seed)
         params = init_params(rng, cfg)
@@ -196,10 +201,14 @@ class Trainer:
         return self.cfg.layer_sizes[i]
 
     def _init_comm(self):
-        """Per-device stacked [P, ...] zero buffers for pipelined mode."""
+        """Per-device stacked [P, ...] zero buffers for pipelined mode.
+        Transport buffers (halo/bgrad) use the compute dtype; the EMA
+        correction accumulators (favg/bavg) stay f32 so repeated small
+        (1-momentum)-sized updates don't vanish in bf16."""
         if not self.tcfg.enable_pipeline:
             return {}
         H = self.sg.halo_size
+        cdt = self.cfg.compute_dtype
         comm = {"halo": {}, "bgrad": {}}
         if self.tcfg.feat_corr:
             comm["favg"] = {}
@@ -207,13 +216,13 @@ class Trainer:
             comm["bavg"] = {}
         for i in self._graph_layer_range():
             f = self._layer_width(i)
-            z = np.zeros((self.P, H, f), np.float32)
+            z = jnp.zeros((self.P, H, f), cdt)
             comm["halo"][str(i)] = z
-            comm["bgrad"][str(i)] = z.copy()
+            comm["bgrad"][str(i)] = z
             if self.tcfg.feat_corr:
-                comm["favg"][str(i)] = z.copy()
+                comm["favg"][str(i)] = jnp.zeros((self.P, H, f), jnp.float32)
             if self.tcfg.grad_corr:
-                comm["bavg"][str(i)] = z.copy()
+                comm["bavg"][str(i)] = jnp.zeros((self.P, H, f), jnp.float32)
         return comm
 
     # ---------------- pp precompute -----------------------------------
@@ -272,12 +281,13 @@ class Trainer:
 
             fresh_halo: Dict[str, jax.Array] = {}
 
+            cdt = cfg.compute_dtype
             if pipeline:
                 # probes must be marked device-varying: their cotangents
                 # (the per-device halo grads) vary over the mesh axis
                 probes = {
                     str(i): jax.lax.pcast(
-                        jnp.zeros((H, self._layer_width(i)), jnp.float32),
+                        jnp.zeros((H, self._layer_width(i)), cdt),
                         PARTS_AXIS, to="varying",
                     )
                     for i in glayers
@@ -286,10 +296,12 @@ class Trainer:
                 def comm_update(i, h):
                     k = str(i)
                     stale_halo = (
-                        comm["favg"][k] if tcfg.feat_corr else comm["halo"][k]
+                        comm["favg"][k].astype(cdt) if tcfg.feat_corr
+                        else comm["halo"][k]
                     )
                     stale_bgrad = (
-                        comm["bavg"][k] if tcfg.grad_corr else comm["bgrad"][k]
+                        comm["bavg"][k].astype(cdt) if tcfg.grad_corr
+                        else comm["bgrad"][k]
                     )
                     op = make_stale_concat(d["send_idx"], d["send_mask"], n_max)
                     fbuf = op(h, stale_halo, stale_bgrad, probes_in[k])
@@ -582,12 +594,16 @@ class Trainer:
         P = self.P
         spec = PartitionSpec(PARTS_AXIS)
 
+        cdt = self.cfg.compute_dtype
+
         def comm_fn(feat, send_idx, send_mask):
             feat, send_idx, send_mask = feat[0], send_idx[0], send_mask[0]
             outs = []
             for i in self._graph_layer_range():
                 w = self._layer_width(i)
-                h = feat[:, :1] * jnp.ones((1, w), jnp.float32)
+                # probe in the compute dtype so the timed exchange moves
+                # the same bytes the train step's halo transport does
+                h = feat[:, :1].astype(cdt) * jnp.ones((1, w), cdt)
                 blocks = exchange_blocks(h, send_idx, send_mask,
                                          PARTS_AXIS, P)
                 outs.append(blocks.sum())

@@ -32,10 +32,23 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+_BF16 = np.dtype(jax.numpy.bfloat16.dtype)
+# np.savez round-trips ml_dtypes.bfloat16 as raw void ('|V2'); store such
+# leaves as a uint16 view under a tagged key instead
+_BF16_TAG = "__bf16__/"
+
+
 def save_pytree(path: str, tree: Any) -> None:
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    arrays = {_path_str(p): np.asarray(v) for p, v in leaves}
+    arrays = {}
+    for p, v in leaves:
+        arr = np.asarray(v)
+        key = _path_str(p)
+        if arr.dtype == _BF16:
+            arrays[_BF16_TAG + key] = arr.view(np.uint16)
+        else:
+            arrays[key] = arr
     np.savez_compressed(path, **arrays)
 
 
@@ -46,14 +59,23 @@ def load_pytree(path: str, template: Any) -> Any:
     leaves = []
     for p, tmpl in paths:
         key = _path_str(p)
-        if key not in data:
+        if _BF16_TAG + key in data:
+            arr = data[_BF16_TAG + key].view(_BF16)
+        elif key in data:
+            arr = data[key]
+        else:
             raise KeyError(f"checkpoint {path} missing leaf {key}")
-        arr = data[key]
         if tuple(arr.shape) != tuple(np.shape(tmpl)):
             raise ValueError(
                 f"checkpoint leaf {key}: shape {arr.shape} != template "
                 f"{np.shape(tmpl)}"
             )
+        tdt = np.asarray(tmpl).dtype
+        if arr.dtype != tdt:
+            # e.g. resuming an f32-run checkpoint under --dtype bfloat16:
+            # convert to the template's dtype so the restored state matches
+            # the step's compiled avals
+            arr = arr.astype(tdt)
         leaves.append(arr)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 

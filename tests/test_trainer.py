@@ -14,14 +14,14 @@ from pipegcn_tpu.partition import ShardedGraph, partition_graph
 
 
 def _setup(g, n_parts, *, dropout=0.0, norm="layer", use_pp=False,
-           n_linear=0, hidden=16, n_layers=2, **tkw):
+           n_linear=0, hidden=16, n_layers=2, dtype="float32", **tkw):
     parts = partition_graph(g, n_parts, seed=0)
     sg = ShardedGraph.build(g, parts, n_parts=n_parts)
     n_class = sg.n_class
     sizes = (sg.n_feat,) + (hidden,) * (n_layers - 1) + (n_class,)
     cfg = ModelConfig(
         layer_sizes=sizes, n_linear=n_linear, use_pp=use_pp, norm=norm,
-        dropout=dropout, train_size=sg.n_train_global,
+        dropout=dropout, train_size=sg.n_train_global, dtype=dtype,
     )
     tcfg = TrainConfig(**tkw)
     return Trainer(sg, cfg, tcfg)
@@ -158,3 +158,39 @@ def test_sync_batch_norm_distributed_matches_single(graph):
         l1 = t1.train_epoch(e)
         l4 = t4.train_epoch(e)
         np.testing.assert_allclose(l1, l4, rtol=2e-3)
+
+
+def test_bf16_mixed_precision_tracks_f32(graph):
+    """bf16 compute path: losses track the f32 run closely for the first
+    epochs and training converges; pipelined comm carry is bf16."""
+    tf32 = _setup(graph, 4, seed=3, enable_pipeline=True)
+    tb16 = _setup(graph, 4, seed=3, dtype="bfloat16", enable_pipeline=True)
+    comm = jax.device_get(tb16.state["comm"])
+    assert all(
+        v.dtype == jax.numpy.bfloat16.dtype
+        for grp in comm.values() for v in grp.values()
+    )
+    for epoch in range(8):
+        l32 = tf32.train_epoch(epoch)
+        l16 = tb16.train_epoch(epoch)
+        assert np.isfinite(l16)
+        np.testing.assert_allclose(l16, l32, rtol=0.05, atol=0.02)
+    # keeps converging
+    for epoch in range(8, 40):
+        last = tb16.train_epoch(epoch)
+    assert last < l16
+
+
+def test_bf16_with_corrections_and_pp(graph):
+    t = _setup(graph, 4, seed=5, dtype="bfloat16", use_pp=True,
+               dropout=0.2, enable_pipeline=True, feat_corr=True,
+               grad_corr=True)
+    comm = jax.device_get(t.state["comm"])
+    # EMA accumulators stay f32, transport is bf16
+    assert all(v.dtype == np.float32 for v in comm["favg"].values())
+    assert all(
+        v.dtype == jax.numpy.bfloat16.dtype for v in comm["halo"].values()
+    )
+    losses = [t.train_epoch(e) for e in range(25)]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[2:7])

@@ -52,3 +52,22 @@ def test_measure_comm():
     cost = t.measure_comm(repeats=2)
     assert cost["comm"] > 0 and cost["reduce"] > 0
     assert cost["comm"] < 5 and cost["reduce"] < 5
+
+
+def test_checkpoint_bf16_roundtrip(tmp_path):
+    """bf16 leaves survive npz save/load (stored as tagged uint16 views;
+    np.savez would otherwise return raw void '|V2')."""
+    import jax.numpy as jnp
+    from pipegcn_tpu.utils.checkpoint import load_pytree, save_pytree
+
+    tree = {
+        "a": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3) / 3,
+        "b": {"c": np.ones((4,), np.float32)},
+    }
+    path = str(tmp_path / "ck.npz")
+    save_pytree(path, tree)
+    out = load_pytree(path, tree)
+    assert out["a"].dtype == jnp.bfloat16.dtype
+    np.testing.assert_array_equal(np.asarray(out["a"], np.float32),
+                                  np.asarray(tree["a"], np.float32))
+    np.testing.assert_array_equal(out["b"]["c"], tree["b"]["c"])
