@@ -36,6 +36,12 @@ def main():
     ap.add_argument("--epochs", type=int, default=12)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--no-pipeline", action="store_true")
+    ap.add_argument("--f32", action="store_true",
+                    help="float32 compute (default bfloat16, the "
+                         "TPU-native choice)")
+    ap.add_argument("--fused", type=int, default=4,
+                    help="epochs per dispatch (lax.scan); per-epoch time "
+                         "= block time / fused")
     args = ap.parse_args()
 
     import jax
@@ -76,31 +82,46 @@ def main():
         layer_sizes=(sg.n_feat,) + (hidden,) * (n_layers - 1) + (sg.n_class,),
         use_pp=True, norm="layer", dropout=0.5,
         train_size=sg.n_train_global, spmm_chunk=spmm_chunk,
+        dtype="float32" if args.f32 else "bfloat16",
     )
     tcfg = TrainConfig(
         lr=0.01, n_epochs=args.epochs,
         enable_pipeline=not args.no_pipeline, seed=0, eval=False,
+        fused_epochs=args.fused,
     )
     t0 = time.perf_counter()
     trainer = Trainer(sg, cfg, tcfg)
     print(f"# trainer setup ({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
 
-    # warmup (compile + pipeline fill)
+    blk = max(1, args.fused)
+
+    def run_block(e0):
+        if blk == 1:
+            loss = trainer.train_epoch(e0)
+        else:
+            loss = float(trainer.train_epochs(e0, blk)[-1])
+        jax.block_until_ready(trainer.state["params"])
+        return loss
+
+    # warmup (compile + pipeline fill); epoch counts round UP to whole
+    # blocks so every timed block reuses the same compiled scan length
     t0 = time.perf_counter()
-    for e in range(args.warmup):
-        trainer.train_epoch(e)
-    jax.block_until_ready(trainer.state["params"])
+    e = 0
+    for _ in range(-(-args.warmup // blk) if args.warmup else 0):
+        run_block(e)
+        e += blk
     print(f"# warmup/compile ({time.perf_counter()-t0:.1f}s)",
           file=sys.stderr)
 
     times = []
-    for e in range(args.warmup, args.warmup + args.epochs):
+    n_blocks = -(-args.epochs // blk)
+    for _ in range(n_blocks):
         t0 = time.perf_counter()
-        loss = trainer.train_epoch(e)
-        jax.block_until_ready(trainer.state["params"])
-        times.append(time.perf_counter() - t0)
+        loss = run_block(e)
+        e += blk
+        times.append((time.perf_counter() - t0) / blk)
     epoch_s = float(np.median(times))
-    print(f"# median epoch {epoch_s:.4f}s over {len(times)} epochs, "
+    print(f"# median epoch {epoch_s:.4f}s over {n_blocks} blocks of {blk}, "
           f"final loss {loss:.4f}", file=sys.stderr)
 
     metric = "reddit_scale_epoch_time" if not args.small else \

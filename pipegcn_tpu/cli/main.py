@@ -202,6 +202,7 @@ def run(args) -> dict:
         log_every=args.log_every,
         seed=args.seed,
         eval=args.eval,
+        fused_epochs=args.fused_epochs,
     )
     trainer = Trainer(sg, cfg, tcfg)
 

@@ -110,6 +110,10 @@ def create_parser() -> argparse.ArgumentParser:
                         help="aggregation kernel: XLA gather+segment-sum, "
                              "the Pallas VMEM-resident CSR kernel, or "
                              "auto-select by shard size")
+    parser.add_argument("--fused-epochs", "--fused_epochs", type=int,
+                        default=1,
+                        help="epochs per compiled dispatch (lax.scan); "
+                             "amortizes host round-trips")
     parser.add_argument("--dtype", choices=["float32", "bfloat16"],
                         default="float32",
                         help="compute dtype for activations/halo exchange "

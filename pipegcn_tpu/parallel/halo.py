@@ -28,6 +28,17 @@ import jax
 import jax.numpy as jnp
 
 
+def _ensure_varying(x: jax.Array, axis_name: str) -> jax.Array:
+    """Mark x device-varying over axis_name unless it already is (pcast
+    rejects varying->varying)."""
+    try:
+        if axis_name in jax.typeof(x).vma:
+            return x
+    except (AttributeError, TypeError):
+        pass
+    return jax.lax.pcast(x, axis_name, to="varying")
+
+
 def _fwd_perm(num_parts: int, d: int):
     return [(r, (r + d) % num_parts) for r in range(num_parts)]
 
@@ -54,7 +65,12 @@ def exchange_blocks(
         blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
         blocks.append(jax.lax.ppermute(blk, axis_name, _fwd_perm(num_parts, d)))
     if not blocks:
-        return jnp.zeros((0, h.shape[-1]), h.dtype)
+        # P=1: no halo, but the empty result must still be marked
+        # device-varying so it types consistently as carry state (e.g.
+        # in the fused-epoch scan)
+        return _ensure_varying(
+            jnp.zeros((0, h.shape[-1]), h.dtype), axis_name
+        )
     return jnp.concatenate(blocks, axis=0)
 
 
@@ -95,7 +111,8 @@ def return_blocks(
         )
         outs.append(jax.lax.ppermute(blk, axis_name, _bwd_perm(num_parts, d)))
     if not outs:
-        return jnp.zeros_like(halo_grad)
+        # P=1 empty case: keep the varying type (see exchange_blocks)
+        return _ensure_varying(jnp.zeros_like(halo_grad), axis_name)
     return jnp.concatenate(outs, axis=0)
 
 

@@ -65,6 +65,9 @@ class TrainConfig:
     log_every: int = 10
     seed: int = 0
     eval: bool = True
+    # run up to this many epochs per dispatch (lax.scan inside the jitted
+    # step); 1 = one program per epoch (reference-like granularity)
+    fused_epochs: int = 1
 
 
 class Trainer:
@@ -216,13 +219,14 @@ class Trainer:
             comm["bavg"] = {}
         for i in self._graph_layer_range():
             f = self._layer_width(i)
-            z = jnp.zeros((self.P, H, f), cdt)
-            comm["halo"][str(i)] = z
-            comm["bgrad"][str(i)] = z
+            # distinct host arrays per slot: aliased device buffers would
+            # be donated twice in one Execute() and rejected
+            comm["halo"][str(i)] = np.zeros((self.P, H, f), cdt)
+            comm["bgrad"][str(i)] = np.zeros((self.P, H, f), cdt)
             if self.tcfg.feat_corr:
-                comm["favg"][str(i)] = jnp.zeros((self.P, H, f), jnp.float32)
+                comm["favg"][str(i)] = np.zeros((self.P, H, f), np.float32)
             if self.tcfg.grad_corr:
-                comm["bavg"][str(i)] = jnp.zeros((self.P, H, f), jnp.float32)
+                comm["bavg"][str(i)] = np.zeros((self.P, H, f), np.float32)
         return comm
 
     # ---------------- pp precompute -----------------------------------
@@ -422,16 +426,51 @@ class Trainer:
             out_specs=(state_spec, PartitionSpec()),
             check_vma=check_vma,
         )
+
+        def multi(state, data, rngs):
+            # k epochs in one compiled program: one dispatch, and XLA can
+            # schedule epoch e+1's independent work (e.g. next halo
+            # exchange) behind epoch e's tail
+            def body(st, rng):
+                return step(st, data, rng)
+
+            return jax.lax.scan(body, state, rngs)
+
+        smapped_multi = jax.shard_map(
+            multi,
+            mesh=self.mesh,
+            in_specs=(state_spec, data_spec, PartitionSpec()),
+            out_specs=(state_spec, PartitionSpec()),
+            check_vma=check_vma,
+        )
+        self._multi_step = jax.jit(smapped_multi, donate_argnums=(0,))
         return jax.jit(smapped, donate_argnums=(0,))
 
     # ---------------- public API --------------------------------------
 
+    def _epoch_rng_base(self) -> jax.Array:
+        # single source of the per-run base key: train_epoch and
+        # train_epochs MUST fold epochs from the same base so fused and
+        # unfused runs are bit-identical
+        return jax.random.PRNGKey(self.tcfg.seed + 17)
+
     def train_epoch(self, epoch: int) -> float:
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(self.tcfg.seed + 17), epoch
-        )
+        rng = jax.random.fold_in(self._epoch_rng_base(), epoch)
         self.state, loss = self._step(self.state, self.data, rng)
         return float(loss)
+
+    def train_epochs(self, start_epoch: int, k: int) -> np.ndarray:
+        """Run epochs [start_epoch, start_epoch + k) as ONE compiled
+        program (lax.scan over the step). Identical numerics to k
+        train_epoch calls — same per-epoch rng fold — but a single
+        dispatch, so host round-trip cost is amortized k-fold and XLA
+        may overlap across epoch boundaries. Returns the k losses."""
+        base = self._epoch_rng_base()
+        rngs = jax.vmap(lambda e: jax.random.fold_in(base, e))(
+            jnp.arange(start_epoch, start_epoch + k)
+        )
+        self.state, losses = self._multi_step(self.state, self.data, rngs)
+        return np.asarray(losses)
 
     def fit(
         self,
@@ -470,16 +509,36 @@ class Trainer:
         profiling = False
         n_epochs = tcfg.n_epochs
 
-        for epoch in range(start_epoch, n_epochs):
+        fused = max(1, int(getattr(tcfg, "fused_epochs", 1)))
+        # per-epoch work (logs/eval/checkpoint/profiler) happens at these
+        # period boundaries; fused blocks must not cross one
+        periods = [tcfg.log_every]
+        if reference_logs:
+            periods.append(10)
+        if checkpoint_dir:
+            periods.append(checkpoint_every)
+
+        epoch = start_epoch
+        seen_chunks = set()  # scan lengths already compiled
+        while epoch < n_epochs:
             if profile_dir and not profiling and \
-                    epoch == min(start_epoch + 6, n_epochs - 1):
+                    epoch >= min(start_epoch + 6, n_epochs - 1):
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
+            chunk = min(fused, n_epochs - epoch)
+            for m in periods:
+                to_boundary = m - epoch % m
+                chunk = min(chunk, to_boundary)
+            if profiling or (profile_dir and epoch < start_epoch + 10):
+                chunk = 1  # epoch-granular around the profiled window
             timer.clear()
             with timer.timer("step"):
-                loss = self.train_epoch(epoch)
+                if chunk == 1:
+                    loss = self.train_epoch(epoch)
+                else:
+                    loss = float(self.train_epochs(epoch, chunk)[-1])
                 jax.block_until_ready(self.state["params"])
-            dur = timer.durations()["step"]
+            dur = timer.durations()["step"] / chunk
             if profiling and epoch >= start_epoch + 8:
                 jax.profiler.stop_trace()
                 profiling = False
@@ -488,9 +547,14 @@ class Trainer:
             # timings — they include jit compilation (the reference
             # excludes epochs <5 and log epochs, train.py:364; here eval
             # runs outside the timed span so log epochs don't need
-            # excluding)
-            if epoch >= start_epoch + 5:
-                durs.append(dur)
+            # excluding). A chunk length seen for the first time also
+            # compiles (one scan program per distinct length) — exclude
+            # that block from the averages too.
+            first_of_len = chunk not in seen_chunks
+            seen_chunks.add(chunk)
+            if epoch >= start_epoch + 5 and not first_of_len:
+                durs.extend([dur] * chunk)
+            epoch += chunk - 1  # body below sees the block's last epoch
             if measure_comm_cost and not comm_measured and \
                     epoch >= min(start_epoch + 5, n_epochs - 1):
                 # standalone collective cost, measured once post-compile
@@ -555,6 +619,7 @@ class Trainer:
             if checkpoint_dir and (epoch + 1) % checkpoint_every == 0:
                 save_checkpoint(checkpoint_dir,
                                 jax.device_get(self.state), epoch + 1)
+            epoch += 1
 
         if profiling:
             # run ended inside the trace window; finalize the trace
@@ -569,7 +634,11 @@ class Trainer:
             "best_epoch": best_epoch,
             "best_params": best_params,
             "best_norm": best_norm,
-            "epoch_time": float(np.mean(durs)) if durs else None,
+            # short runs can have every block excluded (warmup /
+            # first-of-scan-length); fall back to the last block's
+            # per-epoch time (compile-inclusive) rather than None
+            "epoch_time": float(np.mean(durs)) if durs
+            else (dur if n_epochs > start_epoch else None),
             "eval_time": float(np.mean(eval_durs)) if eval_durs else None,
             "comm_cost": comm_cost if comm_measured else None,
             "history": history,

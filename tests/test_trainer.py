@@ -194,3 +194,27 @@ def test_bf16_with_corrections_and_pp(graph):
     losses = [t.train_epoch(e) for e in range(25)]
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < np.mean(losses[2:7])
+
+
+def test_fused_epochs_match_singles(graph):
+    """train_epochs(k) must be numerically identical to k train_epoch
+    calls (same per-epoch rng folds), pipelined carry included."""
+    ta = _setup(graph, 4, seed=9, dropout=0.3, enable_pipeline=True)
+    tb = _setup(graph, 4, seed=9, dropout=0.3, enable_pipeline=True)
+    la = [ta.train_epoch(e) for e in range(6)]
+    lb = list(tb.train_epochs(0, 3)) + list(tb.train_epochs(3, 3))
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+    pa = jax.tree_util.tree_leaves(jax.device_get(ta.state["params"]))
+    pb = jax.tree_util.tree_leaves(jax.device_get(tb.state["params"]))
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_fit_with_fused_epochs(graph):
+    t = _setup(graph, 4, seed=3, n_epochs=40, log_every=20, hidden=32,
+               fused_epochs=8)
+    res = t.fit(eval_graphs={"val": (graph, "val_mask"),
+                             "test": (graph, "test_mask")},
+                log_fn=lambda m: None)
+    assert res["best_val"] > 0.75
+    assert len(res["history"]) == 2  # evals still at log_every boundaries
