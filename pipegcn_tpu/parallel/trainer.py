@@ -126,17 +126,30 @@ class Trainer:
 
     def _setup_pallas_spmm(self) -> None:
         """Resolve cfg.spmm_impl: 'pallas' forces the VMEM-resident CSR
-        kernel (ops/pallas_spmm.py), 'auto' uses it when the shard fits
-        the VMEM budget, 'xla' (default) keeps gather+segment-sum."""
+        kernel (ops/pallas_spmm.py), 'bucket' the scatter-free
+        degree-bucketed aggregation (ops/bucket_spmm.py), 'auto' picks
+        pallas when the shard fits the VMEM budget else bucket, 'xla'
+        (default) keeps gather+segment-sum."""
         from ..ops.pallas_spmm import build_sharded_tables, sharded_applicable
 
         impl = self.cfg.spmm_impl
         self._pallas_tables = None
         self._pallas_max_e = 0
-        if impl not in ("xla", "pallas", "auto"):
+        self._bucket_tables = None
+        if impl not in ("xla", "pallas", "auto", "bucket"):
             raise ValueError(f"unknown spmm_impl: {impl}")
         if impl == "xla":
             return
+
+        def use_bucket():
+            from ..ops.bucket_spmm import build_sharded_bucket_tables
+
+            self._bucket_tables = build_sharded_bucket_tables(self.sg)
+
+        if impl == "bucket":
+            use_bucket()
+            return
+
         # cheap VMEM gate first (needs only shapes) — skip the O(E) table
         # build when 'auto' will reject the shard anyway
         n_src_rows = self.sg.n_max + self.sg.halo_size
@@ -147,10 +160,12 @@ class Trainer:
         ]
         w_max = max(widths, default=1)
         if impl == "auto" and not sharded_applicable(n_src_rows, w_max, 0):
+            use_bucket()
             return
         tables, max_e, n_src_rows = build_sharded_tables(self.sg)
         fits = sharded_applicable(n_src_rows, w_max, max_e)
         if impl == "auto" and not fits:
+            use_bucket()
             return
         if impl == "pallas" and not fits:
             import warnings
@@ -184,6 +199,8 @@ class Trainer:
         }
         if self._pallas_tables is not None:
             arrs.update(self._pallas_tables)
+        if self._bucket_tables is not None:
+            arrs.update(self._bucket_tables)
         return {
             k: jax.device_put(jnp.asarray(v), self._shard)
             for k, v in arrs.items()
@@ -268,6 +285,7 @@ class Trainer:
         glayers = list(self._graph_layer_range())
         momentum = tcfg.corr_momentum
         use_pallas = self._pallas_tables is not None
+        use_bucket = self._bucket_tables is not None
         pallas_max_e = self._pallas_max_e
         pallas_interp = getattr(self, "_pallas_interpret", False)
 
@@ -330,6 +348,13 @@ class Trainer:
                 spmm_fn = make_device_spmm_fn(
                     d, n_max, n_max + H, pallas_max_e, pallas_interp,
                     cfg.spmm_chunk,
+                )
+            elif use_bucket:
+                from ..ops.bucket_spmm import make_device_bucket_spmm_fn
+
+                spmm_fn = make_device_bucket_spmm_fn(
+                    d, d["in_deg"], n_max + H,
+                    chunk_edges=cfg.spmm_chunk,
                 )
 
             def loss_fn(params, probes_arg):
