@@ -1,0 +1,364 @@
+"""Hybrid block-dense SpMM: community-dense tiles on the MXU, sparse
+remainder through the scatter-free bucket kernel.
+
+The third TPU-native replacement for DGL's SpMM (reference
+module/layer.py:47-49), aimed at the regime that actually decides the
+headline benchmark: large community-structured graphs (Reddit-like).
+Such graphs concentrate most edges in dense (destination-tile,
+source-tile) blocks; a gather-based SpMM re-reads each source row
+once per edge (~degree times), while a block-dense formulation reads
+each participating feature tile once per block and turns the
+aggregation into batched [T,S] @ [S,F] matmuls — exactly what the MXU
+is for. Edges outside dense blocks (the uniform "background") fall
+back to ops/bucket_spmm.py's gather + dense-reduction.
+
+Traffic comparison per layer at Reddit scale (114M edges, F=256,
+bf16): pure gather moves ~59 GB; with SBM-like structure the hybrid
+moves ~2-4 GB of A-blocks + feature tiles plus the remainder's
+gathers — an order of magnitude less, with the dense part's FLOPs
+(~1 TFLOP) costing single-digit milliseconds on one v5e chip.
+
+Mechanics:
+  - Host tiles the destination space into rows of `tile` (T) and the
+    source space into `tile` (S); (bd, bs) blocks with
+    nnz * F >= T * S ("the dense A block is cheaper to read than the
+    gathers it replaces") are materialized as dense [T, S] matrices
+    holding per-edge 1.0 (duplicate edges accumulate).
+  - Forward: per destination tile, sum_k A[blk_k] @ fbuf_tile[src_k]
+    via one batched einsum inside a lax.scan over destination tiles.
+  - Backward: the same A blocks, transposed roles — per SOURCE tile,
+    sum_k A[blk_k]^T @ g_tile[dst_k] — so no scatter anywhere; the
+    remainder's backward is the bucket kernel's transpose tables.
+  - Mean normalization (in_deg division) is applied once at the end,
+    after dense + remainder parts are summed.
+
+All shapes are static; per-device plans pad to shared maxima
+(block count, per-tile block lists, bucket caps) so a single traced
+program serves every device in shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bucket_spmm import (
+    _bucket_widths,
+    bucket_aggregate,
+    build_tables_for_edges,
+)
+
+
+def _pad_rows(mat: np.ndarray, rows: int, fill) -> np.ndarray:
+    if mat.shape[0] == rows:
+        return mat
+    return np.pad(mat, ((0, rows - mat.shape[0]),) +
+                  ((0, 0),) * (mat.ndim - 1), constant_values=fill)
+
+
+class BlockPlan:
+    """Host-side hybrid plan for one device's edge list.
+
+    Attributes (all numpy, static shapes):
+      a_blocks:    [B, T, S] f32 — dense block values (1.0 per edge);
+                   block B-1 is NOT special; a zero block is appended
+                   on device as index B.
+      fwd_blk/fwd_tile: [n_dst_tiles, K] int32 — per destination tile,
+                   the A-block indices (pad B) and source-tile ids
+                   (pad n_src_tiles, the zero tile).
+      bwd_blk/bwd_tile: [n_src_tiles, K2] int32 — per source tile, the
+                   A-block indices and destination-tile ids for the
+                   transpose.
+      rem_*:       remainder edges' bucket tables (fwd + transpose).
+    """
+
+    def __init__(self, edge_src: np.ndarray, edge_dst: np.ndarray,
+                 n_out: int, n_src_rows: int, n_feat: int,
+                 tile: int = 256,
+                 nnz_threshold: Optional[int] = None,
+                 fwd_widths: Optional[Sequence[int]] = None,
+                 bwd_widths: Optional[Sequence[int]] = None):
+        T = S = tile
+        self.tile = tile
+        real = edge_dst < n_out
+        src = edge_src[real].astype(np.int64)
+        dst = edge_dst[real].astype(np.int64)
+        n_dst_tiles = -(-n_out // T)
+        n_src_tiles = -(-n_src_rows // S)
+        self.n_out = n_out
+        self.n_src_rows = n_src_rows
+        self.n_dst_tiles = n_dst_tiles
+        self.n_src_tiles = n_src_tiles
+
+        if nnz_threshold is None:
+            # dense block pays T*S A-reads + S*F tile-read amortized;
+            # each replaced edge saves an F-wide gather
+            nnz_threshold = max(1, (T * S) // max(n_feat, 1))
+        bid = (dst // T) * n_src_tiles + (src // S)
+        order = np.argsort(bid, kind="stable")
+        src_o, dst_o, bid_o = src[order], dst[order], bid[order]
+        uniq, starts, counts = np.unique(bid_o, return_index=True,
+                                         return_counts=True)
+        dense_sel = counts >= nnz_threshold
+
+        # ---- dense blocks ----
+        dense_ids = uniq[dense_sel]
+        B = int(dense_ids.shape[0])
+        a_blocks = np.zeros((B, T, S), np.float32)
+        for k, (u, s0, c) in enumerate(
+                zip(uniq[dense_sel], starts[dense_sel],
+                    counts[dense_sel])):
+            rows = dst_o[s0:s0 + c] % T
+            cols = src_o[s0:s0 + c] % S
+            np.add.at(a_blocks[k], (rows, cols), 1.0)
+        self.a_blocks = a_blocks
+        bd = (dense_ids // n_src_tiles).astype(np.int64)
+        bs = (dense_ids % n_src_tiles).astype(np.int64)
+
+        def group(keys, vals_blk, vals_tile, n_groups, pad_blk, pad_tile):
+            k_max = int(np.bincount(keys, minlength=n_groups).max(
+                initial=0))
+            k_max = max(k_max, 1)
+            blk = np.full((n_groups, k_max), pad_blk, np.int32)
+            tl = np.full((n_groups, k_max), pad_tile, np.int32)
+            fill = np.zeros(n_groups, np.int64)
+            for i in range(keys.shape[0]):
+                g = keys[i]
+                blk[g, fill[g]] = vals_blk[i]
+                tl[g, fill[g]] = vals_tile[i]
+                fill[g] += 1
+            return blk, tl
+
+        blk_idx = np.arange(B, dtype=np.int64)
+        self.fwd_blk, self.fwd_tile = group(
+            bd, blk_idx, bs, n_dst_tiles, B, n_src_tiles)
+        self.bwd_blk, self.bwd_tile = group(
+            bs, blk_idx, bd, n_src_tiles, B, n_dst_tiles)
+
+        # ---- sparse remainder (bucket tables both directions) ----
+        in_dense = dense_sel[np.searchsorted(uniq, bid_o)]
+        r_src, r_dst = src_o[~in_dense], dst_o[~in_dense]
+        self.rem_count = int(r_src.shape[0])
+        max_in = int(np.bincount(r_dst, minlength=n_out).max(initial=1))
+        max_out = int(np.bincount(r_src, minlength=n_src_rows).max(
+            initial=1))
+        self.rem_fwd_widths = list(
+            fwd_widths if fwd_widths is not None
+            else _bucket_widths(max(max_in, 1)))
+        self.rem_bwd_widths = list(
+            bwd_widths if bwd_widths is not None
+            else _bucket_widths(max(max_out, 1)))
+        self.rem_fwd_mats, self.rem_fwd_inv, self.rem_fwd_counts = \
+            build_tables_for_edges(r_src, r_dst, n_out, n_src_rows,
+                                   self.rem_fwd_widths)
+        self.rem_bwd_mats, self.rem_bwd_inv, self.rem_bwd_counts = \
+            build_tables_for_edges(r_dst, r_src, n_src_rows, n_out,
+                                   self.rem_bwd_widths)
+
+
+def _dense_apply(a_pad, blk_idx, tile_idx, tiles, T, out_rows, n_feat):
+    """sum_k A[blk_idx[i,k]] (@ or transposed-@) tiles[tile_idx[i,k]]
+    for every group i, via lax.scan. a_pad: [B+1, T, S] (last = zeros);
+    tiles: [n_tiles+1, S, F] (last = zeros). Returns [n_groups*T, F] f32."""
+
+    def body(_, idx):
+        bi, ti = idx
+        blks = jnp.take(a_pad, bi, axis=0)      # [K, T, S]
+        tls = jnp.take(tiles, ti, axis=0)       # [K, S, F]
+        out = jnp.einsum("kts,ksf->tf", blks, tls,
+                         preferred_element_type=jnp.float32)
+        return None, out
+
+    _, outs = jax.lax.scan(body, None, (blk_idx, tile_idx))
+    return outs.reshape(-1, n_feat)[:out_rows]
+
+
+def make_block_spmm_fn(
+    plan_arrays: Dict[str, jax.Array],
+    in_deg: jax.Array,
+    n_out: int,
+    n_src_rows: int,
+    tile: int,
+    chunk_edges: Optional[int] = None,
+):
+    """Differentiable hybrid mean-aggregation closure f(fbuf [R, F]) ->
+    f32 [n_out, F]. `plan_arrays` holds the BlockPlan tensors (see
+    sharded_block_tables for keys), already stripped to per-device blocks
+    when used inside shard_map."""
+    d = plan_arrays
+    deg_col = in_deg[:, None]
+    T = tile
+
+    def tiles_of(x, n_tiles, S):
+        rpad = n_tiles * S - x.shape[0]
+        xp = jnp.pad(x, ((0, rpad + S), (0, 0)))  # + one zero tile
+        return xp.reshape(n_tiles + 1, S, x.shape[-1])
+
+    def rem_mats(prefix):
+        return [d[k] for k in sorted(d)
+                if k.startswith(prefix) and not k.endswith("inv")]
+
+    def dense_dtype(x):
+        # A blocks are 0/1 counts — exact in bf16; match fbuf's dtype so
+        # the MXU runs at the activation precision
+        return d["blk_a"].astype(x.dtype)
+
+    @jax.custom_vjp
+    def f(fbuf):
+        a_pad = jnp.concatenate(
+            [dense_dtype(fbuf),
+             jnp.zeros((1, T, T), fbuf.dtype)], axis=0)
+        n_s_tiles = -(-n_src_rows // T)
+        tiles = tiles_of(fbuf, n_s_tiles, T)
+        dense = _dense_apply(a_pad, d["blk_fwd_blk"], d["blk_fwd_tile"],
+                             tiles, T, n_out, fbuf.shape[-1])
+        rem = bucket_aggregate(fbuf, rem_mats("blkrem_fwd_"),
+                               d["blkrem_fwd_inv"],
+                               chunk_edges=chunk_edges)
+        return (dense + rem) / deg_col
+
+    def fwd(fbuf):
+        return f(fbuf), jnp.zeros((0,), fbuf.dtype)
+
+    def bwd(proto, g):
+        gd = (g.astype(jnp.float32) / deg_col).astype(proto.dtype)
+        # transpose dense: per source tile, sum A^T @ g_tile
+        a_t = jnp.swapaxes(dense_dtype(gd), 1, 2)  # [B, S, T]
+        a_pad = jnp.concatenate(
+            [a_t, jnp.zeros((1, T, T), gd.dtype)], axis=0)
+        n_d_tiles = -(-n_out // T)
+        g_tiles = tiles_of(gd, n_d_tiles, T)
+        dense = _dense_apply(a_pad, d["blk_bwd_blk"], d["blk_bwd_tile"],
+                             g_tiles, T, n_src_rows, g.shape[-1])
+        rem = bucket_aggregate(gd, rem_mats("blkrem_bwd_"),
+                               d["blkrem_bwd_inv"],
+                               chunk_edges=chunk_edges)
+        return ((dense + rem).astype(proto.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def plan_to_arrays(p: BlockPlan) -> Dict[str, np.ndarray]:
+    """Flatten a BlockPlan into the array dict make_block_spmm_fn uses."""
+    arrs = {
+        "blk_a": p.a_blocks,
+        "blk_fwd_blk": p.fwd_blk.astype(np.int32),
+        "blk_fwd_tile": p.fwd_tile.astype(np.int32),
+        "blk_bwd_blk": p.bwd_blk.astype(np.int32),
+        "blk_bwd_tile": p.bwd_tile.astype(np.int32),
+        "blkrem_fwd_inv": p.rem_fwd_inv,
+        "blkrem_bwd_inv": p.rem_bwd_inv,
+    }
+    for b, m in enumerate(p.rem_fwd_mats):
+        if m.shape[0]:
+            arrs[f"blkrem_fwd_{b:02d}"] = m
+    for b, m in enumerate(p.rem_bwd_mats):
+        if m.shape[0]:
+            arrs[f"blkrem_bwd_{b:02d}"] = m
+    return arrs
+
+
+def build_sharded_block_tables(sg, tile: int = 256,
+                               n_feat_hint: int = 256
+                               ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Stacked per-device hybrid plans (leading device axis), padded to
+    shared shapes: same B (dense block count), same K (per-tile block
+    list width), same remainder bucket ladders/caps. Returns
+    (tables, tile)."""
+    P = sg.num_parts
+    n_src_rows = sg.n_max + sg.halo_size
+
+    # shared remainder ladders need global maxima; build plans first
+    plans = [
+        BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max, n_src_rows,
+                  n_feat_hint, tile=tile)
+        for r in range(P)
+    ]
+    # unify remainder widths (ladder length = max over devices)
+    fw_len = max(len(p.rem_fwd_widths) for p in plans)
+    bw_len = max(len(p.rem_bwd_widths) for p in plans)
+    fw = [1 << i for i in range(fw_len)]
+    bw = [1 << i for i in range(bw_len)]
+    rebuild = any(p.rem_fwd_widths != fw or p.rem_bwd_widths != bw
+                  for p in plans)
+    if rebuild:
+        plans = [
+            BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
+                      n_src_rows, n_feat_hint, tile=tile,
+                      fwd_widths=fw, bwd_widths=bw)
+            for r in range(P)
+        ]
+
+    B_max = max(p.a_blocks.shape[0] for p in plans)
+    kf_max = max(p.fwd_blk.shape[1] for p in plans)
+    kb_max = max(p.bwd_blk.shape[1] for p in plans)
+    fwd_caps = [max(p.rem_fwd_counts[b] for p in plans)
+                for b in range(fw_len)]
+    bwd_caps = [max(p.rem_bwd_counts[b] for p in plans)
+                for b in range(bw_len)]
+
+    def pad_k(mat, k, fill):
+        if mat.shape[1] == k:
+            return mat
+        return np.pad(mat, ((0, 0), (0, k - mat.shape[1])),
+                      constant_values=fill)
+
+    def reoffset_inv(inv, counts, caps):
+        inv = inv.astype(np.int64)
+        out = np.full_like(inv, sum(caps))
+        off_old = off_new = 0
+        for n_b, cap in zip(counts, caps):
+            sel = (inv >= off_old) & (inv < off_old + n_b)
+            out[sel] = inv[sel] - off_old + off_new
+            off_old += n_b
+            off_new += cap
+        return out.astype(np.int32)
+
+    tables: Dict[str, List[np.ndarray]] = {}
+    for p in plans:
+        B = p.a_blocks.shape[0]
+        arrs = {
+            # pad dense blocks to B_max with zero blocks; pad indices
+            # point at the appended zero block (index B_max on device)
+            "blk_a": _pad_rows(p.a_blocks, B_max, 0.0),
+            "blk_fwd_blk": np.where(
+                pad_k(p.fwd_blk, kf_max, B) == B, B_max,
+                pad_k(p.fwd_blk, kf_max, B)).astype(np.int32),
+            "blk_fwd_tile": pad_k(p.fwd_tile, kf_max,
+                                  p.n_src_tiles).astype(np.int32),
+            "blk_bwd_blk": np.where(
+                pad_k(p.bwd_blk, kb_max, B) == B, B_max,
+                pad_k(p.bwd_blk, kb_max, B)).astype(np.int32),
+            "blk_bwd_tile": pad_k(p.bwd_tile, kb_max,
+                                  p.n_dst_tiles).astype(np.int32),
+            "blkrem_fwd_inv": reoffset_inv(p.rem_fwd_inv,
+                                           p.rem_fwd_counts, fwd_caps),
+            "blkrem_bwd_inv": reoffset_inv(p.rem_bwd_inv,
+                                           p.rem_bwd_counts, bwd_caps),
+        }
+        for b in range(fw_len):
+            if fwd_caps[b]:
+                arrs[f"blkrem_fwd_{b:02d}"] = _pad_rows(
+                    p.rem_fwd_mats[b], fwd_caps[b], n_src_rows)
+        for b in range(bw_len):
+            if bwd_caps[b]:
+                arrs[f"blkrem_bwd_{b:02d}"] = _pad_rows(
+                    p.rem_bwd_mats[b], bwd_caps[b], sg.n_max)
+        for k, v in arrs.items():
+            tables.setdefault(k, []).append(v)
+    return {k: np.stack(v) for k, v in tables.items()}, tile
+
+
+def make_device_block_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
+                              n_out: int, n_src_rows: int, tile: int,
+                              chunk_edges: Optional[int] = None):
+    """Bind per-device blocks of build_sharded_block_tables (inside
+    shard_map, leading device axis stripped)."""
+    plan_arrays = {k: v for k, v in d.items()
+                   if k.startswith(("blk_", "blkrem_"))}
+    return make_block_spmm_fn(plan_arrays, in_deg, n_out, n_src_rows,
+                              tile, chunk_edges)

@@ -136,7 +136,9 @@ class Trainer:
         self._pallas_tables = None
         self._pallas_max_e = 0
         self._bucket_tables = None
-        if impl not in ("xla", "pallas", "auto", "bucket"):
+        self._block_tables = None
+        self._block_tile = 0
+        if impl not in ("xla", "pallas", "auto", "bucket", "block"):
             raise ValueError(f"unknown spmm_impl: {impl}")
         if impl == "xla":
             return
@@ -148,6 +150,13 @@ class Trainer:
 
         if impl == "bucket":
             use_bucket()
+            return
+        if impl == "block":
+            from ..ops.block_spmm import build_sharded_block_tables
+
+            w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
+            self._block_tables, self._block_tile = \
+                build_sharded_block_tables(self.sg, n_feat_hint=w_hint)
             return
 
         # cheap VMEM gate first (needs only shapes) — skip the O(E) table
