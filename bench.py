@@ -8,8 +8,15 @@ using a synthetic graph with Reddit's shape statistics (the real dataset
 needs a download this environment does not allow).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
-vs_baseline > 1 means faster than the reference's 0.266 s/epoch.
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...}
+vs_baseline > 1 means faster than the reference's 0.266 s/epoch. Extra
+keys: backend/device, MFU, estimated HBM + ICI traffic, and the
+pipelined-vs-vanilla epoch-time comparison (the overlap evidence).
+
+Backend init is hardened: the TPU backend is probed in a subprocess with
+retry + backoff (a transient UNAVAILABLE from a stale chip holder must
+not kill the run), and if the TPU never comes up the bench falls back to
+CPU and still reports a (clearly labeled) number rather than rc=1.
 
 The partition/build artifact is cached under partitions/ so repeat runs
 skip the ~minutes of host-side preprocessing. Use --small for a quick
@@ -17,14 +24,104 @@ smoke-scale run, --parts N to shard over N devices.
 """
 
 import argparse
+import dataclasses
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 BASELINE_EPOCH_S = 0.266  # reference README.md:93-94 (2x GPU)
+
+# peak dense bf16 FLOP/s per chip, by device_kind substring (public specs)
+PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def probe_backend(timeout_s: float) -> dict:
+    """Try to initialize the default jax backend in a SUBPROCESS.
+
+    A failed in-process `jax.devices()` poisons jax's backend cache for
+    the life of the process, so probing must happen out-of-process; only
+    after a probe succeeds does the parent import jax for real. Returns
+    {"ok": bool, "detail": str}.
+    """
+    code = (
+        "import jax, json, sys;"
+        "ds = jax.devices();"
+        "print(json.dumps({'n': len(ds), 'kind': ds[0].device_kind,"
+        " 'platform': ds[0].platform}))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "detail": f"probe timed out after {timeout_s}s"}
+    if r.returncode == 0 and r.stdout.strip():
+        return {"ok": True, "detail": r.stdout.strip().splitlines()[-1]}
+    tail = (r.stderr or "").strip().splitlines()[-3:]
+    return {"ok": False, "detail": " | ".join(tail) or f"rc={r.returncode}"}
+
+
+def init_backend(max_tries: int, probe_timeout: float, force_cpu: bool) -> str:
+    """Probe-with-retry; on persistent failure fall back to CPU.
+
+    Returns the backend label ("tpu", "cpu", "cpu-fallback", ...). Round 1
+    shipped no perf number because a single transient
+    'UNAVAILABLE: TPU backend setup/compile error' at jax.devices()
+    crashed the bench (BENCH_r01.json rc=1); this makes that path
+    impossible: worst case is a CPU-labeled fallback measurement.
+
+    NOTE: this environment's site hook pins JAX_PLATFORMS, so choosing
+    CPU must happen via jax.config.update AFTER import (the caller does
+    that when the returned label starts with "cpu") — the env var alone
+    is silently overridden.
+    """
+    if force_cpu or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return "cpu"
+    delay = 5.0
+    # escalating per-attempt timeouts: a healthy-but-slow init gets more
+    # room on later tries, a dead tunnel doesn't burn 4x the max timeout
+    schedule = [120.0, 300.0, 600.0] if not probe_timeout else \
+        [probe_timeout] * max_tries
+    for attempt in range(1, max_tries + 1):
+        t0 = time.perf_counter()
+        res = probe_backend(schedule[min(attempt - 1, len(schedule) - 1)])
+        dt = time.perf_counter() - t0
+        if res["ok"]:
+            print(f"# backend probe ok (attempt {attempt}, {dt:.0f}s): "
+                  f"{res['detail']}", file=sys.stderr)
+            info = json.loads(res["detail"])
+            return info["platform"]
+        print(f"# backend probe FAILED (attempt {attempt}/{max_tries}, "
+              f"{dt:.0f}s): {res['detail']}", file=sys.stderr)
+        if attempt < max_tries:
+            print(f"# retrying in {delay:.0f}s ...", file=sys.stderr)
+            time.sleep(delay)
+            delay = min(delay * 2, 60.0)
+    print("# backend unavailable after all retries — falling back to CPU "
+          "(numbers below are NOT a TPU measurement)", file=sys.stderr)
+    return "cpu-fallback"
+
+
+def peak_flops_for(kind: str):
+    k = kind.lower()
+    for sub, f in PEAK_FLOPS:
+        if sub in k:
+            return f
+    return None
 
 
 def main():
@@ -33,25 +130,71 @@ def main():
                     help="10k-node smoke config instead of Reddit scale")
     ap.add_argument("--parts", type=int, default=0,
                     help="partitions (default: all available devices)")
-    ap.add_argument("--epochs", type=int, default=12)
-    ap.add_argument("--warmup", type=int, default=3)
-    ap.add_argument("--no-pipeline", action="store_true")
+    ap.add_argument("--blocks", type=int, default=8,
+                    help="timed samples; each sample is one dispatch of "
+                         "--fused epochs (sample count is independent of "
+                         "--fused so the median is equally stable)")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="measure the vanilla (synchronous-halo) step as "
+                         "the headline instead of the pipelined one")
+    ap.add_argument("--no-compare", action="store_true",
+                    help="skip the pipelined-vs-vanilla comparison run")
     ap.add_argument("--f32", action="store_true",
                     help="float32 compute (default bfloat16, the "
                          "TPU-native choice)")
     ap.add_argument("--fused", type=int, default=4,
                     help="epochs per dispatch (lax.scan); per-epoch time "
                          "= block time / fused")
+    ap.add_argument("--spmm-impl", default="xla",
+                    choices=["xla", "pallas", "bucket", "block", "auto"])
+    ap.add_argument("--sweep-spmm", action="store_true",
+                    help="also time every SpMM impl and report the winner")
+    ap.add_argument("--probe-tries", type=int, default=3)
+    ap.add_argument("--probe-timeout", type=float, default=0.0,
+                    help="per-attempt probe timeout; 0 = escalating "
+                         "120/300/600s schedule")
+    ap.add_argument("--cpu", action="store_true",
+                    help="run on CPU without probing the TPU backend")
     args = ap.parse_args()
 
+    backend = init_backend(args.probe_tries, args.probe_timeout, args.cpu)
+
+    global jax
     import jax
+
+    if backend.startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        # the probe succeeded out-of-process, but the chip can still go
+        # transiently UNAVAILABLE before the parent's own backend init —
+        # guard the in-process init too, with the same CPU last resort
+        try:
+            jax.devices()
+        except RuntimeError as exc:
+            # a failed in-process init is cached for the process's life,
+            # so there is no point retrying here — fall straight back
+            print(f"# in-process backend init failed after a good probe: "
+                  f"{exc}\n# falling back to CPU", file=sys.stderr)
+            backend = "cpu-fallback"
+            jax.config.update("jax_platforms", "cpu")
 
     from pipegcn_tpu.graph import load_data
     from pipegcn_tpu.models import ModelConfig
     from pipegcn_tpu.parallel import Trainer, TrainConfig
     from pipegcn_tpu.partition import ShardedGraph, partition_graph
 
+    device_kind = jax.devices()[0].device_kind
     n_parts = args.parts or len(jax.devices())
+    degraded = False
+    if backend == "cpu-fallback" and not args.small:
+        # Reddit scale on the CPU fallback would take hours; shrink the
+        # sampling so the artifact still lands in bounded time. The JSON
+        # is clearly labeled backend=cpu-fallback + degraded=true.
+        args.fused, args.blocks, args.no_compare = 1, 2, True
+        args.sweep_spmm = False
+        degraded = True
+        print("# cpu-fallback: degrading to 2 blocks of 1 epoch, "
+              "no comparison run", file=sys.stderr)
     if args.small:
         dataset = "synthetic:10000:20:64:16"
         hidden, n_layers = 64, 3
@@ -83,46 +226,124 @@ def main():
         use_pp=True, norm="layer", dropout=0.5,
         train_size=sg.n_train_global, spmm_chunk=spmm_chunk,
         dtype="float32" if args.f32 else "bfloat16",
+        spmm_impl=args.spmm_impl,
     )
-    tcfg = TrainConfig(
-        lr=0.01, n_epochs=args.epochs,
-        enable_pipeline=not args.no_pipeline, seed=0, eval=False,
-        fused_epochs=args.fused,
-    )
-    t0 = time.perf_counter()
-    trainer = Trainer(sg, cfg, tcfg)
-    print(f"# trainer setup ({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
-
     blk = max(1, args.fused)
 
-    def run_block(e0):
-        if blk == 1:
-            loss = trainer.train_epoch(e0)
-        else:
-            loss = float(trainer.train_epochs(e0, blk)[-1])
-        jax.block_until_ready(trainer.state["params"])
-        return loss
+    def build_trainer(pipeline: bool) -> "Trainer":
+        tcfg = TrainConfig(
+            lr=0.01, n_epochs=args.blocks * blk,
+            enable_pipeline=pipeline, seed=0, eval=False,
+            fused_epochs=blk,
+        )
+        return Trainer(sg, cfg, tcfg)
 
-    # warmup (compile + pipeline fill); epoch counts round UP to whole
-    # blocks so every timed block reuses the same compiled scan length
-    t0 = time.perf_counter()
-    e = 0
-    for _ in range(-(-args.warmup // blk) if args.warmup else 0):
-        run_block(e)
-        e += blk
-    print(f"# warmup/compile ({time.perf_counter()-t0:.1f}s)",
-          file=sys.stderr)
+    def time_trainer(trainer, n_blocks: int, warmup_blocks: int = 1):
+        """Median per-epoch time over n_blocks dispatches of blk epochs.
+        At least one warmup block always runs first so compile (and the
+        staleness pipeline fill) never lands in a timed sample."""
+        e = 0
 
-    times = []
-    n_blocks = -(-args.epochs // blk)
-    for _ in range(n_blocks):
+        def run_block(e0):
+            if blk == 1:
+                loss = trainer.train_epoch(e0)
+            else:
+                loss = float(trainer.train_epochs(e0, blk)[-1])
+            jax.block_until_ready(trainer.state["params"])
+            return loss
+
         t0 = time.perf_counter()
-        loss = run_block(e)
-        e += blk
-        times.append((time.perf_counter() - t0) / blk)
-    epoch_s = float(np.median(times))
-    print(f"# median epoch {epoch_s:.4f}s over {n_blocks} blocks of {blk}, "
-          f"final loss {loss:.4f}", file=sys.stderr)
+        for _ in range(max(1, warmup_blocks)):
+            run_block(e)
+            e += blk
+        print(f"# warmup/compile ({time.perf_counter()-t0:.1f}s)",
+              file=sys.stderr)
+        times = []
+        loss = float("nan")
+        for _ in range(n_blocks):
+            t0 = time.perf_counter()
+            loss = run_block(e)
+            e += blk
+            times.append((time.perf_counter() - t0) / blk)
+        return float(np.median(times)), loss
+
+    headline_pipeline = not args.no_pipeline
+    t0 = time.perf_counter()
+    trainer = build_trainer(headline_pipeline)
+    print(f"# trainer setup ({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
+
+    epoch_s, loss = time_trainer(trainer, args.blocks)
+    print(f"# median epoch {epoch_s:.4f}s over {args.blocks} blocks of "
+          f"{blk}, final loss {loss:.4f}", file=sys.stderr)
+
+    # ---- derived metrics: MFU + bytes (from XLA's own cost model) -----
+    extras = {
+        "backend": backend,
+        "device": device_kind,
+        "n_parts": n_parts,
+        "dtype": cfg.dtype,
+        "spmm_impl": args.spmm_impl,
+        "pipeline": headline_pipeline,
+        "loss": round(loss, 4) if np.isfinite(loss) else None,
+    }
+    if degraded:
+        extras["degraded"] = True
+    try:
+        ca = trainer.step_cost_analysis()
+        if ca:
+            # cost_analysis describes the per-device SPMD module; scale
+            # to whole-job totals so the labels mean what they say
+            flops_epoch = ca.get("flops", 0.0) * n_parts
+            hbm_bytes = ca.get("bytes accessed", 0.0) * n_parts
+            extras["flops_per_epoch"] = round(flops_epoch)
+            extras["est_hbm_bytes_per_epoch"] = round(hbm_bytes)
+            peak = peak_flops_for(device_kind)
+            if peak and flops_epoch:
+                extras["mfu_pct"] = round(
+                    100.0 * flops_epoch / (epoch_s * peak * n_parts), 2
+                )
+    except Exception as exc:  # cost analysis is best-effort diagnostics
+        print(f"# cost analysis unavailable: {exc}", file=sys.stderr)
+    extras["est_ici_bytes_per_epoch"] = trainer.est_ici_bytes_per_epoch()
+
+    # ---- overlap evidence: pipelined vs vanilla -----------------------
+    if not args.no_compare:
+        del trainer  # free HBM before compiling the second program
+        other = build_trainer(not headline_pipeline)
+        other_s, _ = time_trainer(other, max(3, args.blocks // 2))
+        key = "vanilla_epoch_s" if headline_pipeline else "pipelined_epoch_s"
+        extras[key] = round(other_s, 4)
+        pipe_s = epoch_s if headline_pipeline else other_s
+        van_s = other_s if headline_pipeline else epoch_s
+        extras["pipeline_speedup"] = round(van_s / pipe_s, 3)
+        print(f"# pipelined {pipe_s:.4f}s vs vanilla {van_s:.4f}s "
+              f"(speedup {van_s / pipe_s:.3f}x)", file=sys.stderr)
+        del other
+
+    # ---- optional SpMM implementation sweep ---------------------------
+    if args.sweep_spmm:
+        sweep = {}
+        for impl in ("xla", "bucket", "block", "pallas"):
+            try:
+                t0 = time.perf_counter()
+                tr = Trainer(sg, dataclasses.replace(cfg, spmm_impl=impl),
+                    TrainConfig(lr=0.01, n_epochs=blk * 4,
+                                enable_pipeline=headline_pipeline,
+                                seed=0, eval=False, fused_epochs=blk))
+                s, _ = time_trainer(tr, 3)
+                sweep[impl] = round(s, 4)
+                print(f"# spmm sweep: {impl} {s:.4f}s/epoch "
+                      f"(total {time.perf_counter()-t0:.0f}s)",
+                      file=sys.stderr)
+                del tr
+            except Exception as exc:
+                sweep[impl] = None
+                print(f"# spmm sweep: {impl} failed: {exc}",
+                      file=sys.stderr)
+        extras["spmm_sweep"] = sweep
+        valid = {k: v for k, v in sweep.items() if v}
+        if valid:
+            extras["spmm_best"] = min(valid, key=valid.get)
 
     metric = "reddit_scale_epoch_time" if not args.small else \
         "small_epoch_time"
@@ -131,6 +352,7 @@ def main():
         "value": round(epoch_s, 4),
         "unit": "s/epoch",
         "vs_baseline": round(BASELINE_EPOCH_S / epoch_s, 3),
+        **extras,
     }))
 
 

@@ -684,6 +684,42 @@ class Trainer:
                                                norm=best_norm)
         return result
 
+    # ---------------- cost analysis -----------------------------------
+
+    def step_cost_analysis(self) -> Dict[str, float]:
+        """XLA's own cost model for ONE epoch of the train step (keys
+        like 'flops' and 'bytes accessed'), for MFU / bandwidth
+        reporting. Compiles the single-epoch program if it isn't already
+        cached; returns {} when the backend doesn't expose an analysis."""
+        rng = jax.random.fold_in(self._epoch_rng_base(), 0)
+        ca = self._step.lower(self.state, self.data, rng) \
+            .compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not ca:
+            return {}
+        return {k: float(v) for k, v in ca.items()
+                if isinstance(v, (int, float))}
+
+    def est_ici_bytes_per_epoch(self) -> int:
+        """Estimated inter-device traffic per epoch: per exchanged graph
+        layer, every device ships its halo block forward and the boundary
+        gradients back (2x); plus the ring all-reduce of the grads
+        (~2x param bytes per device)."""
+        if self.P == 1:
+            return 0
+        item = 4 if self.cfg.compute_dtype == jnp.float32 else 2
+        total = 0
+        for i in self._graph_layer_range():
+            total += 2 * self.P * self.sg.halo_size * self._layer_width(i) \
+                * item
+        n_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(self.state["params"])
+        )
+        total += 2 * self.P * n_params * 4
+        return int(total)
+
     # ---------------- comm cost measurement ---------------------------
 
     def measure_comm(self, repeats: int = 5) -> Dict[str, float]:
