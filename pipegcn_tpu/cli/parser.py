@@ -106,11 +106,12 @@ def create_parser() -> argparse.ArgumentParser:
                         help="edge-chunk size bounding SpMM memory "
                              "(0 = unchunked)")
     parser.add_argument("--spmm-impl", "--spmm_impl",
-                        choices=["xla", "pallas", "bucket", "auto"],
+                        choices=["xla", "pallas", "bucket", "block", "auto"],
                         default="xla",
                         help="aggregation kernel: XLA gather+segment-sum, "
                              "the Pallas VMEM-resident CSR kernel, the "
-                             "scatter-free degree-bucketed kernel, or "
+                             "scatter-free degree-bucketed kernel, the "
+                             "hybrid block-dense MXU kernel, or "
                              "auto-select by shard size")
     parser.add_argument("--fused-epochs", "--fused_epochs", type=int,
                         default=1,

@@ -49,7 +49,9 @@ class ModelConfig:
     train_size: int = 0            # global n_train (SyncBN divisor, loss)
     spmm_chunk: Optional[int] = None
     sorted_edges: bool = False     # edge_dst ascending (CSR order)
-    spmm_impl: str = "xla"         # 'xla' | 'pallas' | 'auto'
+    # 'xla' | 'pallas' | 'bucket' | 'block' | 'auto' — must stay in sync
+    # with cli/parser.py --spmm-impl and Trainer._setup_pallas_spmm
+    spmm_impl: str = "xla"
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     @property

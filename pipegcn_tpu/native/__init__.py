@@ -103,9 +103,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(path)
-        except OSError:
+            _declare(lib)
+        except (OSError, AttributeError):
+            # AttributeError: a stale library missing newly-declared
+            # symbols (e.g. built before a source was added) must degrade
+            # to the numpy fallback like every other load failure
             return None
-        _declare(lib)
         _lib = lib
     return _lib
 
@@ -117,12 +120,17 @@ def available() -> bool:
 def _declare(lib: ctypes.CDLL) -> None:
     c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     c_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    c_u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
     lib.pgt_partition.restype = ctypes.c_int
     lib.pgt_partition.argtypes = [
         ctypes.c_int64, c_i64p, c_i32p,          # n, indptr, indices
         ctypes.c_int32, ctypes.c_int,            # n_parts, objective
         ctypes.c_uint64, ctypes.c_double,        # seed, imbalance
         ctypes.c_int, c_i32p,                    # refine_iters, out
+    ]
+    lib.pgt_radix_argsort_u64.restype = ctypes.c_int
+    lib.pgt_radix_argsort_u64.argtypes = [
+        ctypes.c_int64, c_u64p, c_i64p,          # n, keys, out order
     ]
 
 
@@ -155,4 +163,21 @@ def native_partition(
     )
     if rc != 0:
         raise RuntimeError(f"pgt_partition failed with code {rc}")
+    return out
+
+
+def radix_argsort(keys: np.ndarray) -> np.ndarray:
+    """Stable argsort of non-negative integer keys via the native LSD
+    radix sort (halo_builder.cpp) — the fast path for ShardedGraph.build's
+    100M+-edge sorts. Identical permutation to
+    np.argsort(keys, kind='stable'). Raises RuntimeError if the native
+    library is unavailable — callers should check available() first."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    out = np.empty(keys.shape[0], dtype=np.int64)
+    rc = lib.pgt_radix_argsort_u64(keys.shape[0], keys, out)
+    if rc != 0:
+        raise RuntimeError(f"pgt_radix_argsort_u64 failed with code {rc}")
     return out

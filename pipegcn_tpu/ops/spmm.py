@@ -131,15 +131,18 @@ def _spmm_mean_lowp_fwd(fbuf, edge_src, edge_dst, in_deg, n_out, chunk,
     out = _spmm_mean_lowp(fbuf, edge_src, edge_dst, in_deg, n_out, chunk,
                           sorted_edges)
     # zero-size proto carries fbuf's (static) row count and dtype through
-    # the residuals, which must be JAX types
+    # the residuals, which must be JAX types. `out` rides along for the
+    # in_deg cotangent; it is also the input of the layer's following
+    # matmul, whose weight grad retains it anyway, so this adds no memory
     proto = jnp.zeros((fbuf.shape[0], 0), fbuf.dtype)
-    return out, (edge_src, edge_dst, in_deg, proto)
+    return out, (edge_src, edge_dst, in_deg, proto, out)
 
 
 def _spmm_mean_lowp_bwd(n_out, chunk, sorted_edges, res, g):
-    edge_src, edge_dst, in_deg, proto = res
+    edge_src, edge_dst, in_deg, proto, out = res
     n_rows, dt = proto.shape[0], proto.dtype
-    gd = g.astype(jnp.float32) / in_deg[:, None]
+    gf = g.astype(jnp.float32)
+    gd = gf / in_deg[:, None]
     # pad one sentinel row so pad edges (dst == n_out) read zeros; the
     # transpose aggregation is spmm_sum with edge roles swapped (f32
     # accumulation; pad edges then scatter harmless zeros into row 0,
@@ -147,10 +150,15 @@ def _spmm_mean_lowp_bwd(n_out, chunk, sorted_edges, res, g):
     gd = jnp.concatenate([gd, jnp.zeros((1, gd.shape[-1]), jnp.float32)])
     d_fbuf = spmm_sum(gd, edge_dst, edge_src, n_rows, chunk,
                       sorted_edges=False)
+    # d(s/deg)/d(deg) = -s/deg^2 = -out/deg, contracted over features —
+    # the f32 path autodiffs this; the two paths must agree (degrees are
+    # normally data, but differentiating through them must not silently
+    # yield zeros)
+    d_in_deg = -jnp.sum(out.astype(jnp.float32) * gf, axis=-1) / in_deg
     ft0 = jax.dtypes.float0
     zint = lambda a: np.zeros(a.shape, ft0)
     return (d_fbuf.astype(dt), zint(edge_src), zint(edge_dst),
-            jnp.zeros_like(in_deg))
+            d_in_deg.astype(in_deg.dtype))
 
 
 _spmm_mean_lowp.defvjp(_spmm_mean_lowp_fwd, _spmm_mean_lowp_bwd)

@@ -210,6 +210,8 @@ class Trainer:
             arrs.update(self._pallas_tables)
         if self._bucket_tables is not None:
             arrs.update(self._bucket_tables)
+        if self._block_tables is not None:
+            arrs.update(self._block_tables)
         return {
             k: jax.device_put(jnp.asarray(v), self._shard)
             for k, v in arrs.items()
@@ -295,6 +297,8 @@ class Trainer:
         momentum = tcfg.corr_momentum
         use_pallas = self._pallas_tables is not None
         use_bucket = self._bucket_tables is not None
+        use_block = self._block_tables is not None
+        block_tile = self._block_tile
         pallas_max_e = self._pallas_max_e
         pallas_interp = getattr(self, "_pallas_interpret", False)
 
@@ -363,6 +367,13 @@ class Trainer:
 
                 spmm_fn = make_device_bucket_spmm_fn(
                     d, d["in_deg"], n_max + H,
+                    chunk_edges=cfg.spmm_chunk,
+                )
+            elif use_block:
+                from ..ops.block_spmm import make_device_block_spmm_fn
+
+                spmm_fn = make_device_block_spmm_fn(
+                    d, d["in_deg"], n_max, n_max + H, block_tile,
                     chunk_edges=cfg.spmm_chunk,
                 )
 

@@ -1,0 +1,123 @@
+"""Hybrid block-dense SpMM: unit parity vs dense reference (dense tiles
+AND sparse remainder exercised), gradient parity vs the XLA path, and
+trainer-level parity vs gather+segment-sum."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.ops.block_spmm import (
+    BlockPlan,
+    make_block_spmm_fn,
+    plan_to_arrays,
+)
+from pipegcn_tpu.ops.spmm import spmm_mean
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+
+@pytest.fixture(scope="module")
+def edges():
+    rng = np.random.default_rng(9)
+    n_out, n_src = 96, 130
+    e = 1200
+    src = rng.integers(0, n_src, e).astype(np.int64)
+    dst = rng.integers(0, n_out, e).astype(np.int64)
+    # concentrate edges into one (dst-tile, src-tile) block so the dense
+    # path has real work at tile=16
+    dst[:300] = rng.integers(0, 16, 300)
+    src[:300] = rng.integers(16, 32, 300)
+    mask = dst != 5  # row 5 has no edges
+    return src[mask], dst[mask], n_out, n_src
+
+
+def _ref_mean(src, dst, n_out, fbuf, deg):
+    out = np.zeros((n_out, fbuf.shape[1]), np.float32)
+    for s, d in zip(src, dst):
+        out[d] += np.asarray(fbuf, np.float32)[s]
+    return out / np.asarray(deg)[:, None]
+
+
+def _make_fn(src, dst, n_out, n_src, deg, tile, nnz_threshold):
+    plan = BlockPlan(src, dst, n_out, n_src, n_feat=8, tile=tile,
+                     nnz_threshold=nnz_threshold)
+    arrs = {k: jnp.asarray(v) for k, v in plan_to_arrays(plan).items()}
+    return plan, make_block_spmm_fn(arrs, deg, n_out, n_src, tile)
+
+
+@pytest.mark.parametrize("nnz_threshold", [4, 10**9])
+def test_block_mean_matches_dense(edges, nnz_threshold):
+    """Low threshold → dense tiles carry most edges; huge threshold →
+    everything goes through the remainder (bucket) path. Both must agree
+    with the dense reference."""
+    src, dst, n_out, n_src = edges
+    rng = np.random.default_rng(0)
+    fbuf = rng.standard_normal((n_src, 8)).astype(np.float32)
+    deg = jnp.asarray(
+        np.maximum(np.bincount(dst, minlength=n_out), 1).astype(np.float32)
+    )
+    plan, fn = _make_fn(src, dst, n_out, n_src, deg, 16, nnz_threshold)
+    if nnz_threshold == 4:
+        assert plan.a_blocks.shape[0] > 0  # dense path actually exercised
+        assert plan.rem_count < src.shape[0]
+    else:
+        assert plan.a_blocks.shape[0] == 0
+    out = fn(jnp.asarray(fbuf))
+    np.testing.assert_allclose(
+        np.asarray(out), _ref_mean(src, dst, n_out, fbuf, deg),
+        rtol=1e-5, atol=1e-5)
+    assert np.abs(np.asarray(out)[5]).max() == 0.0  # zero-degree row
+
+
+def test_block_fn_grad_matches_reference(edges):
+    src, dst, n_out, n_src = edges
+    rng = np.random.default_rng(2)
+    fbuf = jnp.asarray(rng.standard_normal((n_src, 8)).astype(np.float32))
+    deg = jnp.asarray(
+        np.maximum(np.bincount(dst, minlength=n_out), 1).astype(np.float32)
+    )
+    _, fn = _make_fn(src, dst, n_out, n_src, deg, 16, 4)
+    order = np.argsort(dst, kind="stable")
+    es = jnp.asarray(src[order].astype(np.int32))
+    ed = jnp.asarray(dst[order].astype(np.int32))
+
+    v_a, g_a = jax.value_and_grad(lambda f: (fn(f) ** 2).sum())(fbuf)
+    v_b, g_b = jax.value_and_grad(
+        lambda f: (spmm_mean(f, es, ed, deg, n_out, None, True) ** 2).sum()
+    )(fbuf)
+    np.testing.assert_allclose(float(v_a), float(v_b), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_a), np.asarray(g_b),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_block_matches_xla():
+    g = synthetic_graph(num_nodes=300, avg_degree=7, n_feat=10, n_class=4,
+                        seed=21)
+    parts = partition_graph(g, 4, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4)
+    losses = {}
+    for impl in ("xla", "block"):
+        cfg = ModelConfig(layer_sizes=(10, 16, 4), norm="layer",
+                          dropout=0.0, train_size=sg.n_train_global,
+                          spmm_impl=impl)
+        t = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True))
+        losses[impl] = [t.train_epoch(e) for e in range(6)]
+    np.testing.assert_allclose(losses["xla"], losses["block"], rtol=2e-4)
+
+
+def test_trainer_block_bf16_fused():
+    g = synthetic_graph(num_nodes=300, avg_degree=7, n_feat=10, n_class=4,
+                        seed=23)
+    parts = partition_graph(g, 4, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4)
+    cfg = ModelConfig(layer_sizes=(10, 16, 16, 4), norm="layer",
+                      dropout=0.2, train_size=sg.n_train_global,
+                      spmm_impl="block", dtype="bfloat16", use_pp=True)
+    t = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True,
+                                     feat_corr=True, grad_corr=True))
+    losses = list(t.train_epochs(0, 4)) + list(t.train_epochs(4, 16))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])

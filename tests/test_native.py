@@ -90,3 +90,38 @@ def test_python_fallback_when_disabled(graph, monkeypatch):
 def test_single_partition(graph):
     parts = _native_parts(graph, 1)
     assert np.array_equal(parts, np.zeros(graph.num_nodes, np.int32))
+
+
+def test_radix_argsort_matches_numpy_stable():
+    rng = np.random.default_rng(11)
+    for n, hi in ((0, 10), (1, 1), (1000, 50), (100_000, 2**40)):
+        keys = rng.integers(0, hi, n, dtype=np.int64)
+        got = native.radix_argsort(keys.astype(np.uint64))
+        want = np.argsort(keys, kind="stable")
+        assert np.array_equal(got, want), f"n={n} hi={hi}"
+
+
+def test_build_native_sort_matches_numpy(graph, monkeypatch):
+    """ShardedGraph.build must produce bit-identical artifacts with the
+    native radix sort and the numpy fallback (sorts are both stable on
+    the same fused keys)."""
+    from pipegcn_tpu.partition import ShardedGraph, partition_graph
+    from pipegcn_tpu.partition import halo as halo_mod
+
+    parts = partition_graph(graph, 4, seed=0)
+
+    # force the native path even below the size cutoff
+    real = halo_mod._stable_argsort
+    monkeypatch.setattr(
+        halo_mod, "_stable_argsort",
+        lambda k: native.radix_argsort(k.astype(np.uint64)))
+    sg_native = ShardedGraph.build(graph, parts, n_parts=4)
+    monkeypatch.setattr(
+        halo_mod, "_stable_argsort",
+        lambda k: np.argsort(k, kind="stable"))
+    sg_numpy = ShardedGraph.build(graph, parts, n_parts=4)
+    monkeypatch.setattr(halo_mod, "_stable_argsort", real)
+
+    for name in ShardedGraph._ARRAYS:
+        assert np.array_equal(getattr(sg_native, name),
+                              getattr(sg_numpy, name)), name
