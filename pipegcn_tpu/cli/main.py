@@ -234,6 +234,8 @@ def run(args) -> dict:
         checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir or None,
         measure_comm_cost=True,
+        sharded_eval=args.sharded_eval,
+        async_eval=not args.sync_eval,
     )
 
     result = {

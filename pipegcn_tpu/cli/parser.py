@@ -132,4 +132,13 @@ def create_parser() -> argparse.ArgumentParser:
                         default="",
                         help="write a jax.profiler trace of a few epochs "
                              "to this directory (TensorBoard format)")
+    parser.add_argument("--sharded-eval", "--sharded_eval",
+                        action="store_true",
+                        help="evaluate through the training mesh instead "
+                             "of one device (for graphs larger than a "
+                             "single device's memory)")
+    parser.add_argument("--sync-eval", "--sync_eval", action="store_true",
+                        help="block the epoch loop on each evaluation "
+                             "instead of the default async dispatch+"
+                             "harvest (reference-thread analogue)")
     return parser

@@ -169,7 +169,15 @@ def _sync_batch_norm_train(h, scale, bias, state, whole_size, psum,
     sum_x = psum(hm.sum(axis=0))
     sum_x2 = psum((hm * hm).sum(axis=0))
     mean = sum_x / whole_size
-    var = (sum_x2 - mean * sum_x) / whole_size
+    # Robustness deviation: the reference divides by the global TRAIN
+    # size while summing over ALL local rows (sync_bn.py:19-20 with
+    # model.py:38's train_size) — fine inductively (rows == train
+    # nodes), but transductively rows > whole_size overscales `mean`,
+    # and sum_x2 - mean*sum_x can then go NEGATIVE -> rsqrt(neg) -> NaN
+    # (unexercised in the reference: no script selects --norm batch).
+    # Clamping to >= 0 preserves exact parity whenever the reference
+    # formula is well-posed and keeps training finite where it isn't.
+    var = jnp.maximum((sum_x2 - mean * sum_x) / whole_size, 0.0)
     new_state = {
         "mean": state["mean"] * (1 - momentum) + mean * momentum,
         "var": state["var"] * (1 - momentum) + var * momentum,
@@ -208,6 +216,7 @@ def forward(
     eval_pp_agg: bool = False,
     row_mask: Optional[jax.Array] = None,
     spmm_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
+    halo_eval: bool = False,
 ) -> Tuple[jax.Array, List[dict]]:
     """Run the GraphSAGE stack; returns (logits [n_dst, n_class],
     updated norm_state).
@@ -222,6 +231,15 @@ def forward(
     dropout, running stats for BN. `eval_pp_agg=True` makes the first
     layer compute concat(feat, ah) @ W (use_pp eval path,
     module/layer.py:58-60).
+
+    Sharded eval (`training=False, halo_eval=True`): the reference
+    evaluates the full graph on one host (train.py:20-61); this mode
+    instead evaluates through the partitioned layout — `comm_update`
+    provides the synchronous halo exchange (no staleness), the feature
+    input is the per-device shard (under use_pp: the precomputed concat,
+    so layer 0 is a plain dense like in training) — with eval semantics
+    everywhere else (no dropout, BN running stats). No single device
+    ever materializes the full graph.
     """
     norm_state = norm_state if norm_state is not None else []
     new_norm_state: List[dict] = []
@@ -248,10 +266,10 @@ def forward(
         if training and cfg.dropout > 0:
             rng, sub = jax.random.split(rng)
         if is_graph:
-            if training:
+            if training or halo_eval:
                 if (i > 0 or not cfg.use_pp) and comm_update is not None:
                     h = comm_update(i, h)
-                if cfg.dropout > 0:
+                if training and cfg.dropout > 0:
                     h = _dropout(sub, h, cfg.dropout)
                 lp = params["layers"][i]
                 if cfg.use_pp and i == 0:

@@ -1,6 +1,7 @@
 from .mesh import make_mesh, PARTS_AXIS
 from .halo import halo_exchange, exchange_blocks, return_blocks, make_stale_concat
 from .trainer import Trainer, TrainConfig
+from .evaluator import ShardedEvaluator
 
 __all__ = [
     "make_mesh",
@@ -11,4 +12,5 @@ __all__ = [
     "make_stale_concat",
     "Trainer",
     "TrainConfig",
+    "ShardedEvaluator",
 ]

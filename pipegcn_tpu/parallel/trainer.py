@@ -110,6 +110,7 @@ class Trainer:
         }
         self._step = self._build_step()
         self._eval_cache: Dict[int, Any] = {}
+        self._sharded_eval_cache: Dict[int, Any] = {}
 
         @partial(jax.jit, static_argnames=("n",))
         def _eval_run(params, norm, feat, es, ed, deg, n):
@@ -259,11 +260,16 @@ class Trainer:
 
     # ---------------- pp precompute -----------------------------------
 
-    def _precompute_pp(self) -> jax.Array:
+    def _precompute_pp(self, sg=None, data=None) -> jax.Array:
         """One-time halo exchange + mean aggregation of raw features,
         stored as concat([feat, mean_neigh]) so layer 0 needs no
-        training-time communication (reference train.py:169-189)."""
-        sg = self.sg
+        training-time communication (reference train.py:169-189).
+
+        Defaults to the trainer's own sharded graph/data; an explicit
+        (sg, data) pair computes the same concat for another graph on the
+        same mesh (the sharded evaluator's use_pp input)."""
+        sg = sg if sg is not None else self.sg
+        data = data if data is not None else self.data
         n_max = sg.n_max
 
         def pp(feat, edge_src, edge_dst, in_deg, send_idx, send_mask):
@@ -281,7 +287,7 @@ class Trainer:
                 in_specs=(spec,) * 6, out_specs=spec,
             )
         )
-        d = self.data
+        d = data
         return fn(d["feat"], d["edge_src"], d["edge_dst"], d["in_deg"],
                   d["send_idx"], d["send_mask"])
 
@@ -530,6 +536,8 @@ class Trainer:
         checkpoint_every: int = 100,
         profile_dir: Optional[str] = None,
         measure_comm_cost: bool = False,
+        sharded_eval: bool = False,
+        async_eval: bool = True,
     ) -> Dict[str, Any]:
         """The single epoch loop (reference train.py:327-400): periodic
         evaluation, best-val/BN-stats tracking, timing with <5-epoch
@@ -539,7 +547,20 @@ class Trainer:
         periodic checkpointing.
 
         `eval_graphs` maps split name -> (graph, mask key); must contain
-        'val' (and usually 'test')."""
+        'val' (and usually 'test').
+
+        `async_eval=True` (default) keeps evaluation off the critical
+        path the way the reference's background eval thread does
+        (train.py:327-328, 377-389): the eval computation is dispatched
+        (with a device-side snapshot of params/BN stats) and its scalar
+        is harvested at the NEXT log boundary, so the epoch loop never
+        blocks on eval. Log lines/history for epoch e therefore appear
+        one log period later; best-val tracking uses the snapshot, like
+        the reference's deep-copied model (train.py:383).
+
+        `sharded_eval=True` evaluates through the training mesh
+        (parallel/evaluator.py) instead of one device — required when
+        the full eval graph exceeds a single device's memory."""
         from ..utils.checkpoint import save_checkpoint
         from ..utils.timer import CommTimer
 
@@ -548,6 +569,63 @@ class Trainer:
         durs = []
         eval_durs = []
         history = []
+        pending = None  # dispatched-but-unharvested evaluation
+
+        def _dispatch_eval(at_epoch, at_loss, at_dur):
+            handles = {}
+            for split in ("val",) if (inductive or not reference_logs) \
+                    else ("val", "test"):
+                if split in eval_graphs:
+                    g, mask = eval_graphs[split]
+                    handles[split] = self.eval_dispatch(
+                        g, mask, sharded=sharded_eval)
+            if async_eval:
+                # device-side copies: best-val harvesting needs the
+                # params AS OF dispatch time (the reference deep-copies
+                # the model into its eval thread, train.py:383)
+                snap_p = jax.tree_util.tree_map(
+                    jnp.copy, self.state["params"])
+                snap_n = jax.tree_util.tree_map(jnp.copy, self.state["norm"])
+            else:
+                snap_p, snap_n = self.state["params"], self.state["norm"]
+            return {"epoch": at_epoch, "loss": at_loss, "dur": at_dur,
+                    "handles": handles, "snap_p": snap_p, "snap_n": snap_n}
+
+        def _harvest_eval(p):
+            nonlocal best_val, best_params, best_norm, best_epoch
+            # plain perf_counter: CommTimer keys are once-per-epoch and a
+            # boundary can harvest AND run a sync eval in one iteration
+            t0 = time.perf_counter()
+            acc = self.eval_finish(p["handles"]["val"])
+            eval_durs.append(time.perf_counter() - t0)
+            e = p["epoch"]
+            if reference_logs:
+                if inductive:
+                    # reference evaluate_induc format (:33-39)
+                    buf = "Epoch {:05d} | Accuracy {:.2%}".format(e, acc)
+                else:
+                    # reference evaluate_trans format (:54-60)
+                    t_acc = self.eval_finish(p["handles"]["test"])
+                    buf = ("Epoch {:05d} | Validation Accuracy "
+                           "{:.2%} | Test Accuracy {:.2%}".format(
+                               e, acc, t_acc))
+                if result_file:
+                    with open(result_file, "a+") as f:
+                        f.write(buf + "\n")
+                log_fn(buf)
+            else:
+                log_fn(f"Epoch {e + 1:05d} | Time(s) "
+                       f"{np.mean(durs or [p['dur']]):.4f} | Loss "
+                       f"{p['loss']:.4f} | Val {acc:.4f}")
+            history.append((e + 1, p["loss"], acc))
+            if acc > best_val:
+                best_val = acc
+                best_epoch = e + 1
+                # snapshot BN running stats with the params (the
+                # reference deep-copies the whole model incl. buffers,
+                # train.py:383)
+                best_params = jax.device_get(p["snap_p"])
+                best_norm = jax.device_get(p["snap_n"])
         comm_cost = {"comm": 0.0, "reduce": 0.0}
         comm_measured = False
         timer = CommTimer()
@@ -565,6 +643,9 @@ class Trainer:
 
         epoch = start_epoch
         seen_chunks = set()  # scan lengths already compiled
+        # True while a dispatched-but-unfinished eval occupies the device
+        # stream (its time would contaminate the next block's timing)
+        eval_in_stream = False
         while epoch < n_epochs:
             if profile_dir and not profiling and \
                     epoch >= min(start_epoch + 6, n_epochs - 1):
@@ -590,15 +671,19 @@ class Trainer:
                 log_fn(f"profiler trace written to {profile_dir}")
             # first 5 epochs after (re)start excluded from averaged
             # timings — they include jit compilation (the reference
-            # excludes epochs <5 and log epochs, train.py:364; here eval
-            # runs outside the timed span so log epochs don't need
-            # excluding). A chunk length seen for the first time also
-            # compiles (one scan program per distinct length) — exclude
-            # that block from the averages too.
+            # excludes epochs <5 and log epochs, train.py:364). A chunk
+            # length seen for the first time also compiles (one scan
+            # program per distinct length) — exclude that block too. And
+            # a block right after an async eval dispatch waits on the
+            # eval's device time (enqueued ahead of it on the same
+            # stream), so exclude it as well — the reference's Time(s)
+            # likewise excludes eval (it runs on the CPU thread).
             first_of_len = chunk not in seen_chunks
             seen_chunks.add(chunk)
-            if epoch >= start_epoch + 5 and not first_of_len:
+            if epoch >= start_epoch + 5 and not first_of_len \
+                    and not eval_in_stream:
                 durs.extend([dur] * chunk)
+            eval_in_stream = False
             epoch += chunk - 1  # body below sees the block's last epoch
             if measure_comm_cost and not comm_measured and \
                     epoch >= min(start_epoch + 5, n_epochs - 1):
@@ -621,39 +706,15 @@ class Trainer:
             if (epoch + 1) % tcfg.log_every == 0:
                 do_eval = tcfg.eval and eval_graphs and "val" in eval_graphs
                 if do_eval:
-                    g, mask = eval_graphs["val"]
-                    with timer.timer("eval"):
-                        acc = self.evaluate(g, mask)
-                    eval_durs.append(timer.durations()["eval"])
-                    if reference_logs:
-                        if inductive:
-                            # reference evaluate_induc format (:33-39)
-                            buf = "Epoch {:05d} | Accuracy {:.2%}".format(
-                                epoch, acc)
-                        else:
-                            # reference evaluate_trans format (:54-60)
-                            tg, tmask = eval_graphs["test"]
-                            t_acc = self.evaluate(tg, tmask)
-                            buf = ("Epoch {:05d} | Validation Accuracy "
-                                   "{:.2%} | Test Accuracy {:.2%}".format(
-                                       epoch, acc, t_acc))
-                        if result_file:
-                            with open(result_file, "a+") as f:
-                                f.write(buf + "\n")
-                        log_fn(buf)
+                    if pending is not None:
+                        _harvest_eval(pending)
+                        pending = None
+                    p = _dispatch_eval(epoch, loss, dur)
+                    if async_eval:
+                        pending = p
+                        eval_in_stream = True
                     else:
-                        log_fn(f"Epoch {epoch + 1:05d} | Time(s) "
-                               f"{np.mean(durs or [dur]):.4f} | Loss "
-                               f"{loss:.4f} | Val {acc:.4f}")
-                    history.append((epoch + 1, loss, acc))
-                    if acc > best_val:
-                        best_val = acc
-                        best_epoch = epoch + 1
-                        # snapshot BN running stats with the params (the
-                        # reference deep-copies the whole model incl.
-                        # buffers, train.py:383)
-                        best_params = jax.device_get(self.state["params"])
-                        best_norm = jax.device_get(self.state["norm"])
+                        _harvest_eval(p)
                 else:
                     history.append((epoch + 1, loss, None))
                     if not reference_logs:
@@ -665,6 +726,11 @@ class Trainer:
                 save_checkpoint(checkpoint_dir,
                                 jax.device_get(self.state), epoch + 1)
             epoch += 1
+
+        if pending is not None:
+            # harvest the final in-flight evaluation
+            _harvest_eval(pending)
+            pending = None
 
         if profiling:
             # run ended inside the trace window; finalize the trace
@@ -684,6 +750,9 @@ class Trainer:
             # per-epoch time (compile-inclusive) rather than None
             "epoch_time": float(np.mean(durs)) if durs
             else (dur if n_epochs > start_epoch else None),
+            # async mode: mean EXPOSED harvest wait (the eval's device
+            # time hides behind subsequent epochs); sync mode: full eval
+            # wall-clock like the reference's evaluate() span
             "eval_time": float(np.mean(eval_durs)) if eval_durs else None,
             "comm_cost": comm_cost if comm_measured else None,
             "history": history,
@@ -692,7 +761,8 @@ class Trainer:
                 best_params is not None:
             g, mask = eval_graphs["test"]
             result["test_acc"] = self.evaluate(g, mask, params=best_params,
-                                               norm=best_norm)
+                                               norm=best_norm,
+                                               sharded=sharded_eval)
         return result
 
     # ---------------- cost analysis -----------------------------------
@@ -798,9 +868,56 @@ class Trainer:
     # ---------------- evaluation --------------------------------------
 
     def evaluate(self, g: Graph, mask_key: str, params=None,
-                 norm=None) -> float:
-        """Full-graph eval on one device (reference evaluates the full
-        graph on rank 0's CPU, train.py:20-61; we use the accelerator)."""
+                 norm=None, sharded: bool = False) -> float:
+        """Evaluate `g` and block for the scalar.
+
+        sharded=False: full-graph eval on one device (reference evaluates
+        the full graph on rank 0's CPU, train.py:20-61; we use the
+        accelerator). sharded=True: partition-parallel eval through the
+        training mesh (parallel/evaluator.py) — use for graphs too big
+        for one device."""
+        return self.eval_finish(
+            self.eval_dispatch(g, mask_key, params, norm, sharded))
+
+    def eval_dispatch(self, g: Graph, mask_key: str, params=None,
+                      norm=None, sharded: bool = False):
+        """Start an evaluation WITHOUT blocking (jax async dispatch);
+        returns an opaque handle for eval_finish. The computation is
+        enqueued on the devices before any subsequent train step, so
+        later buffer donation cannot race it."""
+        if params is None:
+            params = self.state["params"]
+        if norm is None:
+            norm = self.state["norm"]
+        if sharded:
+            ev = self._get_sharded_evaluator(g)
+            return ("sharded", ev, ev.counts(mask_key, params, norm))
+        c = self._full_eval_cache(g)
+        logits = self._eval_run(params, norm, c["feat"], c["edge_src"],
+                                c["edge_dst"], c["in_deg"], c["n"])
+        return ("full", c, logits, mask_key)
+
+    def eval_finish(self, handle) -> float:
+        """Resolve a dispatched evaluation to its scalar metric (blocks
+        only if the device computation hasn't completed yet)."""
+        if handle[0] == "sharded":
+            _, ev, counts = handle
+            return ev.finish(counts)
+        _, c, logits, mask_key = handle
+        logits = np.asarray(logits)
+        m = np.asarray(c["graph"].ndata[mask_key])
+        return calc_acc(logits[m], c["label"][m])
+
+    def _get_sharded_evaluator(self, g: Graph):
+        from .evaluator import ShardedEvaluator
+
+        key = id(g)
+        if key not in self._sharded_eval_cache:
+            self._sharded_eval_cache[key] = (
+                ShardedEvaluator.for_graph(self, g), g)
+        return self._sharded_eval_cache[key][0]
+
+    def _full_eval_cache(self, g: Graph):
         key = id(g)
         if key not in self._eval_cache:
             n = g.num_nodes
@@ -817,14 +934,4 @@ class Trainer:
                 ),
                 "n": n,
             }
-        c = self._eval_cache[key]
-        if params is None:
-            params = self.state["params"]
-        if norm is None:
-            norm = self.state["norm"]
-        logits = np.asarray(
-            self._eval_run(params, norm, c["feat"], c["edge_src"],
-                           c["edge_dst"], c["in_deg"], c["n"])
-        )
-        m = np.asarray(g.ndata[mask_key])
-        return calc_acc(logits[m], c["label"][m])
+        return self._eval_cache[key]

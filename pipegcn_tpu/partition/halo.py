@@ -92,9 +92,21 @@ class ShardedGraph:
     in_deg: np.ndarray        # [P, N_max] float32 (padding rows 1.0)
     global_nid: np.ndarray    # [P, N_max] int64 (padding rows -1)
 
+    # wraparound-uint64 checksum of the source graph's global edge list
+    # (identifies "is this sharded graph built from exactly graph g?" —
+    # node-ID cover alone can't distinguish graphs sharing a node set);
+    # -1 in artifacts saved before the field existed
+    source_edge_checksum: int = -1
+
     @property
     def halo_size(self) -> int:
         return (self.num_parts - 1) * self.b_max
+
+    @staticmethod
+    def edge_checksum(g: Graph) -> int:
+        fused = np.multiply(g.src.astype(np.uint64),
+                            np.uint64(g.num_nodes)) + g.dst.astype(np.uint64)
+        return int(fused.sum(dtype=np.uint64))
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -292,6 +304,7 @@ class ShardedGraph:
             test_mask=sm,
             in_deg=in_deg,
             global_nid=gnid,
+            source_edge_checksum=ShardedGraph.edge_checksum(g),
         )
 
     # ------------------------------------------------------------------
@@ -320,6 +333,7 @@ class ShardedGraph:
             "n_feat": self.n_feat,
             "n_class": self.n_class,
             "multilabel": self.multilabel,
+            "source_edge_checksum": self.source_edge_checksum,
         }
         # arrays first, manifest last: exists() keys off the manifest, so
         # a reader polling a shared filesystem (multi-host prepare) never

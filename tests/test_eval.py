@@ -1,0 +1,150 @@
+"""Sharded (mesh-parallel) and asynchronous evaluation.
+
+The reference evaluates the full graph in a rank-0 background thread
+(train.py:327-328, 377-389); here eval can run through the training
+shard_map (no device holds the full graph) and is dispatched
+asynchronously by fit(). These tests pin: sharded == single-device eval
+on the same params (transductive reuse AND a freshly-partitioned eval
+graph, incl. use_pp and multilabel), and async fit == sync fit.
+"""
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.graph.datasets import inductive_split
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+
+def _trainer(g, n_parts=4, use_pp=False, norm="layer", dtype="float32",
+             multilabel=False, pipeline=True, seed=3):
+    parts = partition_graph(g, n_parts, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=n_parts)
+    n_out = sg.n_class
+    cfg = ModelConfig(
+        layer_sizes=(sg.n_feat, 16, 16, n_out), norm=norm, dropout=0.0,
+        train_size=sg.n_train_global, use_pp=use_pp, dtype=dtype,
+    )
+    return Trainer(sg, cfg, TrainConfig(seed=seed,
+                                        enable_pipeline=pipeline))
+
+
+def test_sharded_eval_matches_full_transductive():
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
+                        seed=31)
+    t = _trainer(g)
+    for e in range(4):
+        t.train_epoch(e)
+    for mask in ("val_mask", "test_mask"):
+        full = t.evaluate(g, mask)
+        sharded = t.evaluate(g, mask, sharded=True)
+        assert full == pytest.approx(sharded, abs=1e-9), mask
+    # transductive: the evaluator must have reused the trainer's arrays
+    ev = t._get_sharded_evaluator(g)
+    assert ev.sg is t.sg and ev.data["feat"] is t.data["feat"]
+
+
+def test_sharded_eval_matches_full_use_pp_and_batchnorm():
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
+                        seed=32)
+    t = _trainer(g, use_pp=True, norm="batch")
+    for e in range(4):
+        t.train_epoch(e)
+    full = t.evaluate(g, "val_mask")
+    sharded = t.evaluate(g, "val_mask", sharded=True)
+    assert full == pytest.approx(sharded, abs=1e-9)
+
+
+def test_sharded_eval_fresh_graph_inductive():
+    """Eval graphs that differ from the training partitions (inductive
+    val/test) must be partitioned + built on the mesh; results match
+    single-device eval."""
+    g = synthetic_graph(num_nodes=500, avg_degree=8, n_feat=12, n_class=5,
+                        seed=33)
+    train_g, val_g, test_g = inductive_split(g)
+    t = _trainer(train_g, use_pp=True)
+    for e in range(4):
+        t.train_epoch(e)
+    for eg, mask in ((val_g, "val_mask"), (test_g, "test_mask")):
+        full = t.evaluate(eg, mask)
+        sharded = t.evaluate(eg, mask, sharded=True)
+        assert full == pytest.approx(sharded, abs=1e-9)
+        ev = t._get_sharded_evaluator(eg)
+        assert ev.sg is not t.sg  # really rebuilt
+
+
+def test_sharded_eval_same_nodes_different_edges_rebuilds():
+    """A graph sharing the training graph's node set but with different
+    edges must NOT silently reuse the trainer's arrays (the edge
+    checksum, not just the node cover, gates the fast path)."""
+    from pipegcn_tpu.graph.csr import Graph, finalize
+
+    g = synthetic_graph(num_nodes=300, avg_degree=8, n_feat=12, n_class=5,
+                        seed=37)
+    t = _trainer(g)
+    t.train_epoch(0)
+    # same nodes/features/labels, edges rewired
+    rng = np.random.default_rng(1)
+    g2 = Graph(src=rng.integers(0, 300, 1200),
+               dst=rng.integers(0, 300, 1200),
+               num_nodes=300, ndata={k: v for k, v in g.ndata.items()})
+    g2 = finalize(g2)
+    sharded = t.evaluate(g2, "val_mask", sharded=True)
+    full = t.evaluate(g2, "val_mask")
+    assert full == pytest.approx(sharded, abs=1e-9)
+    assert t._get_sharded_evaluator(g2).sg is not t.sg
+
+
+def test_sharded_eval_multilabel_micro_f1():
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=6,
+                        multilabel=True, seed=34)
+    t = _trainer(g, multilabel=True)
+    for e in range(3):
+        t.train_epoch(e)
+    full = t.evaluate(g, "val_mask")
+    sharded = t.evaluate(g, "val_mask", sharded=True)
+    assert full == pytest.approx(sharded, abs=1e-9)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_async_fit_matches_sync(sharded):
+    """fit() with async eval must produce the same history accuracies,
+    best val and test acc as blocking eval (same seeds -> same params at
+    every dispatch point); only log timing differs."""
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
+                        seed=35, train_frac=0.3)
+    eval_graphs = {"val": (g, "val_mask"), "test": (g, "test_mask")}
+    results = {}
+    for async_eval in (False, True):
+        t = _trainer(g)
+        t.tcfg = TrainConfig(seed=3, enable_pipeline=True, n_epochs=12,
+                             log_every=4)
+        results[async_eval] = t.fit(
+            eval_graphs, log_fn=lambda *_: None,
+            sharded_eval=sharded, async_eval=async_eval,
+        )
+    a, b = results[False], results[True]
+    assert [h[2] for h in a["history"]] == [h[2] for h in b["history"]]
+    assert a["best_val"] == b["best_val"]
+    assert a["best_epoch"] == b["best_epoch"]
+    assert a.get("test_acc") == b.get("test_acc")
+
+
+def test_async_eval_does_not_block_loop():
+    """The dispatch at a log boundary must return without waiting for
+    the eval computation (jax async dispatch): the step timer never
+    includes eval work. Structural check: pending harvests lag by one
+    boundary and the final pending is flushed."""
+    g = synthetic_graph(num_nodes=300, avg_degree=6, n_feat=10, n_class=4,
+                        seed=36)
+    t = _trainer(g)
+    t.tcfg = TrainConfig(seed=3, enable_pipeline=True, n_epochs=9,
+                         log_every=3)
+    seen = []
+    res = t.fit({"val": (g, "val_mask"), "test": (g, "test_mask")},
+                log_fn=lambda m: seen.append(str(m)), async_eval=True)
+    # three boundaries -> three history entries, all with accuracies
+    accs = [h for h in res["history"] if h[2] is not None]
+    assert len(accs) == 3

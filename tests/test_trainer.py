@@ -210,6 +210,19 @@ def test_fused_epochs_match_singles(graph):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
 
 
+def test_batchnorm_transductive_stays_finite(graph):
+    """Transductive SyncBN sums over ALL rows but divides by n_train
+    (reference semantics, sync_bn.py:19-20) — the overscaled mean can
+    make the variance estimate negative; the clamp in
+    _sync_batch_norm_train must keep training finite AND learning."""
+    t = _setup(graph, 4, seed=5, dropout=0.5, norm="batch",
+               enable_pipeline=True)
+    assert t.sg.n_train_global < t.sg.inner_count.sum()  # transductive
+    losses = [t.train_epoch(e) for e in range(20)]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
 def test_fit_with_fused_epochs(graph):
     t = _setup(graph, 4, seed=3, n_epochs=40, log_every=20, hidden=32,
                fused_epochs=8)
