@@ -21,8 +21,6 @@ of the reference's background-thread eval, train.py:327-328, 377-389).
 
 from __future__ import annotations
 
-import dataclasses
-from functools import partial
 from typing import Dict, Optional
 
 import jax
@@ -74,8 +72,7 @@ class ShardedEvaluator:
         # mutating it would retrigger compilation (or crash the pytree
         # structure check)
         self.data = dict(data)
-        cfg = trainer.cfg
-        self._cfg = dataclasses.replace(cfg, sorted_edges=True)
+        self._cfg = trainer.cfg  # already has sorted_edges=True
         P = trainer.P
         n_max = sg.n_max
         multilabel = sg.multilabel

@@ -104,9 +104,16 @@ class ShardedGraph:
 
     @staticmethod
     def edge_checksum(g: Graph) -> int:
-        fused = np.multiply(g.src.astype(np.uint64),
-                            np.uint64(g.num_nodes)) + g.dst.astype(np.uint64)
-        return int(fused.sum(dtype=np.uint64))
+        # splitmix64-mix each fused (src, dst) pair BEFORE the order-free
+        # sum: a plain sum of src*N + dst is linear (N*Σsrc + Σdst) and
+        # collides for any re-pairing of the same endpoints — exactly the
+        # rewired-graph case the checksum must detect
+        x = np.multiply(g.src.astype(np.uint64),
+                        np.uint64(g.num_nodes)) + g.dst.astype(np.uint64)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+        return int(x.sum(dtype=np.uint64))
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -97,6 +97,32 @@ def test_sharded_eval_same_nodes_different_edges_rebuilds():
     assert t._get_sharded_evaluator(g2).sg is not t.sg
 
 
+def test_sharded_eval_rewired_same_sums_rebuilds():
+    """Adversarial checksum case: swap the destinations of two edges —
+    node set, edge COUNT and endpoint SUMS all unchanged, so a linear
+    checksum would collide; the mixed checksum must still force a
+    rebuild."""
+    from pipegcn_tpu.graph.csr import Graph, finalize
+    from pipegcn_tpu.parallel.evaluator import _covers_exactly
+
+    g = synthetic_graph(num_nodes=300, avg_degree=8, n_feat=12, n_class=5,
+                        seed=38)
+    t = _trainer(g)
+    src, dst = g.src.copy(), g.dst.copy()
+    non_loop = np.flatnonzero((src != dst))
+    # pick a pair whose swap neither no-ops nor creates self-loops
+    i = non_loop[0]
+    j = next(j for j in non_loop[::-1]
+             if dst[j] != dst[i] and src[i] != dst[j] and src[j] != dst[i])
+    dst[i], dst[j] = dst[j], dst[i]  # re-pair endpoints
+    g2 = Graph(src=src, dst=dst, num_nodes=g.num_nodes,
+               ndata={k: v for k, v in g.ndata.items()})
+    g2 = finalize(g2)
+    assert g2.num_edges == g.num_edges
+    assert not _covers_exactly(t.sg, g2)
+    assert _covers_exactly(t.sg, g)
+
+
 def test_sharded_eval_multilabel_micro_f1():
     g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=6,
                         multilabel=True, seed=34)

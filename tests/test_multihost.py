@@ -1,0 +1,165 @@
+"""Multi-host launch path (cli/main.py:60-144).
+
+The real multi-host flow — jax.distributed.initialize + process-0-only
+partitioning with peers polling the shared filesystem (the analogue of
+reference main.py:32-59's node_rank-0 partition + spawn) — cannot run in
+a single-host CI, so these tests pin its pieces: the node-count math
+driving initialize(), _await_partition_artifact's success/timeout/
+mismatch behavior, and prepare()'s process-role branches under mocked
+process_count/process_index.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from pipegcn_tpu.cli.main import (
+    _await_partition_artifact,
+    _maybe_init_distributed,
+    prepare,
+)
+from pipegcn_tpu.cli.parser import create_parser
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+
+def _args(tmp_path, extra=()):
+    return create_parser().parse_args([
+        "--dataset", "synthetic:200:6:8:4",
+        "--n-partitions", "2",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--no-eval",
+        *extra,
+    ])
+
+
+def _make_artifact(path, n_parts=2):
+    g = synthetic_graph(num_nodes=200, avg_degree=6, n_feat=8, n_class=4,
+                        seed=0)
+    sg = ShardedGraph.build(g, partition_graph(g, n_parts, seed=0),
+                            n_parts=n_parts)
+    sg.save(path)
+    return sg
+
+
+# ---------------------------------------------------------------------
+# _maybe_init_distributed: n_nodes = ceil(n_partitions / parts_per_node)
+
+def test_distributed_init_called_with_node_math(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    args = create_parser().parse_args([
+        "--dataset", "reddit", "--n-partitions", "40",
+        "--parts-per-node", "10", "--node-rank", "3",
+        "--master-addr", "10.0.0.7", "--port", "18118",
+    ])
+    _maybe_init_distributed(args)
+    assert calls == [{
+        "coordinator_address": "10.0.0.7:18118",
+        "num_processes": 4,
+        "process_id": 3,
+    }]
+
+
+def test_distributed_init_skipped_single_host(monkeypatch):
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: pytest.fail("must not initialize"))
+    args = create_parser().parse_args([
+        "--dataset", "reddit", "--n-partitions", "8",
+        "--parts-per-node", "8",
+    ])
+    _maybe_init_distributed(args)  # 1 node -> no-op
+
+
+def test_distributed_init_rounds_up(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    args = create_parser().parse_args([
+        "--dataset", "reddit", "--n-partitions", "11",
+        "--parts-per-node", "4",
+    ])
+    _maybe_init_distributed(args)
+    assert calls[0]["num_processes"] == 3  # ceil(11/4)
+
+
+# ---------------------------------------------------------------------
+# _await_partition_artifact
+
+def test_await_artifact_already_there(tmp_path):
+    p = str(tmp_path / "art")
+    _make_artifact(p)
+    sg = _await_partition_artifact(p, 2, timeout_s=1.0)
+    assert sg.num_parts == 2
+
+
+def test_await_artifact_appears_late(tmp_path):
+    p = str(tmp_path / "art")
+
+    def writer():
+        time.sleep(0.5)
+        _make_artifact(p)
+
+    th = threading.Thread(target=writer)
+    th.start()
+    sg = _await_partition_artifact(p, 2, timeout_s=30.0, poll_s=0.05)
+    th.join()
+    assert sg.num_parts == 2
+
+
+def test_await_artifact_timeout(tmp_path):
+    with pytest.raises(TimeoutError, match="shared filesystem"):
+        _await_partition_artifact(str(tmp_path / "never"), 2,
+                                  timeout_s=0.2, poll_s=0.05)
+
+
+def test_await_artifact_wrong_parts(tmp_path):
+    p = str(tmp_path / "art")
+    _make_artifact(p, n_parts=2)
+    with pytest.raises(ValueError, match="2 parts, requested 4"):
+        _await_partition_artifact(p, 4, timeout_s=1.0)
+
+
+# ---------------------------------------------------------------------
+# prepare(): process-role branches under mocked process topology
+
+def test_prepare_process0_partitions_and_saves(tmp_path, monkeypatch):
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    args = _args(tmp_path)
+    sg, eval_graphs = prepare(args)
+    assert sg.num_parts == 2
+    assert eval_graphs is None  # --no-eval
+    # artifact saved for the peers to pick up
+    assert ShardedGraph.exists(
+        os.path.join(args.partition_dir, args.graph_name or
+                     "synthetic:200:6:8:4-2-metis-vol-trans"))
+
+
+def test_prepare_nonzero_process_loads_artifact(tmp_path, monkeypatch):
+    """A non-zero process must NEVER partition — it polls for process
+    0's artifact."""
+    art = str(tmp_path / "parts" / "synthetic:200:6:8:4-2-metis-vol-trans")
+    _make_artifact(art)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    import pipegcn_tpu.cli.main as cli_main
+    monkeypatch.setattr(
+        cli_main, "partition_graph",
+        lambda *a, **k: pytest.fail("peer process must not partition"))
+    sg, _ = prepare(_args(tmp_path))
+    assert sg.num_parts == 2
+
+
+def test_prepare_single_process_partitions(tmp_path, monkeypatch):
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    sg, _ = prepare(_args(tmp_path))
+    assert sg.num_parts == 2
+    assert int(sg.inner_count.sum()) == 200
