@@ -35,6 +35,40 @@ import numpy as np
 
 BASELINE_EPOCH_S = 0.266  # reference README.md:93-94 (2x GPU)
 
+# Cap on the wall-clock of ONE device dispatch. The axon tunnel has been
+# observed to kill the TPU worker mid-run under long Execute calls
+# (~80 s fused blocks died; ~20 s single epochs survived), so the bench
+# adaptively drops to single-epoch dispatches when fused blocks would
+# exceed this.
+MAX_DISPATCH_S = 25.0
+
+# Mid-run degradation ladder: when the TPU worker crashes AFTER a good
+# probe (a failure mode round 1's init-only hardening did not cover),
+# the bench re-execs itself one stage down rather than dying with rc=1.
+#   stage 0: as requested
+#   stage 1: minimal sampling (fused=1, 3 blocks, no comparison/sweep)
+#   stage 2: --small smoke scale
+#   stage 3: CPU fallback
+_STAGE_FLAG = "--_stage"
+
+
+def _reexec_degraded(stage: int, reason: str) -> None:
+    delay = min(30.0 * (2 ** stage), 120.0)
+    print(f"# measurement crashed at stage {stage}: {reason}\n"
+          f"# re-exec at stage {stage + 1} in {delay:.0f}s", file=sys.stderr)
+    time.sleep(delay)
+    argv = list(sys.argv)
+    i = 0
+    while i < len(argv):  # strip any previous stage flag (+ value token)
+        if argv[i] == _STAGE_FLAG:
+            del argv[i:i + 2]
+        elif argv[i].startswith(_STAGE_FLAG + "="):
+            del argv[i]
+        else:
+            i += 1
+    os.execv(sys.executable,
+             [sys.executable] + argv + [_STAGE_FLAG, str(stage + 1)])
+
 # peak dense bf16 FLOP/s per chip, by device_kind substring (public specs)
 PEAK_FLOPS = [
     ("v6", 918e12),
@@ -155,7 +189,17 @@ def main():
                          "120/300/600s schedule")
     ap.add_argument("--cpu", action="store_true",
                     help="run on CPU without probing the TPU backend")
+    ap.add_argument(_STAGE_FLAG, type=int, default=0, dest="stage",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.stage >= 1:
+        args.fused, args.blocks = 1, min(args.blocks, 3)
+        args.no_compare, args.sweep_spmm = True, False
+    if args.stage >= 2:
+        args.small = True
+    if args.stage >= 3:
+        args.cpu = True
 
     backend = init_backend(args.probe_tries, args.probe_timeout, args.cpu)
 
@@ -221,6 +265,24 @@ def main():
         print(f"# built partitions ({time.perf_counter()-t0:.1f}s)",
               file=sys.stderr)
 
+    try:
+        _measure(args, backend, device_kind, n_parts, degraded, sg,
+                 hidden, n_layers, spmm_chunk)
+    except Exception as exc:  # noqa: BLE001 — worker crashes arrive as
+        # JaxRuntimeError/RuntimeError/XlaRuntimeError; anything fatal
+        # mid-measurement gets one shot at a degraded re-exec
+        if args.stage >= 3 or backend.startswith("cpu"):
+            raise
+        _reexec_degraded(args.stage, repr(exc)[:300])
+
+
+def _measure(args, backend, device_kind, n_parts, degraded, sg,
+             hidden, n_layers, spmm_chunk):
+    import jax
+
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import Trainer, TrainConfig
+
     cfg = ModelConfig(
         layer_sizes=(sg.n_feat,) + (hidden,) * (n_layers - 1) + (sg.n_class,),
         use_pp=True, norm="layer", dropout=0.5,
@@ -238,43 +300,85 @@ def main():
         )
         return Trainer(sg, cfg, tcfg)
 
-    def time_trainer(trainer, n_blocks: int, warmup_blocks: int = 1):
-        """Median per-epoch time over n_blocks dispatches of blk epochs.
-        At least one warmup block always runs first so compile (and the
-        staleness pipeline fill) never lands in a timed sample."""
+    def time_trainer(trainer, n_blocks: int, warmup_blocks: int = 1,
+                     force_blk: int = 0):
+        """Median per-epoch time over n_blocks dispatches of up-to-blk
+        epochs; returns (median_epoch_s, last_loss, used_blk).
+
+        Warmup always starts with single-epoch dispatches: the first
+        compiles the step, and the next two measure a per-epoch time
+        (min of the two, so one transient hiccup can't flip the
+        decision) used to decide whether fused blocks would exceed
+        MAX_DISPATCH_S per Execute (long dispatches have crashed the
+        tunneled TPU worker); if they would, the timed blocks run
+        unfused. `force_blk` skips the decision and reuses a prior
+        run's block size so two runs being compared are methodologically
+        identical. Warmup never lands in a timed sample."""
         e = 0
 
-        def run_block(e0):
-            if blk == 1:
+        def run_block(e0, k):
+            if k == 1:
                 loss = trainer.train_epoch(e0)
             else:
-                loss = float(trainer.train_epochs(e0, blk)[-1])
+                loss = float(trainer.train_epochs(e0, k)[-1])
             jax.block_until_ready(trainer.state["params"])
             return loss
 
         t0 = time.perf_counter()
-        for _ in range(max(1, warmup_blocks)):
-            run_block(e)
-            e += blk
-        print(f"# warmup/compile ({time.perf_counter()-t0:.1f}s)",
-              file=sys.stderr)
+        run_block(e, 1)
+        e += 1
+        compile_s = time.perf_counter() - t0
+        singles = []
+        for _ in range(2 if blk > 1 and not force_blk else 1):
+            t0 = time.perf_counter()
+            run_block(e, 1)
+            e += 1
+            singles.append(time.perf_counter() - t0)
+        single_s = min(singles)
+        print(f"# warmup: compile+first {compile_s:.1f}s, "
+              f"single epoch {single_s:.2f}s", file=sys.stderr)
+        if force_blk:
+            # reuse the caller's dispatch size, but never past the
+            # dispatch cap: THIS trainer may be much slower than the one
+            # force_blk was derived from (vanilla vs pipelined, sweep
+            # impls), and a long Execute kills the tunneled worker
+            my_blk = force_blk
+            if my_blk > 1 and single_s * my_blk > MAX_DISPATCH_S:
+                my_blk = max(1, int(MAX_DISPATCH_S // max(single_s, 1e-6)))
+                print(f"# forced fused {force_blk} would make "
+                      f"~{single_s * force_blk:.0f}s dispatches; clamping "
+                      f"to {my_blk}", file=sys.stderr)
+        else:
+            my_blk = blk
+            if my_blk > 1 and single_s * my_blk > MAX_DISPATCH_S:
+                my_blk = max(1, int(MAX_DISPATCH_S // max(single_s, 1e-6)))
+                print(f"# fused {blk} would make ~{single_s * blk:.0f}s "
+                      f"dispatches; dropping to fused {my_blk}",
+                      file=sys.stderr)
+        if my_blk > 1:
+            t0 = time.perf_counter()
+            for _ in range(max(1, warmup_blocks)):
+                run_block(e, my_blk)
+                e += my_blk
+            print(f"# fused-block warmup/compile "
+                  f"({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
         times = []
         loss = float("nan")
         for _ in range(n_blocks):
             t0 = time.perf_counter()
-            loss = run_block(e)
-            e += blk
-            times.append((time.perf_counter() - t0) / blk)
-        return float(np.median(times)), loss
+            loss = run_block(e, my_blk)
+            e += my_blk
+            times.append((time.perf_counter() - t0) / my_blk)
+        return float(np.median(times)), loss, my_blk
 
     headline_pipeline = not args.no_pipeline
     t0 = time.perf_counter()
     trainer = build_trainer(headline_pipeline)
     print(f"# trainer setup ({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
 
-    epoch_s, loss = time_trainer(trainer, args.blocks)
+    epoch_s, loss, used_blk = time_trainer(trainer, args.blocks)
     print(f"# median epoch {epoch_s:.4f}s over {args.blocks} blocks of "
-          f"{blk}, final loss {loss:.4f}", file=sys.stderr)
+          f"{used_blk}, final loss {loss:.4f}", file=sys.stderr)
 
     # ---- derived metrics: MFU + bytes (from XLA's own cost model) -----
     extras = {
@@ -288,6 +392,11 @@ def main():
     }
     if degraded:
         extras["degraded"] = True
+    if args.stage > 0:
+        # this run is a crash-recovery re-exec with reduced sampling (and
+        # at stage >= 2, reduced scale) — not comparable to a full run
+        extras["degraded"] = True
+        extras["stage"] = args.stage
     try:
         ca = trainer.step_cost_analysis()
         if ca:
@@ -306,44 +415,58 @@ def main():
         print(f"# cost analysis unavailable: {exc}", file=sys.stderr)
     extras["est_ici_bytes_per_epoch"] = trainer.est_ici_bytes_per_epoch()
 
-    # ---- overlap evidence: pipelined vs vanilla -----------------------
-    if not args.no_compare:
-        del trainer  # free HBM before compiling the second program
-        other = build_trainer(not headline_pipeline)
-        other_s, _ = time_trainer(other, max(3, args.blocks // 2))
-        key = "vanilla_epoch_s" if headline_pipeline else "pipelined_epoch_s"
-        extras[key] = round(other_s, 4)
-        pipe_s = epoch_s if headline_pipeline else other_s
-        van_s = other_s if headline_pipeline else epoch_s
-        extras["pipeline_speedup"] = round(van_s / pipe_s, 3)
-        print(f"# pipelined {pipe_s:.4f}s vs vanilla {van_s:.4f}s "
-              f"(speedup {van_s / pipe_s:.3f}x)", file=sys.stderr)
-        del other
+    # The headline number is in hand from here on: the optional extras
+    # below must never discard it, so a crash there falls through to the
+    # JSON print instead of the stage-degrading re-exec.
+    try:
+        # ---- overlap evidence: pipelined vs vanilla -------------------
+        if not args.no_compare:
+            del trainer  # free HBM before compiling the second program
+            other = build_trainer(not headline_pipeline)
+            # reuse the headline's dispatch size: comparing runs with
+            # different fused-block amortization would contaminate the
+            # speedup with per-dispatch overhead differences
+            other_s, _, _ = time_trainer(other, max(3, args.blocks // 2),
+                                         force_blk=used_blk)
+            key = "vanilla_epoch_s" if headline_pipeline \
+                else "pipelined_epoch_s"
+            extras[key] = round(other_s, 4)
+            pipe_s = epoch_s if headline_pipeline else other_s
+            van_s = other_s if headline_pipeline else epoch_s
+            extras["pipeline_speedup"] = round(van_s / pipe_s, 3)
+            print(f"# pipelined {pipe_s:.4f}s vs vanilla {van_s:.4f}s "
+                  f"(speedup {van_s / pipe_s:.3f}x)", file=sys.stderr)
+            del other
 
-    # ---- optional SpMM implementation sweep ---------------------------
-    if args.sweep_spmm:
-        sweep = {}
-        for impl in ("xla", "bucket", "block", "pallas"):
-            try:
-                t0 = time.perf_counter()
-                tr = Trainer(sg, dataclasses.replace(cfg, spmm_impl=impl),
-                    TrainConfig(lr=0.01, n_epochs=blk * 4,
-                                enable_pipeline=headline_pipeline,
-                                seed=0, eval=False, fused_epochs=blk))
-                s, _ = time_trainer(tr, 3)
-                sweep[impl] = round(s, 4)
-                print(f"# spmm sweep: {impl} {s:.4f}s/epoch "
-                      f"(total {time.perf_counter()-t0:.0f}s)",
-                      file=sys.stderr)
-                del tr
-            except Exception as exc:
-                sweep[impl] = None
-                print(f"# spmm sweep: {impl} failed: {exc}",
-                      file=sys.stderr)
-        extras["spmm_sweep"] = sweep
-        valid = {k: v for k, v in sweep.items() if v}
-        if valid:
-            extras["spmm_best"] = min(valid, key=valid.get)
+        # ---- optional SpMM implementation sweep -----------------------
+        if args.sweep_spmm:
+            sweep = {}
+            for impl in ("xla", "bucket", "block", "pallas"):
+                try:
+                    t0 = time.perf_counter()
+                    tr = Trainer(sg,
+                        dataclasses.replace(cfg, spmm_impl=impl),
+                        TrainConfig(lr=0.01, n_epochs=blk * 4,
+                                    enable_pipeline=headline_pipeline,
+                                    seed=0, eval=False, fused_epochs=blk))
+                    s, _, _ = time_trainer(tr, 3, force_blk=used_blk)
+                    sweep[impl] = round(s, 4)
+                    print(f"# spmm sweep: {impl} {s:.4f}s/epoch "
+                          f"(total {time.perf_counter()-t0:.0f}s)",
+                          file=sys.stderr)
+                    del tr
+                except Exception as exc:
+                    sweep[impl] = None
+                    print(f"# spmm sweep: {impl} failed: {exc}",
+                          file=sys.stderr)
+            extras["spmm_sweep"] = sweep
+            valid = {k: v for k, v in sweep.items() if v}
+            if valid:
+                extras["spmm_best"] = min(valid, key=valid.get)
+    except Exception as exc:  # noqa: BLE001 — keep the headline number
+        extras["extras_error"] = repr(exc)[:200]
+        print(f"# optional comparison/sweep crashed ({exc!r}); "
+              f"reporting the headline measurement alone", file=sys.stderr)
 
     metric = "reddit_scale_epoch_time" if not args.small else \
         "small_epoch_time"

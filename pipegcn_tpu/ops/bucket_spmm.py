@@ -38,6 +38,14 @@ import numpy as np
 # (elements, not bytes): 32M elems = 128 MB in f32, 64 MB in bf16
 DEFAULT_CHUNK_ELEMS = 32 * 1024 * 1024
 
+# TPU row-gather fast path: measured on v5e, gathering rows of <= 256
+# bytes runs at ~400-460M rows/s while wider rows fall off a cliff to
+# ~75-80M rows/s (5-6x). Rows are therefore processed in feature slabs
+# of SLAB_BYTES, each slab materialized as its own compact [R, slab]
+# operand (a strided slice of the wide buffer does NOT trigger the fast
+# path) via a lax.scan over the slab axis.
+SLAB_BYTES = 256
+
 
 def _bucket_widths(max_deg: int) -> List[int]:
     """Power-of-2 ladder [1, 2, 4, ..., >= max_deg]."""
@@ -115,6 +123,7 @@ def bucket_aggregate(
     inv_perm: jax.Array,
     chunk_elems: int = DEFAULT_CHUNK_ELEMS,
     chunk_edges: Optional[int] = None,
+    slab: Optional[int] = None,
 ) -> jax.Array:
     """Scatter-free sum aggregation. fbuf [R, F] (any float dtype);
     returns f32 [n_out, F] where n_out = inv_perm length. idx_mats index
@@ -122,8 +131,17 @@ def bucket_aggregate(
 
     `chunk_edges` (the --spmm-chunk edge budget) overrides the default
     element budget: each gather materializes at most ~chunk_edges
-    messages."""
+    messages.
+
+    Rows wider than SLAB_BYTES are processed per feature slab (see
+    SLAB_BYTES note above); `slab` overrides the element width (0
+    disables slabbing)."""
     f = fbuf.shape[-1]
+    if slab is None:
+        slab = SLAB_BYTES // fbuf.dtype.itemsize
+    if slab and f > slab:
+        return _slabbed_aggregate(fbuf, idx_mats, inv_perm, chunk_elems,
+                                  chunk_edges, slab)
     if chunk_edges:
         chunk_elems = chunk_edges * f
     fbuf_pad = jnp.concatenate(
@@ -155,6 +173,27 @@ def bucket_aggregate(
         outs.append(chunks.reshape(-1, f)[:n_b])
     res = jnp.concatenate(outs + [jnp.zeros((1, f), jnp.float32)], axis=0)
     return jnp.take(res, inv_perm, axis=0)
+
+
+def _slabbed_aggregate(fbuf, idx_mats, inv_perm, chunk_elems, chunk_edges,
+                       slab):
+    """Run bucket_aggregate per feature slab of `slab` elements, scanning
+    over a [S, R, slab] re-layout so each slab is a compact operand."""
+    r, f = fbuf.shape
+    n_s = -(-f // slab)
+    pad_f = n_s * slab - f
+    if pad_f:
+        fbuf = jnp.pad(fbuf, ((0, 0), (0, pad_f)))
+    slabs = fbuf.reshape(r, n_s, slab).swapaxes(0, 1)  # [S, R, slab]
+
+    def one(_, sl):
+        out = bucket_aggregate(sl, idx_mats, inv_perm, chunk_elems,
+                               chunk_edges, slab=0)
+        return None, out
+
+    _, outs = jax.lax.scan(one, None, slabs)  # [S, n_out, slab]
+    out = outs.swapaxes(0, 1).reshape(-1, n_s * slab)
+    return out[:, :f] if pad_f else out
 
 
 class BucketPlan:

@@ -56,6 +56,33 @@ def test_bucket_aggregate_matches_dense(edges):
     assert np.abs(np.asarray(out)[11]).max() == 0.0
 
 
+def test_bucket_aggregate_slabbed_matches(edges):
+    # force the feature-slab path (production: F wider than 256 bytes /
+    # itemsize; here slab=4 so F=10 spans 3 slabs incl. a partial one)
+    src, dst, n_out, n_src = edges
+    rng = np.random.default_rng(3)
+    fbuf = rng.standard_normal((n_src, 10)).astype(np.float32)
+    widths = _bucket_widths(int(np.bincount(dst, minlength=n_out).max()))
+    mats, inv, counts = build_tables_for_edges(src, dst, n_out, n_src,
+                                               widths)
+    ref = _dense_sum(src, dst, n_out, n_src, fbuf)
+    for chunk_edges in (None, 64):
+        out = bucket_aggregate(jnp.asarray(fbuf),
+                               [jnp.asarray(m) for m in mats],
+                               jnp.asarray(inv), chunk_edges=chunk_edges,
+                               slab=4)
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   rtol=1e-5, atol=1e-5)
+    # default slab width activates on its own past 256 bytes per row
+    wide = rng.standard_normal((n_src, 70)).astype(np.float32)
+    out = bucket_aggregate(jnp.asarray(wide),
+                           [jnp.asarray(m) for m in mats],
+                           jnp.asarray(inv))
+    np.testing.assert_allclose(
+        np.asarray(out), _dense_sum(src, dst, n_out, n_src, wide),
+        rtol=1e-5, atol=1e-5)
+
+
 def test_bucket_aggregate_chunked_matches(edges):
     src, dst, n_out, n_src = edges
     rng = np.random.default_rng(1)
