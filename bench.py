@@ -251,16 +251,21 @@ def main():
         # ([2M, 602] f32 = 4.8 GB peak for the pp precompute gather)
         name = f"bench-reddit-{n_parts}"
 
-    part_path = os.path.join("partitions", name)
+    # "-c" suffix: artifacts with cluster-reordered local ids (the same
+    # format; a different, locality-aware numbering)
+    part_path = os.path.join("partitions", name + "-c")
     t0 = time.perf_counter()
     if ShardedGraph.exists(part_path):
         sg = ShardedGraph.load(part_path)
         print(f"# loaded cached partitions ({time.perf_counter()-t0:.1f}s)",
               file=sys.stderr)
     else:
+        from pipegcn_tpu.partition import locality_clusters
+
         g = load_data(dataset)
         parts = partition_graph(g, n_parts, method="metis", obj="vol", seed=0)
-        sg = ShardedGraph.build(g, parts, n_parts=n_parts)
+        cluster = locality_clusters(g, seed=0)
+        sg = ShardedGraph.build(g, parts, n_parts=n_parts, cluster=cluster)
         sg.save(part_path)
         print(f"# built partitions ({time.perf_counter()-t0:.1f}s)",
               file=sys.stderr)

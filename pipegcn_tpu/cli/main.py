@@ -32,7 +32,7 @@ import warnings
 from ..graph.datasets import inductive_split, load_data
 from ..models.sage import ModelConfig
 from ..partition.halo import ShardedGraph
-from ..partition.partitioner import partition_graph
+from ..partition.partitioner import locality_clusters, partition_graph
 from ..utils.checkpoint import checkpoint_exists, load_checkpoint, save_pytree
 
 
@@ -73,7 +73,13 @@ def prepare(args):
     """Load, partition (or reuse artifact), and return
     (sharded_graph, eval_graphs or None)."""
     graph_name = args.graph_name or derive_graph_name(args)
-    part_path = os.path.join(args.partition_dir, graph_name)
+    # the local-id ordering is part of the artifact's identity: a
+    # cluster-reordered layout and a plain one are both valid but not
+    # interchangeable (--skip-partition must never silently reuse the
+    # other kind), so the ordering choice gets its own cache key suffix
+    part_name = graph_name + ("-c" if args.local_reorder == "cluster"
+                              else "")
+    part_path = os.path.join(args.partition_dir, part_name)
 
     g = None
     eval_graphs = None
@@ -111,12 +117,16 @@ def prepare(args):
             # inductive mode partitions the train subgraph only
             # (reference main.py:34-35)
             pg = train_g if args.inductive else g
+            seed = args.seed if args.fix_seed else 0
             parts = partition_graph(
                 pg, args.n_partitions, method=args.partition_method,
-                obj=args.partition_obj,
-                seed=args.seed if args.fix_seed else 0,
+                obj=args.partition_obj, seed=seed,
             )
-            sg = ShardedGraph.build(pg, parts, n_parts=args.n_partitions)
+            cluster = None
+            if args.local_reorder == "cluster":
+                cluster = locality_clusters(pg, seed=seed)
+            sg = ShardedGraph.build(pg, parts, n_parts=args.n_partitions,
+                                    cluster=cluster)
             os.makedirs(args.partition_dir, exist_ok=True)
             sg.save(part_path)
     return sg, eval_graphs

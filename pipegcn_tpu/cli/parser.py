@@ -117,6 +117,13 @@ def create_parser() -> argparse.ArgumentParser:
                         default=1,
                         help="epochs per compiled dispatch (lax.scan); "
                              "amortizes host round-trips")
+    parser.add_argument("--local-reorder", "--local_reorder",
+                        choices=["none", "cluster"], default="cluster",
+                        help="local-id ordering within each partition: "
+                             "'cluster' renumbers by locality clusters so "
+                             "the shard adjacency forms dense tiles "
+                             "(feeds --spmm-impl block); 'none' keeps "
+                             "global-id order")
     parser.add_argument("--dtype", choices=["float32", "bfloat16"],
                         default="float32",
                         help="compute dtype for activations/halo exchange "

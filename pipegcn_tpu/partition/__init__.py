@@ -1,4 +1,4 @@
-from .partitioner import partition_graph
+from .partitioner import locality_clusters, partition_graph
 from .halo import ShardedGraph
 
-__all__ = ["partition_graph", "ShardedGraph"]
+__all__ = ["locality_clusters", "partition_graph", "ShardedGraph"]

@@ -122,6 +122,7 @@ class ShardedGraph:
         parts: np.ndarray,
         n_parts: Optional[int] = None,
         pad_to: int = 8,
+        cluster: Optional[np.ndarray] = None,
     ) -> "ShardedGraph":
         """Build the sharded layout from a graph and a partition assignment.
 
@@ -129,6 +130,15 @@ class ShardedGraph:
         `n_parts` is the intended device count; defaults to parts.max()+1
         but must be passed explicitly when trailing partitions could be
         empty (an empty shard is valid, just wasteful).
+
+        `cluster` ([N] int, optional) adds a locality key to the local
+        renumbering: within each partition's train and non-train
+        segments, nodes sort by (cluster, global id) instead of global id
+        alone, so community members get contiguous local ids and the
+        shard adjacency concentrates into dense tiles (what
+        ops/block_spmm.py exploits). Purely an ordering choice — every
+        layout invariant (train-first, CSR edges, send lists) holds for
+        any consistent order.
         """
         n = g.num_nodes
         parts = parts.astype(np.int32)
@@ -141,8 +151,12 @@ class ShardedGraph:
         train_mask = g.ndata["train_mask"]
 
         # ---- local ids: train-first within each partition ------------
-        # sort nodes by (part, ~is_train, global id) -> contiguous blocks
-        order = np.lexsort((np.arange(n), ~train_mask, parts))
+        # sort nodes by (part, ~is_train[, cluster], global id) ->
+        # contiguous blocks
+        sort_keys = [np.arange(n), ~train_mask, parts]
+        if cluster is not None:
+            sort_keys.insert(1, cluster.astype(np.int64))
+        order = np.lexsort(tuple(sort_keys))
         part_sizes = np.bincount(parts, minlength=num_parts)
         part_starts = np.zeros(num_parts + 1, dtype=np.int64)
         np.cumsum(part_sizes, out=part_starts[1:])

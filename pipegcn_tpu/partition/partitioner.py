@@ -99,6 +99,42 @@ def partition_graph(
     return parts
 
 
+def locality_clusters(
+    g: Graph,
+    target_size: int = 4096,
+    seed: int = 0,
+) -> np.ndarray:
+    """Cluster labels for locality-aware LOCAL renumbering.
+
+    Orders of magnitude finer than the device partitioning: ~target_size
+    nodes per cluster. ShardedGraph.build sorts each partition's inner
+    nodes by these labels, so nodes of one community get contiguous
+    local ids and the shard's adjacency concentrates into dense tiles —
+    the structure ops/block_spmm.py's MXU path needs. (The reference
+    inherits whatever order DGL's METIS emits; here locality is an
+    explicit, separately-controlled step.)
+
+    Uses the same partitioner machinery with k = ceil(n / target_size);
+    returns zeros (single cluster, no-op ordering) for graphs at or
+    below target_size.
+    """
+    k = max(1, -(-g.num_nodes // target_size))
+    from .. import native
+
+    if not native.available():
+        # the pure-numpy refiner materializes dense [N, k] gain tables;
+        # cap k so that stays ~256 MB instead of OOMing on large graphs
+        # (coarser clusters = coarser locality, still valid ordering)
+        k = min(k, max(1, (64 << 20) // max(g.num_nodes, 1)))
+    if k == 1:
+        return np.zeros(g.num_nodes, dtype=np.int32)
+    # higher imbalance tolerance than device partitioning: clusters only
+    # steer ordering, so balance is irrelevant — cut quality is all that
+    # matters
+    return partition_graph(g, k, method="metis", obj="cut", seed=seed,
+                           refine_iters=6, imbalance=1.3)
+
+
 def _sym_adj(g: Graph) -> sp.csr_matrix:
     """Symmetric 0/1 adjacency without self loops."""
     non_loop = g.src != g.dst

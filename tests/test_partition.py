@@ -164,6 +164,65 @@ def test_sharded_aggregation_matches_global():
         )
 
 
+def test_cluster_reorder_preserves_semantics():
+    """Cluster renumbering is an ordering choice only: every invariant
+    holds, and per-global-node aggregation results are identical to the
+    unordered build."""
+    from pipegcn_tpu.partition import locality_clusters
+
+    g = synthetic_graph(num_nodes=600, avg_degree=8, n_feat=8, n_class=4,
+                        homophily=0.9, seed=5)
+    P = 3
+    parts = partition_graph(g, P, seed=1)
+    cluster = locality_clusters(g, target_size=64, seed=0)
+    assert cluster.shape == (g.num_nodes,)
+    sg_plain = ShardedGraph.build(g, parts, n_parts=P)
+    sg_clust = ShardedGraph.build(g, parts, n_parts=P, cluster=cluster)
+
+    for sg in (sg_plain, sg_clust):
+        # train-first invariant survives the extra sort key
+        for r in range(P):
+            tm = sg.train_mask[r, : sg.inner_count[r]]
+            assert tm[: sg.train_count[r]].all()
+            assert not tm[sg.train_count[r]:].any()
+        # per-device CSR order
+        for r in range(P):
+            ed = sg.edge_dst[r][: sg.edge_count[r]]
+            assert (np.diff(ed) >= 0).all()
+
+    # same nodes per device, different order
+    for r in range(P):
+        a = np.sort(sg_plain.global_nid[r, : sg_plain.inner_count[r]])
+        b = np.sort(sg_clust.global_nid[r, : sg_clust.inner_count[r]])
+        np.testing.assert_array_equal(a, b)
+
+    # aggregation result per GLOBAL node id identical for both layouts
+    got_p = _simulate_aggregation(sg_plain)
+    got_c = _simulate_aggregation(sg_clust)
+    for r in range(P):
+        n_r = sg_plain.inner_count[r]
+        order_p = np.argsort(sg_plain.global_nid[r, :n_r])
+        order_c = np.argsort(sg_clust.global_nid[r, :n_r])
+        np.testing.assert_allclose(
+            got_p[r, :n_r][order_p], got_c[r, :n_r][order_c],
+            rtol=1e-5, atol=1e-5,
+        )
+
+    # cluster locality actually materializes: mean local-id distance
+    # across edges shrinks vs the unordered layout on a homophilous graph
+    def mean_edge_span(sg):
+        spans = []
+        for r in range(P):
+            e = sg.edge_count[r]
+            src, dst = sg.edge_src[r][:e], sg.edge_dst[r][:e]
+            inner = src < sg.n_max
+            spans.append(np.abs(src[inner].astype(np.int64)
+                                - dst[inner].astype(np.int64)).mean())
+        return np.mean(spans)
+
+    assert mean_edge_span(sg_clust) < mean_edge_span(sg_plain)
+
+
 def test_artifact_roundtrip(tmp_path):
     g = karate_club()
     parts = partition_graph(g, 2, seed=0)
