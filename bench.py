@@ -179,7 +179,7 @@ def main():
     ap.add_argument("--fused", type=int, default=4,
                     help="epochs per dispatch (lax.scan); per-epoch time "
                          "= block time / fused")
-    ap.add_argument("--spmm-impl", default="xla",
+    ap.add_argument("--spmm-impl", default="auto",
                     choices=["xla", "pallas", "bucket", "block", "auto"])
     ap.add_argument("--sweep-spmm", action="store_true",
                     help="also time every SpMM impl and report the winner")

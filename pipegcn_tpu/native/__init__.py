@@ -166,6 +166,16 @@ def native_partition(
     return out
 
 
+def stable_argsort(keys: np.ndarray) -> np.ndarray:
+    """Stable argsort of non-negative integer keys: the native LSD radix
+    sort when the library is available and the array is large enough to
+    matter, else numpy. The shared fast path for every O(E) host sort
+    (halo build, kernel table builds, eval-edge CSR ordering)."""
+    if keys.size >= 1 << 20 and available():
+        return radix_argsort(keys)
+    return np.argsort(keys, kind="stable")
+
+
 def radix_argsort(keys: np.ndarray) -> np.ndarray:
     """Stable argsort of non-negative integer keys via the native LSD
     radix sort (halo_builder.cpp) — the fast path for ShardedGraph.build's

@@ -98,7 +98,9 @@ class BlockPlan:
             # each replaced edge saves an F-wide gather
             nnz_threshold = max(1, (T * S) // max(n_feat, 1))
         bid = (dst // T) * n_src_tiles + (src // S)
-        order = np.argsort(bid, kind="stable")
+        from ..native import stable_argsort
+
+        order = stable_argsort(bid)
         src_o, dst_o, bid_o = src[order], dst[order], bid[order]
         uniq, starts, counts = np.unique(bid_o, return_index=True,
                                          return_counts=True)

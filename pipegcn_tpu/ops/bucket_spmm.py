@@ -79,7 +79,9 @@ def build_tables_for_edges(
     real = edge_dst < n_out
     src = edge_src[real].astype(np.int64)
     dst = edge_dst[real].astype(np.int64)
-    order = np.argsort(dst, kind="stable")
+    from ..native import stable_argsort
+
+    order = stable_argsort(dst)
     src, dst = src[order], dst[order]
     row_ptr = np.searchsorted(dst, np.arange(n_out + 1))
     deg = (row_ptr[1:] - row_ptr[:-1]).astype(np.int64)

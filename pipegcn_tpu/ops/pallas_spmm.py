@@ -188,7 +188,9 @@ def build_sharded_tables(sg) -> Tuple[dict, int, int]:
         is_pad = dst_r == sg.n_max
         scat = np.where(is_pad, n_src_rows, src_r)
         gath = np.where(is_pad, 0, dst_r)
-        order = np.argsort(scat, kind="stable")
+        from ..native import stable_argsort
+
+        order = stable_argsort(scat)
         t_gather[r] = gath[order].astype(np.int32)
         t_scatter[r] = scat[order].astype(np.int32)
     n_pad = all_starts[0].shape[0]

@@ -64,13 +64,13 @@ class ShardedEvaluator:
     (inductive val/test graphs, or any external graph).
     """
 
-    def __init__(self, trainer, sg, data: Dict[str, jax.Array]):
+    def __init__(self, trainer, sg, data: Dict[str, jax.Array],
+                 use_tables: bool = False):
         self.trainer = trainer
         self.sg = sg
-        # shallow copy: _mask() lazily adds mask arrays, and the trainer's
-        # own data dict is the train step's traced input structure —
-        # mutating it would retrigger compilation (or crash the pytree
-        # structure check)
+        # the fixed traced input of _run (pytree structure must not
+        # change between calls); lazily-added masks live in self.data
+        self._dev_data = dict(data)
         self.data = dict(data)
         self._cfg = trainer.cfg  # already has sorted_edges=True
         P = trainer.P
@@ -78,19 +78,26 @@ class ShardedEvaluator:
         multilabel = sg.multilabel
         self.multilabel = multilabel
 
-        def eval_fn(params, norm, feat, es, ed, deg, send_idx, send_mask,
-                    label, mask):
-            feat, es, ed, deg = feat[0], es[0], ed[0], deg[0]
-            send_idx, send_mask = send_idx[0], send_mask[0]
-            label, mask = label[0], mask[0]
+        def eval_fn(params, norm, data_in, mask):
+            d = {k: v[0] for k, v in data_in.items()}
+            label, mask = d["label"], mask[0]
 
             def comm_update(i, h):
-                return halo_exchange(h, send_idx, send_mask, PARTS_AXIS, P)
+                return halo_exchange(h, d["send_idx"], d["send_mask"],
+                                     PARTS_AXIS, P)
 
+            # reuse the trainer's device-resident kernel tables when
+            # evaluating its own shards (use_tables): the trainer may
+            # have trimmed the raw edge list from HBM, and the kernels
+            # are the faster aggregation anyway. Foreign graphs
+            # (inductive val/test) carry raw edges and no tables.
+            spmm = trainer.make_device_spmm_closure(d) if use_tables \
+                else None
             logits, _ = forward(
-                params, self._cfg, feat, es, ed, deg, n_max,
+                params, self._cfg, d["feat"], d["edge_src"],
+                d["edge_dst"], d["in_deg"], n_max,
                 training=False, halo_eval=True, comm_update=comm_update,
-                norm_state=norm,
+                norm_state=norm, spmm_fn=spmm,
             )
             if multilabel:
                 pred = logits > 0
@@ -116,11 +123,19 @@ class ShardedEvaluator:
             lambda _: repl, trainer.state["params"])
         norm_spec = jax.tree_util.tree_map(
             lambda _: repl, trainer.state["norm"])
+        data_spec = jax.tree_util.tree_map(lambda _: spec, self._dev_data)
+        # pallas interpret mode (CPU testing) hits an internal VMA
+        # mismatch in jax's HLO interpreter; relax the check there only
+        # (same workaround as the train step, trainer._build_step)
+        check_vma = not (use_tables
+                         and trainer._pallas_tables is not None
+                         and getattr(trainer, "_pallas_interpret", False))
         self._run = jax.jit(jax.shard_map(
             eval_fn,
             mesh=trainer.mesh,
-            in_specs=(params_spec, norm_spec) + (spec,) * 8,
+            in_specs=(params_spec, norm_spec, data_spec, spec),
             out_specs=repl,
+            check_vma=check_vma,
         ))
 
     # ------------------------------------------------------------------
@@ -128,7 +143,11 @@ class ShardedEvaluator:
     def for_graph(trainer, g: Graph,
                   parts: Optional[np.ndarray] = None) -> "ShardedEvaluator":
         if _covers_exactly(trainer.sg, g):
-            return ShardedEvaluator(trainer, trainer.sg, trainer.data)
+            # transductive: reuse the trainer's device arrays, kernel
+            # tables included — no re-upload even when the trainer
+            # trimmed the raw edge list from HBM
+            return ShardedEvaluator(trainer, trainer.sg, trainer.data,
+                                    use_tables=trainer._edges_trimmed)
 
         from ..partition.halo import ShardedGraph
         from ..partition.partitioner import partition_graph
@@ -173,12 +192,10 @@ class ShardedEvaluator:
         """Dispatch the sharded eval; returns the [3] reduced counts as a
         device array WITHOUT blocking (jax async dispatch)."""
         t = self.trainer
-        d = self.data
         return self._run(
             params if params is not None else t.state["params"],
             norm if norm is not None else t.state["norm"],
-            d["feat"], d["edge_src"], d["edge_dst"], d["in_deg"],
-            d["send_idx"], d["send_mask"], d["label"],
+            self._dev_data,
             self._mask(mask_key),
         )
 

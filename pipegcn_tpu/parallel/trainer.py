@@ -91,7 +91,17 @@ class Trainer:
         self._repl = NamedSharding(self.mesh, PartitionSpec())
 
         self._setup_pallas_spmm()
-        self.data = self._put_data()
+        # with kernel tables active, the step (and the sharded
+        # evaluator) aggregate through them and the raw edge list is
+        # only needed for the one-shot pp precompute — at Reddit scale
+        # the two int32 edge arrays are ~0.9 GB of HBM that would
+        # otherwise sit resident for nothing (forward()'s edge args are
+        # untraced when spmm_fn is set, so a token shape suffices)
+        self._edges_trimmed = (self._pallas_tables is not None
+                               or self._bucket_tables is not None
+                               or self._block_tables is not None)
+        self.data = self._put_data(
+            skip_edges=self._edges_trimmed and not cfg.use_pp)
         if cfg.use_pp:
             self.data["feat"] = self._precompute_pp()
         if cfg.compute_dtype != jnp.float32:
@@ -99,6 +109,12 @@ class Trainer:
             # HBM read (and layer-0 halo exchange) is half-width; the pp
             # precompute above still ran in f32
             self.data["feat"] = self.data["feat"].astype(cfg.compute_dtype)
+        if self._edges_trimmed and cfg.use_pp:
+            # edges were uploaded only for the precompute above; drop
+            # them now
+            dummy = jnp.zeros((self.P, 8), jnp.int32)
+            self.data["edge_src"] = jax.device_put(dummy, self._shard)
+            self.data["edge_dst"] = jax.device_put(dummy, self._shard)
 
         rng = jax.random.PRNGKey(tcfg.seed)
         params = init_params(rng, cfg)
@@ -191,15 +207,18 @@ class Trainer:
 
     # ---------------- data placement ----------------------------------
 
-    def _put_data(self) -> Dict[str, jax.Array]:
+    def _put_data(self, skip_edges: bool = False) -> Dict[str, jax.Array]:
         sg = self.sg
+        edge_dummy = np.zeros((self.P, 8), np.int32)
         arrs = {
             "feat": sg.feat,
             "label": sg.label,
             "train_mask": sg.train_mask,
             "in_deg": sg.in_deg,
-            "edge_src": sg.edge_src.astype(np.int32),
-            "edge_dst": sg.edge_dst.astype(np.int32),
+            "edge_src": edge_dummy if skip_edges
+            else sg.edge_src.astype(np.int32),
+            "edge_dst": edge_dummy if skip_edges
+            else sg.edge_dst.astype(np.int32),
             "send_idx": sg.send_idx.astype(np.int32),
             "send_mask": sg.send_mask,
             # True for real inner rows, False for padding (BN statistics)
@@ -293,6 +312,36 @@ class Trainer:
 
     # ---------------- the train step ----------------------------------
 
+    def make_device_spmm_closure(self, d: Dict[str, jax.Array]):
+        """Per-device mean-aggregation closure over the stripped (no
+        leading device axis) table arrays in `d`, matching the trainer's
+        resolved spmm_impl — or None for the raw-edge XLA path. Shared
+        by the train step and the sharded evaluator (which reuses the
+        same device-resident tables instead of the raw edge list)."""
+        sg, cfg = self.sg, self.cfg
+        n_max, H = sg.n_max, sg.halo_size
+        if self._pallas_tables is not None:
+            from ..ops.pallas_spmm import make_device_spmm_fn
+
+            return make_device_spmm_fn(
+                d, n_max, n_max + H, self._pallas_max_e,
+                getattr(self, "_pallas_interpret", False), cfg.spmm_chunk,
+            )
+        if self._bucket_tables is not None:
+            from ..ops.bucket_spmm import make_device_bucket_spmm_fn
+
+            return make_device_bucket_spmm_fn(
+                d, d["in_deg"], n_max + H, chunk_edges=cfg.spmm_chunk,
+            )
+        if self._block_tables is not None:
+            from ..ops.block_spmm import make_device_block_spmm_fn
+
+            return make_device_block_spmm_fn(
+                d, d["in_deg"], n_max, n_max + H, self._block_tile,
+                chunk_edges=cfg.spmm_chunk,
+            )
+        return None
+
     def _build_step(self):
         sg, cfg, tcfg, P = self.sg, self.cfg, self.tcfg, self.P
         n_max, b_max, H = sg.n_max, sg.b_max, sg.halo_size
@@ -302,10 +351,6 @@ class Trainer:
         glayers = list(self._graph_layer_range())
         momentum = tcfg.corr_momentum
         use_pallas = self._pallas_tables is not None
-        use_bucket = self._bucket_tables is not None
-        use_block = self._block_tables is not None
-        block_tile = self._block_tile
-        pallas_max_e = self._pallas_max_e
         pallas_interp = getattr(self, "_pallas_interpret", False)
 
         def step(state, data, rng):
@@ -360,28 +405,7 @@ class Trainer:
                         h, d["send_idx"], d["send_mask"], PARTS_AXIS, P
                     )
 
-            spmm_fn = None
-            if use_pallas:
-                from ..ops.pallas_spmm import make_device_spmm_fn
-
-                spmm_fn = make_device_spmm_fn(
-                    d, n_max, n_max + H, pallas_max_e, pallas_interp,
-                    cfg.spmm_chunk,
-                )
-            elif use_bucket:
-                from ..ops.bucket_spmm import make_device_bucket_spmm_fn
-
-                spmm_fn = make_device_bucket_spmm_fn(
-                    d, d["in_deg"], n_max + H,
-                    chunk_edges=cfg.spmm_chunk,
-                )
-            elif use_block:
-                from ..ops.block_spmm import make_device_block_spmm_fn
-
-                spmm_fn = make_device_block_spmm_fn(
-                    d, d["in_deg"], n_max, n_max + H, block_tile,
-                    chunk_edges=cfg.spmm_chunk,
-                )
+            spmm_fn = self.make_device_spmm_closure(d)
 
             def loss_fn(params, probes_arg):
                 nonlocal probes_in
@@ -920,9 +944,11 @@ class Trainer:
     def _full_eval_cache(self, g: Graph):
         key = id(g)
         if key not in self._eval_cache:
+            from ..native import stable_argsort
+
             n = g.num_nodes
             # CSR-sort eval edges so the sorted segment reduction applies
-            order = np.argsort(g.dst, kind="stable")
+            order = stable_argsort(g.dst)
             self._eval_cache[key] = {
                 "graph": g,  # strong ref: keeps id(g) valid while cached
                 "feat": jnp.asarray(g.ndata["feat"]),

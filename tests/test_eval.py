@@ -19,13 +19,14 @@ from pipegcn_tpu.partition import ShardedGraph, partition_graph
 
 
 def _trainer(g, n_parts=4, use_pp=False, norm="layer", dtype="float32",
-             multilabel=False, pipeline=True, seed=3):
+             multilabel=False, pipeline=True, seed=3, spmm_impl="xla"):
     parts = partition_graph(g, n_parts, seed=0)
     sg = ShardedGraph.build(g, parts, n_parts=n_parts)
     n_out = sg.n_class
     cfg = ModelConfig(
         layer_sizes=(sg.n_feat, 16, 16, n_out), norm=norm, dropout=0.0,
         train_size=sg.n_train_global, use_pp=use_pp, dtype=dtype,
+        spmm_impl=spmm_impl,
     )
     return Trainer(sg, cfg, TrainConfig(seed=seed,
                                         enable_pipeline=pipeline))
@@ -44,6 +45,39 @@ def test_sharded_eval_matches_full_transductive():
     # transductive: the evaluator must have reused the trainer's arrays
     ev = t._get_sharded_evaluator(g)
     assert ev.sg is t.sg and ev.data["feat"] is t.data["feat"]
+
+
+def test_sharded_eval_through_kernel_tables_matches():
+    """A trainer on the bucket kernel trims its device edge list; the
+    transductive sharded evaluator must aggregate through the kernel
+    tables (no edge re-upload) and still match single-device eval."""
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
+                        seed=33)
+    t = _trainer(g, spmm_impl="bucket")
+    assert t._edges_trimmed
+    assert t.data["edge_src"].shape[-1] != t.sg.e_max  # dummies in place
+    for e in range(3):
+        t.train_epoch(e)
+    full = t.evaluate(g, "val_mask")
+    sharded = t.evaluate(g, "val_mask", sharded=True)
+    assert full == pytest.approx(sharded, abs=1e-9)
+    # no fresh edge upload happened: the evaluator holds the dummies
+    ev = t._get_sharded_evaluator(g)
+    assert ev._dev_data["edge_src"] is t.data["edge_src"]
+
+
+def test_sharded_eval_through_pallas_tables_matches():
+    # pallas interpret mode on the CPU mesh needs the evaluator's
+    # check_vma relaxation (same as the train step's)
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
+                        seed=34)
+    t = _trainer(g, spmm_impl="pallas")
+    assert t._edges_trimmed
+    for e in range(3):
+        t.train_epoch(e)
+    full = t.evaluate(g, "val_mask")
+    sharded = t.evaluate(g, "val_mask", sharded=True)
+    assert full == pytest.approx(sharded, abs=1e-9)
 
 
 def test_sharded_eval_matches_full_use_pp_and_batchnorm():
