@@ -80,7 +80,8 @@ class BlockPlan:
                  tile: int = 256,
                  nnz_threshold: Optional[int] = None,
                  fwd_widths: Optional[Sequence[int]] = None,
-                 bwd_widths: Optional[Sequence[int]] = None):
+                 bwd_widths: Optional[Sequence[int]] = None,
+                 max_blocks: Optional[int] = None):
         T = S = tile
         self.tile = tile
         real = edge_dst < n_out
@@ -105,6 +106,15 @@ class BlockPlan:
         uniq, starts, counts = np.unique(bid_o, return_index=True,
                                          return_counts=True)
         dense_sel = counts >= nnz_threshold
+        if max_blocks is not None and int(dense_sel.sum()) > max_blocks:
+            # HBM budget: keep only the densest blocks (best edges-
+            # replaced-per-byte); the rest spill to the sparse remainder
+            cutoff = np.sort(counts[dense_sel])[-max_blocks]
+            dense_sel &= counts >= cutoff
+            if int(dense_sel.sum()) > max_blocks:  # ties at the cutoff
+                over = int(dense_sel.sum()) - max_blocks
+                tie_idx = np.nonzero(dense_sel & (counts == cutoff))[0]
+                dense_sel[tie_idx[:over]] = False
 
         # ---- dense blocks ----
         dense_ids = uniq[dense_sel]
@@ -265,7 +275,8 @@ def plan_to_arrays(p: BlockPlan) -> Dict[str, np.ndarray]:
 
 
 def build_sharded_block_tables(sg, tile: int = 256,
-                               n_feat_hint: int = 256
+                               n_feat_hint: int = 256,
+                               byte_budget: int = 2 << 30,
                                ) -> Tuple[Dict[str, np.ndarray], int]:
     """Stacked per-device hybrid plans (leading device axis), padded to
     shared shapes: same B (dense block count), same K (per-tile block
@@ -273,11 +284,17 @@ def build_sharded_block_tables(sg, tile: int = 256,
     (tables, tile)."""
     P = sg.num_parts
     n_src_rows = sg.n_max + sg.halo_size
+    # HBM budget for the per-device dense-A tensor: keep the densest
+    # blocks under byte_budget, spill the rest to the sparse remainder.
+    # Past this size the A reads stop paying for the gathers they
+    # replace and, at Reddit scale, the table alone would crowd a v5e's
+    # 16 GB HBM (an unbudgeted clustered Reddit shard produced 6.5 GB).
+    max_blocks = max(1, int(byte_budget) // (tile * tile * 2))
 
     # shared remainder ladders need global maxima; build plans first
     plans = [
         BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max, n_src_rows,
-                  n_feat_hint, tile=tile)
+                  n_feat_hint, tile=tile, max_blocks=max_blocks)
         for r in range(P)
     ]
     # unify remainder widths (ladder length = max over devices)
@@ -291,7 +308,28 @@ def build_sharded_block_tables(sg, tile: int = 256,
         plans = [
             BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
                       n_src_rows, n_feat_hint, tile=tile,
-                      fwd_widths=fw, bwd_widths=bw)
+                      fwd_widths=fw, bwd_widths=bw,
+                      max_blocks=max_blocks)
+            for r in range(P)
+        ]
+
+    # ship A in bf16 when exact (edge multiplicities <= 256 fit bf16's
+    # 8-bit mantissa): halves the dominant HBM-resident table
+    import ml_dtypes
+
+    a_max = max((float(p.a_blocks.max(initial=0.0)) for p in plans),
+                default=0.0)
+    a_dtype = np.float32 if a_max > 256 else ml_dtypes.bfloat16
+    if a_dtype == np.float32 and \
+            max(p.a_blocks.shape[0] for p in plans) > max_blocks // 2:
+        # multigraph shards with >256-fold edges ship f32 A (4 bytes);
+        # the budget above assumed 2 — rebuild plans at half the block
+        # count so byte_budget still holds
+        plans = [
+            BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
+                      n_src_rows, n_feat_hint, tile=tile,
+                      fwd_widths=fw, bwd_widths=bw,
+                      max_blocks=max(1, max_blocks // 2))
             for r in range(P)
         ]
 
@@ -319,14 +357,6 @@ def build_sharded_block_tables(sg, tile: int = 256,
             off_old += n_b
             off_new += cap
         return out.astype(np.int32)
-
-    # ship A in bf16 when exact (edge multiplicities <= 256 fit bf16's
-    # 8-bit mantissa): halves the dominant HBM-resident table
-    import ml_dtypes
-
-    a_max = max((float(p.a_blocks.max(initial=0.0)) for p in plans),
-                default=0.0)
-    a_dtype = np.float32 if a_max > 256 else ml_dtypes.bfloat16
 
     tables: Dict[str, List[np.ndarray]] = {}
     for p in plans:

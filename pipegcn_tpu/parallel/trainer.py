@@ -141,6 +141,71 @@ class Trainer:
 
     # ---------------- pallas spmm selection ---------------------------
 
+    # bump when any kernel-table layout changes: stale caches must miss
+    _TABLES_FORMAT = 1
+
+    def _cached_tables(self, kind: str, build_fn):
+        """Disk-cache derived kernel tables next to the partition
+        artifact (sg.cache_dir, set by ShardedGraph.load): the O(E)
+        host builds cost minutes at 100M-edge scale and depend only on
+        the artifact. The cache is stamped with (_TABLES_FORMAT,
+        source_edge_checksum) and validated on load — a regenerated
+        artifact or a format change must rebuild, never silently load
+        tables for a different graph. Corrupt/mismatched caches fall
+        back to the build. bfloat16 arrays round-trip as uint16 bit
+        views (npz stores bf16 as raw void and cannot restore it);
+        writes go to a temp file + atomic rename so a killed run (or a
+        shared-filesystem race between hosts, halo.py save()) can never
+        leave a truncated file the next run trusts."""
+        import os
+
+        import ml_dtypes
+
+        cd = getattr(self.sg, "cache_dir", None)
+        fname = os.path.join(cd, f"{kind}_tables.npz") if cd else None
+        stamp = np.asarray(
+            [self._TABLES_FORMAT,
+             int(self.sg.source_edge_checksum) & ((1 << 64) - 1)],
+            dtype=np.uint64)
+        if fname and os.path.exists(fname):
+            try:
+                z = np.load(fname)
+                if "__stamp__" in z.files and \
+                        np.array_equal(z["__stamp__"], stamp):
+                    bf16_keys = set(z["__bf16_keys__"].tolist())
+                    return {
+                        k: z[k].view(ml_dtypes.bfloat16)
+                        if k in bf16_keys else z[k]
+                        for k in z.files
+                        if k not in ("__bf16_keys__", "__stamp__")
+                    }
+            except Exception:  # truncated/corrupt cache: rebuild below
+                pass
+        tables = build_fn()
+        if fname:
+            bf16_keys = [k for k, v in tables.items()
+                         if v.dtype == ml_dtypes.bfloat16]
+            tmp = f"{fname}.{os.getpid()}.tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    np.savez(
+                        f,
+                        __stamp__=stamp,
+                        __bf16_keys__=np.asarray(bf16_keys, dtype="U64"),
+                        **{k: (v.view(np.uint16) if k in bf16_keys else v)
+                           for k, v in tables.items()},
+                    )
+                os.replace(tmp, fname)
+            except OSError:  # read-only artifact dir: cache is optional
+                pass
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+        return tables
+
     def _setup_pallas_spmm(self) -> None:
         """Resolve cfg.spmm_impl: 'pallas' forces the VMEM-resident CSR
         kernel (ops/pallas_spmm.py), 'bucket' the scatter-free
@@ -163,7 +228,8 @@ class Trainer:
         def use_bucket():
             from ..ops.bucket_spmm import build_sharded_bucket_tables
 
-            self._bucket_tables = build_sharded_bucket_tables(self.sg)
+            self._bucket_tables = self._cached_tables(
+                "bucket", lambda: build_sharded_bucket_tables(self.sg))
 
         if impl == "bucket":
             use_bucket()
@@ -172,8 +238,12 @@ class Trainer:
             from ..ops.block_spmm import build_sharded_block_tables
 
             w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
-            self._block_tables, self._block_tile = \
-                build_sharded_block_tables(self.sg, n_feat_hint=w_hint)
+            tile = 256
+            self._block_tables = self._cached_tables(
+                f"block_{tile}_{w_hint}",
+                lambda: build_sharded_block_tables(
+                    self.sg, tile=tile, n_feat_hint=w_hint)[0])
+            self._block_tile = tile
             return
 
         # cheap VMEM gate first (needs only shapes) — skip the O(E) table

@@ -96,6 +96,11 @@ class ShardedGraph:
     # -1 in artifacts saved before the field existed
     source_edge_checksum: int = -1
 
+    # set by load(): the artifact directory, which doubles as the cache
+    # location for derived per-device kernel tables (bucket/block) so
+    # repeat runs skip their O(E) host builds. Not serialized.
+    cache_dir: Optional[str] = None
+
     @property
     def halo_size(self) -> int:
         return (self.num_parts - 1) * self.b_max
@@ -376,8 +381,8 @@ class ShardedGraph:
                 f"(delete the directory or drop --skip-partition)"
             )
         arrays = np.load(os.path.join(path, "arrays.npz"))
-        return ShardedGraph(**manifest, **{k: arrays[k] for k in
-                                           ShardedGraph._ARRAYS})
+        return ShardedGraph(**manifest, cache_dir=path,
+                            **{k: arrays[k] for k in ShardedGraph._ARRAYS})
 
     @staticmethod
     def exists(path: str) -> bool:
