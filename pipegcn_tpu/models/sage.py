@@ -52,6 +52,7 @@ class ModelConfig:
     # 'xla' | 'pallas' | 'bucket' | 'block' | 'auto' — must stay in sync
     # with cli/parser.py --spmm-impl and Trainer._setup_pallas_spmm
     spmm_impl: str = "xla"
+    block_tile: int = 256          # dense-tile edge for spmm_impl='block'
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     @property

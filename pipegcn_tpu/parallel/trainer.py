@@ -238,7 +238,7 @@ class Trainer:
             from ..ops.block_spmm import build_sharded_block_tables
 
             w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
-            tile = 256
+            tile = self.cfg.block_tile
             self._block_tables = self._cached_tables(
                 f"block_{tile}_{w_hint}",
                 lambda: build_sharded_block_tables(

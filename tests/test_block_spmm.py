@@ -108,6 +108,30 @@ def test_trainer_block_matches_xla():
     np.testing.assert_allclose(losses["xla"], losses["block"], rtol=2e-4)
 
 
+def test_trainer_block_clustered_matches_xla():
+    """The intended production path: cluster-renumbered local ids feed
+    the block-dense plan real dense tiles; training must still match the
+    raw-edge XLA trainer loss-for-loss on the same layout."""
+    from pipegcn_tpu.partition import locality_clusters
+
+    g = synthetic_graph(num_nodes=600, avg_degree=10, n_feat=12,
+                        n_class=4, homophily=0.9, seed=25)
+    parts = partition_graph(g, 4, seed=0)
+    cluster = locality_clusters(g, target_size=64, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4, cluster=cluster)
+    losses = {}
+    for impl in ("xla", "block"):
+        cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
+                          dropout=0.0, train_size=sg.n_train_global,
+                          spmm_impl=impl, block_tile=32)
+        t = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True))
+        losses[impl] = [t.train_epoch(e) for e in range(6)]
+        if impl == "block":
+            # the clustered layout must actually produce dense blocks
+            assert t._block_tables["blk_a"].shape[1] > 0
+    np.testing.assert_allclose(losses["xla"], losses["block"], rtol=2e-4)
+
+
 def test_trainer_block_bf16_fused():
     g = synthetic_graph(num_nodes=300, avg_degree=7, n_feat=10, n_class=4,
                         seed=23)
