@@ -171,16 +171,23 @@ class BlockPlan:
                                    self.rem_bwd_widths)
 
 
-def _dense_apply(a_pad, blk_idx, tile_idx, tiles, T, out_rows, n_feat):
+def _dense_apply(a_pad, blk_idx, tile_idx, tiles, T, out_rows, n_feat,
+                 compute_dtype, transpose=False):
     """sum_k A[blk_idx[i,k]] (@ or transposed-@) tiles[tile_idx[i,k]]
-    for every group i, via lax.scan. a_pad: [B+1, T, S] (last = zeros);
-    tiles: [n_tiles+1, S, F] (last = zeros). Returns [n_groups*T, F] f32."""
+    for every group i, via lax.scan. a_pad: [B+1, T, S] in its STORED
+    dtype (possibly int8; last block = zeros) — the cast to the compute
+    dtype happens per scan step on the gathered [K, T, S] slice, so the
+    full A tensor is never materialized in a wider dtype; likewise the
+    backward's A^T lives in the einsum spec, never as a transposed
+    copy. tiles: [n_tiles+1, S, F] (last = zeros). Returns
+    [n_groups*T, F] f32."""
+    spec = "kts,ktf->sf" if transpose else "kts,ksf->tf"
 
     def body(_, idx):
         bi, ti = idx
-        blks = jnp.take(a_pad, bi, axis=0)      # [K, T, S]
-        tls = jnp.take(tiles, ti, axis=0)       # [K, S, F]
-        out = jnp.einsum("kts,ksf->tf", blks, tls,
+        blks = jnp.take(a_pad, bi, axis=0).astype(compute_dtype)
+        tls = jnp.take(tiles, ti, axis=0)       # [K, S|T, F]
+        out = jnp.einsum(spec, blks, tls,
                          preferred_element_type=jnp.float32)
         return None, out
 
@@ -213,20 +220,20 @@ def make_block_spmm_fn(
         return [d[k] for k in sorted(d)
                 if k.startswith(prefix) and not k.endswith("inv")]
 
-    def dense_dtype(x):
-        # A blocks are 0/1 counts — exact in bf16; match fbuf's dtype so
-        # the MXU runs at the activation precision
-        return d["blk_a"].astype(x.dtype)
+    def a_padded():
+        # append the zero block IN the stored dtype (int8/bf16/f32);
+        # the per-step cast to the compute dtype lives in _dense_apply
+        a = d["blk_a"]
+        return jnp.concatenate(
+            [a, jnp.zeros((1, T, T), a.dtype)], axis=0)
 
     @jax.custom_vjp
     def f(fbuf):
-        a_pad = jnp.concatenate(
-            [dense_dtype(fbuf),
-             jnp.zeros((1, T, T), fbuf.dtype)], axis=0)
         n_s_tiles = -(-n_src_rows // T)
         tiles = tiles_of(fbuf, n_s_tiles, T)
-        dense = _dense_apply(a_pad, d["blk_fwd_blk"], d["blk_fwd_tile"],
-                             tiles, T, n_out, fbuf.shape[-1])
+        dense = _dense_apply(a_padded(), d["blk_fwd_blk"],
+                             d["blk_fwd_tile"], tiles, T, n_out,
+                             fbuf.shape[-1], fbuf.dtype)
         rem = bucket_aggregate(fbuf, rem_mats("blkrem_fwd_"),
                                d["blkrem_fwd_inv"],
                                chunk_edges=chunk_edges)
@@ -238,13 +245,11 @@ def make_block_spmm_fn(
     def bwd(proto, g):
         gd = (g.astype(jnp.float32) / deg_col).astype(proto.dtype)
         # transpose dense: per source tile, sum A^T @ g_tile
-        a_t = jnp.swapaxes(dense_dtype(gd), 1, 2)  # [B, S, T]
-        a_pad = jnp.concatenate(
-            [a_t, jnp.zeros((1, T, T), gd.dtype)], axis=0)
         n_d_tiles = -(-n_out // T)
         g_tiles = tiles_of(gd, n_d_tiles, T)
-        dense = _dense_apply(a_pad, d["blk_bwd_blk"], d["blk_bwd_tile"],
-                             g_tiles, T, n_src_rows, g.shape[-1])
+        dense = _dense_apply(a_padded(), d["blk_bwd_blk"],
+                             d["blk_bwd_tile"], g_tiles, T, n_src_rows,
+                             g.shape[-1], gd.dtype, transpose=True)
         rem = bucket_aggregate(gd, rem_mats("blkrem_bwd_"),
                                d["blkrem_bwd_inv"],
                                chunk_edges=chunk_edges)
@@ -289,49 +294,61 @@ def build_sharded_block_tables(sg, tile: int = 256,
     # Past this size the A reads stop paying for the gathers they
     # replace and, at Reddit scale, the table alone would crowd a v5e's
     # 16 GB HBM (an unbudgeted clustered Reddit shard produced 6.5 GB).
-    max_blocks = max(1, int(byte_budget) // (tile * tile * 2))
+    # First pass assumes int8 A (1 byte — the common case: simple graphs
+    # have small edge multiplicities); if the counts force a wider
+    # dtype, plans rebuild under the correspondingly smaller cap.
+    max_blocks = max(1, int(byte_budget) // (tile * tile))
 
-    # shared remainder ladders need global maxima; build plans first
-    plans = [
-        BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max, n_src_rows,
-                  n_feat_hint, tile=tile, max_blocks=max_blocks)
-        for r in range(P)
-    ]
-    # unify remainder widths (ladder length = max over devices)
+    # narrowest exact dtype for the A counts: int8 (<=127) halves bf16
+    # and quarters f32, which doubles/quadruples the dense coverage one
+    # HBM byte buys (the device casts A to the activation dtype at use)
+    import ml_dtypes
+
+    def build_plans(cap, fw=None, bw=None):
+        # fresh ladders unless given: a different block cap changes
+        # which edges land in the remainder, and reusing a ladder built
+        # for a different remainder can under-size its top bucket —
+        # build_tables_for_edges would then SILENTLY drop edges
+        return [
+            BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
+                      n_src_rows, n_feat_hint, tile=tile,
+                      fwd_widths=fw, bwd_widths=bw, max_blocks=cap)
+            for r in range(P)
+        ]
+
+    def required_isz(plans):
+        a_max = max((float(p.a_blocks.max(initial=0.0)) for p in plans),
+                    default=0.0)
+        if a_max <= 127:
+            return np.int8, 1
+        if a_max <= 256:
+            return ml_dtypes.bfloat16, 2
+        return np.float32, 4
+
+    # fixpoint on the A dtype: cap = budget / itemsize, but the counts
+    # (and thus the required dtype) depend on which blocks the cap
+    # keeps. isz only ratchets up, so this terminates in <= 3 builds;
+    # a final narrower-than-assumed dtype is shipped as-is (exact,
+    # merely under-using the budget).
+    isz = 1
+    while True:
+        plans = build_plans(max(1, max_blocks // isz))
+        a_dtype, need = required_isz(plans)
+        if need <= isz:
+            break
+        isz = need
+
+    # unify remainder widths (ladder length = max over devices); the
+    # re-build keeps the SAME cap, so the dense selection — and thus
+    # every remainder degree — is unchanged and the unified ladder
+    # (covering the global max) is safe for every device
     fw_len = max(len(p.rem_fwd_widths) for p in plans)
     bw_len = max(len(p.rem_bwd_widths) for p in plans)
     fw = [1 << i for i in range(fw_len)]
     bw = [1 << i for i in range(bw_len)]
-    rebuild = any(p.rem_fwd_widths != fw or p.rem_bwd_widths != bw
-                  for p in plans)
-    if rebuild:
-        plans = [
-            BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
-                      n_src_rows, n_feat_hint, tile=tile,
-                      fwd_widths=fw, bwd_widths=bw,
-                      max_blocks=max_blocks)
-            for r in range(P)
-        ]
-
-    # ship A in bf16 when exact (edge multiplicities <= 256 fit bf16's
-    # 8-bit mantissa): halves the dominant HBM-resident table
-    import ml_dtypes
-
-    a_max = max((float(p.a_blocks.max(initial=0.0)) for p in plans),
-                default=0.0)
-    a_dtype = np.float32 if a_max > 256 else ml_dtypes.bfloat16
-    if a_dtype == np.float32 and \
-            max(p.a_blocks.shape[0] for p in plans) > max_blocks // 2:
-        # multigraph shards with >256-fold edges ship f32 A (4 bytes);
-        # the budget above assumed 2 — rebuild plans at half the block
-        # count so byte_budget still holds
-        plans = [
-            BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
-                      n_src_rows, n_feat_hint, tile=tile,
-                      fwd_widths=fw, bwd_widths=bw,
-                      max_blocks=max(1, max_blocks // 2))
-            for r in range(P)
-        ]
+    if any(p.rem_fwd_widths != fw or p.rem_bwd_widths != bw
+           for p in plans):
+        plans = build_plans(max(1, max_blocks // isz), fw=fw, bw=bw)
 
     B_max = max(p.a_blocks.shape[0] for p in plans)
     kf_max = max(p.fwd_blk.shape[1] for p in plans)

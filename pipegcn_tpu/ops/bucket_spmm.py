@@ -257,7 +257,11 @@ def make_bucket_spmm_fn(
         return f(fbuf), jnp.zeros((0,), fbuf.dtype)
 
     def bwd(proto, g):
-        gd = g.astype(jnp.float32) / deg_col
+        # transpose aggregation; cotangents travel in the activation
+        # dtype (half the gather traffic and double the slab width in
+        # bf16 — same transport precision as the halo exchange), while
+        # bucket_aggregate still accumulates in f32
+        gd = (g.astype(jnp.float32) / deg_col).astype(proto.dtype)
         d_fbuf = bucket_aggregate(gd, bwd_mats, bwd_inv, chunk_elems,
                                   chunk_edges)
         return (d_fbuf[:n_src_rows].astype(proto.dtype),)

@@ -142,7 +142,7 @@ class Trainer:
     # ---------------- pallas spmm selection ---------------------------
 
     # bump when any kernel-table layout changes: stale caches must miss
-    _TABLES_FORMAT = 1
+    _TABLES_FORMAT = 2  # v2: int8 A-blocks under the 1-byte-first budget
 
     def _cached_tables(self, kind: str, build_fn):
         """Disk-cache derived kernel tables next to the partition

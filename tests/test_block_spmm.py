@@ -108,6 +108,55 @@ def test_trainer_block_matches_xla():
     np.testing.assert_allclose(losses["xla"], losses["block"], rtol=2e-4)
 
 
+def test_block_budget_spill_and_wide_counts_stay_exact():
+    """A tight byte budget forces dense-block spills, and >127-fold
+    duplicate edges force the wider A dtype's smaller cap (the rebuild
+    path): every edge must still be aggregated exactly once — spilled
+    blocks' high-degree rows must not overflow a stale remainder
+    ladder."""
+    from pipegcn_tpu.graph import synthetic_graph
+    from pipegcn_tpu.graph.csr import Graph
+    from pipegcn_tpu.ops.block_spmm import (
+        build_sharded_block_tables,
+        make_device_block_spmm_fn,
+    )
+
+    base = synthetic_graph(num_nodes=256, avg_degree=12, n_feat=6,
+                           n_class=3, homophily=0.9, seed=11)
+    # multigraph: repeat one hub edge 200x (forces bf16 A, isz=2)
+    rng = np.random.default_rng(0)
+    rep_src = np.full(200, int(base.src[0]), np.int64)
+    rep_dst = np.full(200, int(base.dst[0]), np.int64)
+    g = Graph(base.num_nodes,
+              np.concatenate([base.src, rep_src]),
+              np.concatenate([base.dst, rep_dst]),
+              ndata={k: v for k, v in base.ndata.items()
+                     if k != "in_deg"})
+    parts = partition_graph(g, 1, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=1)
+
+    # budget of ONE int8 tile at tile=16 -> heavy spills; wide counts
+    # then halve the cap during the dtype rebuild
+    tables, tile = build_sharded_block_tables(
+        sg, tile=16, n_feat_hint=6, byte_budget=16 * 16 * 2)
+    assert tables["blk_a"].dtype != np.int8  # the wide-dtype path ran
+
+    fbuf_rows = sg.n_max + sg.halo_size
+    fbuf = rng.standard_normal((fbuf_rows, 6)).astype(np.float32)
+    d = {k: jnp.asarray(v[0]) for k, v in tables.items()}
+    f = make_device_block_spmm_fn(
+        d, jnp.asarray(sg.in_deg[0]), sg.n_max, fbuf_rows, tile)
+    out = np.asarray(f(jnp.asarray(fbuf)))
+
+    # dense reference over the padded edge list
+    e = sg.edge_count[0]
+    src, dst = sg.edge_src[0][:e], sg.edge_dst[0][:e]
+    ref = np.zeros((sg.n_max, 6), np.float32)
+    np.add.at(ref, dst, fbuf[src])
+    ref /= sg.in_deg[0][:, None]
+    np.testing.assert_allclose(out[:sg.n_max], ref, rtol=2e-2, atol=2e-2)
+
+
 def test_trainer_block_clustered_matches_xla():
     """The intended production path: cluster-renumbered local ids feed
     the block-dense plan real dense tiles; training must still match the
