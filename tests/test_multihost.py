@@ -2,10 +2,12 @@
 
 The real multi-host flow — jax.distributed.initialize + process-0-only
 partitioning with peers polling the shared filesystem (the analogue of
-reference main.py:32-59's node_rank-0 partition + spawn) — cannot run in
-a single-host CI, so these tests pin its pieces: the node-count math
-driving initialize(), _await_partition_artifact's success/timeout/
-mismatch behavior, and prepare()'s process-role branches under mocked
+reference main.py:32-59's node_rank-0 partition + spawn) — runs for
+real in test_two_process_end_to_end (two coordinated CPU processes over
+localhost, the TPU analogue of the reference's localhost-gloo trick);
+the remaining tests pin its pieces cheaply: the node-count math driving
+initialize(), _await_partition_artifact's success/timeout/mismatch
+behavior, and prepare()'s process-role branches under mocked
 process_count/process_index.
 """
 
@@ -136,16 +138,18 @@ def test_prepare_process0_partitions_and_saves(tmp_path, monkeypatch):
     sg, eval_graphs = prepare(args)
     assert sg.num_parts == 2
     assert eval_graphs is None  # --no-eval
-    # artifact saved for the peers to pick up
+    # artifact saved for the peers to pick up ("-c": the default
+    # cluster local-reorder is part of the artifact's cache key)
     assert ShardedGraph.exists(
-        os.path.join(args.partition_dir, args.graph_name or
-                     "synthetic:200:6:8:4-2-metis-vol-trans"))
+        os.path.join(args.partition_dir,
+                     "synthetic:200:6:8:4-2-metis-vol-trans-c"))
 
 
 def test_prepare_nonzero_process_loads_artifact(tmp_path, monkeypatch):
     """A non-zero process must NEVER partition — it polls for process
     0's artifact."""
-    art = str(tmp_path / "parts" / "synthetic:200:6:8:4-2-metis-vol-trans")
+    art = str(tmp_path / "parts"
+              / "synthetic:200:6:8:4-2-metis-vol-trans-c")
     _make_artifact(art)
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(jax, "process_index", lambda: 1)
@@ -163,3 +167,58 @@ def test_prepare_single_process_partitions(tmp_path, monkeypatch):
     sg, _ = prepare(_args(tmp_path))
     assert sg.num_parts == 2
     assert int(sg.inner_count.sum()) == 200
+
+
+def test_two_process_end_to_end(tmp_path):
+    """The real thing: two OS processes rendezvous through
+    jax.distributed.initialize over localhost, each drives 2 of the 4
+    partitions of ONE SPMD training job (process 0 partitions, process
+    1 polls the shared artifact), and both finish with identical
+    results files."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # free localhost port for the rendezvous
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PYTHONPATH": repo,
+    }
+    procs = []
+    for rank in (0, 1):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(repo, "main.py"),
+             "--dataset", "synthetic:600:8:12:4",
+             "--n-partitions", "4", "--parts-per-node", "2",
+             "--node-rank", str(rank),
+             "--master-addr", "127.0.0.1", "--port", str(port),
+             "--n-epochs", "6", "--n-hidden", "16", "--n-layers", "2",
+             "--enable-pipeline", "--log-every", "3",
+             "--fix-seed", "--seed", "3",
+             "--partition-dir", str(tmp_path / "parts"),
+             "--model-dir", str(tmp_path / f"model{rank}"),
+             "--results-dir", str(tmp_path / f"results{rank}")],
+            env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+    # both ranks ran the SAME SPMD program: identical final results
+    res = []
+    for rank in (0, 1):
+        d = tmp_path / f"results{rank}"
+        files = list(d.glob("*.txt"))
+        assert files, outs[rank][-1000:]
+        res.append(files[0].read_text())
+    assert res[0] == res[1]
+    assert "Accuracy" in res[0]
