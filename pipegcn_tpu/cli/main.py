@@ -185,6 +185,10 @@ def run(args) -> dict:
     from ..parallel.trainer import TrainConfig, Trainer
 
     sg, eval_graphs = prepare(args)
+    # partition-size report (reference prints each rank's node count at
+    # setup, train.py:267-268)
+    sizes = ", ".join(str(int(c)) for c in sg.inner_count)
+    print(f"partition sizes (inner nodes per device): {sizes}")
 
     n_feat = args.n_feat or sg.n_feat
     n_class = args.n_class or sg.n_class
