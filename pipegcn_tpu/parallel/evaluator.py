@@ -86,13 +86,15 @@ class ShardedEvaluator:
                 return halo_exchange(h, d["send_idx"], d["send_mask"],
                                      PARTS_AXIS, P)
 
-            # reuse the trainer's device-resident kernel tables when
-            # evaluating its own shards (use_tables): the trainer may
-            # have trimmed the raw edge list from HBM, and the kernels
-            # are the faster aggregation anyway. Foreign graphs
-            # (inductive val/test) carry raw edges and no tables.
-            spmm = trainer.make_device_spmm_closure(d) if use_tables \
-                else None
+            # aggregate through kernel tables when the data carries them
+            # (use_tables): the trainer's own tables for the
+            # transductive covers-exactly case, or bucket tables built
+            # for a foreign (inductive) eval graph — both beat the
+            # raw-edge gather path. Shapes come from THIS sg, which may
+            # be sharded differently from the training graph.
+            spmm = trainer.make_device_spmm_closure(
+                d, n_max=n_max, n_src_rows=n_max + sg.halo_size,
+            ) if use_tables else None
             logits, _ = forward(
                 params, self._cfg, d["feat"], d["edge_src"],
                 d["edge_dst"], d["in_deg"], n_max,
@@ -125,10 +127,12 @@ class ShardedEvaluator:
             lambda _: repl, trainer.state["norm"])
         data_spec = jax.tree_util.tree_map(lambda _: spec, self._dev_data)
         # pallas interpret mode (CPU testing) hits an internal VMA
-        # mismatch in jax's HLO interpreter; relax the check there only
-        # (same workaround as the train step, trainer._build_step)
+        # mismatch in jax's HLO interpreter; relax the check ONLY when
+        # this evaluator's own trace contains the pallas kernel (its
+        # tables are in the data) — a foreign-graph eval under a pallas
+        # trainer runs bucket tables and keeps the check
         check_vma = not (use_tables
-                         and trainer._pallas_tables is not None
+                         and "spmm_esrc" in self._dev_data
                          and getattr(trainer, "_pallas_interpret", False))
         self._run = jax.jit(jax.shard_map(
             eval_fn,
@@ -168,15 +172,39 @@ class ShardedEvaluator:
             "test_mask": sg.test_mask,
             "train_mask": sg.train_mask,
         }
+        use_tables = False
+        if trainer._edges_trimmed:
+            # the training step aggregates through kernel tables, so
+            # repeated evals of this foreign graph deserve the same:
+            # build bucket tables for ITS shards (the general-purpose
+            # kernel)
+            from ..ops.bucket_spmm import build_sharded_bucket_tables
+
+            arrs.update(build_sharded_bucket_tables(sg))
+            use_tables = True
+            if not trainer.cfg.use_pp:
+                # the raw edge arrays' only consumer is the pp
+                # precompute — without it, never upload them at all
+                # (mirrors Trainer._put_data skip_edges)
+                dummy = np.zeros((trainer.P, 8), np.int32)
+                arrs["edge_src"] = dummy
+                arrs["edge_dst"] = dummy
         data = {
             k: jax.device_put(jnp.asarray(v), trainer._shard)
             for k, v in arrs.items()
         }
         if trainer.cfg.use_pp:
             # layer 0 consumes the precomputed [feat, mean_neigh] concat;
-            # rebuild it for this graph's own edges/degrees
+            # rebuild it for this graph's own edges/degrees (the raw
+            # edge arrays' only consumer when tables are active)
             data["feat"] = trainer._precompute_pp(sg, data)
-        return ShardedEvaluator(trainer, sg, data)
+        if use_tables and trainer.cfg.use_pp:
+            # the precompute above was the edges' last consumer; drop
+            # them from HBM like the trainer does
+            dummy = jnp.zeros((trainer.P, 8), jnp.int32)
+            data["edge_src"] = jax.device_put(dummy, trainer._shard)
+            data["edge_dst"] = jax.device_put(dummy, trainer._shard)
+        return ShardedEvaluator(trainer, sg, data, use_tables=use_tables)
 
     # ------------------------------------------------------------------
     def _mask(self, mask_key: str) -> jax.Array:

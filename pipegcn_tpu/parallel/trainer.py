@@ -382,32 +382,39 @@ class Trainer:
 
     # ---------------- the train step ----------------------------------
 
-    def make_device_spmm_closure(self, d: Dict[str, jax.Array]):
+    def make_device_spmm_closure(self, d: Dict[str, jax.Array],
+                                 n_max: Optional[int] = None,
+                                 n_src_rows: Optional[int] = None):
         """Per-device mean-aggregation closure over the stripped (no
-        leading device axis) table arrays in `d`, matching the trainer's
-        resolved spmm_impl — or None for the raw-edge XLA path. Shared
-        by the train step and the sharded evaluator (which reuses the
-        same device-resident tables instead of the raw edge list)."""
-        sg, cfg = self.sg, self.cfg
-        n_max, H = sg.n_max, sg.halo_size
-        if self._pallas_tables is not None:
+        leading device axis) table arrays in `d` — or None when `d`
+        carries no kernel tables (raw-edge XLA path). The kernel kind is
+        read off the table keys present, so the same builder serves the
+        train step (tables matching cfg.spmm_impl) and the sharded
+        evaluator (whose foreign eval graphs carry bucket tables
+        regardless of the training impl). Shape overrides cover eval
+        graphs sharded differently from the training graph."""
+        cfg = self.cfg
+        n_max = self.sg.n_max if n_max is None else n_max
+        if n_src_rows is None:
+            n_src_rows = n_max + self.sg.halo_size
+        if "spmm_esrc" in d:
             from ..ops.pallas_spmm import make_device_spmm_fn
 
             return make_device_spmm_fn(
-                d, n_max, n_max + H, self._pallas_max_e,
+                d, n_max, n_src_rows, self._pallas_max_e,
                 getattr(self, "_pallas_interpret", False), cfg.spmm_chunk,
             )
-        if self._bucket_tables is not None:
+        if "bkt_fwd_inv" in d:
             from ..ops.bucket_spmm import make_device_bucket_spmm_fn
 
             return make_device_bucket_spmm_fn(
-                d, d["in_deg"], n_max + H, chunk_edges=cfg.spmm_chunk,
+                d, d["in_deg"], n_src_rows, chunk_edges=cfg.spmm_chunk,
             )
-        if self._block_tables is not None:
+        if "blk_a" in d:
             from ..ops.block_spmm import make_device_block_spmm_fn
 
             return make_device_block_spmm_fn(
-                d, d["in_deg"], n_max, n_max + H, self._block_tile,
+                d, d["in_deg"], n_max, n_src_rows, self._block_tile,
                 chunk_edges=cfg.spmm_chunk,
             )
         return None

@@ -109,6 +109,25 @@ def test_sharded_eval_fresh_graph_inductive():
         assert ev.sg is not t.sg  # really rebuilt
 
 
+def test_sharded_eval_foreign_graph_through_bucket_tables():
+    """A kernel-table trainer evaluating FOREIGN (inductive) graphs
+    builds bucket tables for their shards and drops their raw edges —
+    results must still match single-device eval."""
+    g = synthetic_graph(num_nodes=500, avg_degree=8, n_feat=12, n_class=5,
+                        seed=35)
+    train_g, val_g, test_g = inductive_split(g)
+    t = _trainer(train_g, use_pp=True, spmm_impl="bucket")
+    for e in range(3):
+        t.train_epoch(e)
+    for eg, mask in ((val_g, "val_mask"), (test_g, "test_mask")):
+        full = t.evaluate(eg, mask)
+        sharded = t.evaluate(eg, mask, sharded=True)
+        assert full == pytest.approx(sharded, abs=1e-9)
+        ev = t._get_sharded_evaluator(eg)
+        assert "bkt_fwd_inv" in ev._dev_data       # tables built
+        assert ev._dev_data["edge_src"].shape[-1] == 8  # edges dropped
+
+
 def test_sharded_eval_same_nodes_different_edges_rebuilds():
     """A graph sharing the training graph's node set but with different
     edges must NOT silently reuse the trainer's arrays (the edge
