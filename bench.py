@@ -270,6 +270,7 @@ def main():
         cluster = locality_clusters(g, seed=0)
         sg = ShardedGraph.build(g, parts, n_parts=n_parts, cluster=cluster)
         sg.save(part_path)
+        sg.cache_dir = part_path  # cache derived kernel tables too
         print(f"# built partitions ({time.perf_counter()-t0:.1f}s)",
               file=sys.stderr)
 

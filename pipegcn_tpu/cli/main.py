@@ -129,6 +129,8 @@ def prepare(args):
                                     cluster=cluster)
             os.makedirs(args.partition_dir, exist_ok=True)
             sg.save(part_path)
+            # first runs cache their derived kernel tables too
+            sg.cache_dir = part_path
     return sg, eval_graphs
 
 
