@@ -196,8 +196,12 @@ def main():
     if args.stage >= 1:
         args.fused, args.blocks = 1, min(args.blocks, 3)
         args.no_compare, args.sweep_spmm = True, False
+        # the most battle-tested kernel: a crash may have been a kernel
+        # (e.g. Pallas) issue rather than the tunnel
+        args.spmm_impl = "bucket"
     if args.stage >= 2:
         args.small = True
+        args.spmm_impl = "xla"
     if args.stage >= 3:
         args.cpu = True
 
