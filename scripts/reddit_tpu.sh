@@ -1,0 +1,23 @@
+# The TPU-tuned Reddit configuration: same model/optimization as the
+# reference reproduction (scripts/reddit.sh) plus the TPU-native
+# extensions — bf16 compute, the auto-selected scatter-free aggregation
+# kernel, cluster-renumbered local ids (dense tiles for the block
+# kernel), fused epoch dispatches, and mesh-sharded evaluation.
+python main.py \
+  --dataset reddit \
+  --dropout 0.5 \
+  --lr 0.01 \
+  --n-partitions "${N_PARTITIONS:-2}" \
+  --n-epochs 3000 \
+  --model graphsage \
+  --n-layers 4 \
+  --n-hidden 256 \
+  --log-every 10 \
+  --inductive \
+  --enable-pipeline \
+  --use-pp \
+  --dtype bfloat16 \
+  --spmm-impl auto \
+  --local-reorder cluster \
+  --fused-epochs 4 \
+  --sharded-eval
