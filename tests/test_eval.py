@@ -66,6 +66,18 @@ def test_sharded_eval_through_kernel_tables_matches():
     assert ev._dev_data["edge_src"] is t.data["edge_src"]
 
 
+def test_sharded_eval_through_block_tables_matches():
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
+                        seed=36)
+    t = _trainer(g, spmm_impl="block")
+    assert t._edges_trimmed
+    for e in range(3):
+        t.train_epoch(e)
+    full = t.evaluate(g, "val_mask")
+    sharded = t.evaluate(g, "val_mask", sharded=True)
+    assert full == pytest.approx(sharded, abs=1e-9)
+
+
 def test_sharded_eval_through_pallas_tables_matches():
     # pallas interpret mode on the CPU mesh needs the evaluator's
     # check_vma relaxation (same as the train step's)
