@@ -182,28 +182,21 @@ class ShardedEvaluator:
 
             arrs.update(build_sharded_bucket_tables(sg))
             use_tables = True
-            if not trainer.cfg.use_pp:
-                # the raw edge arrays' only consumer is the pp
-                # precompute — without it, never upload them at all
-                # (mirrors Trainer._put_data skip_edges)
-                dummy = np.zeros((trainer.P, 8), np.int32)
-                arrs["edge_src"] = dummy
-                arrs["edge_dst"] = dummy
+            # the pp precompute also aggregates through the tables, so
+            # the raw edge arrays never need to reach the device
+            # (mirrors Trainer._put_data skip_edges)
+            dummy = np.zeros((trainer.P, 8), np.int32)
+            arrs["edge_src"] = dummy
+            arrs["edge_dst"] = dummy
         data = {
             k: jax.device_put(jnp.asarray(v), trainer._shard)
             for k, v in arrs.items()
         }
         if trainer.cfg.use_pp:
             # layer 0 consumes the precomputed [feat, mean_neigh] concat;
-            # rebuild it for this graph's own edges/degrees (the raw
-            # edge arrays' only consumer when tables are active)
+            # rebuild it for this graph's own edges/degrees (through the
+            # kernel tables when present)
             data["feat"] = trainer._precompute_pp(sg, data)
-        if use_tables and trainer.cfg.use_pp:
-            # the precompute above was the edges' last consumer; drop
-            # them from HBM like the trainer does
-            dummy = jnp.zeros((trainer.P, 8), jnp.int32)
-            data["edge_src"] = jax.device_put(dummy, trainer._shard)
-            data["edge_dst"] = jax.device_put(dummy, trainer._shard)
         return ShardedEvaluator(trainer, sg, data, use_tables=use_tables)
 
     # ------------------------------------------------------------------

@@ -100,8 +100,15 @@ class Trainer:
         self._edges_trimmed = (self._pallas_tables is not None
                                or self._bucket_tables is not None
                                or self._block_tables is not None)
-        self.data = self._put_data(
-            skip_edges=self._edges_trimmed and not cfg.use_pp)
+        # bucket/block tables can also serve the pp precompute, so the
+        # raw edges never reach the device at all; the pallas kernel's
+        # VMEM gate covered the layer widths only, so a pallas trainer
+        # still uploads edges for the (wider) raw-feature precompute
+        pp_via_tables = (self._bucket_tables is not None
+                         or self._block_tables is not None)
+        need_edges = (not self._edges_trimmed) or \
+            (cfg.use_pp and not pp_via_tables)
+        self.data = self._put_data(skip_edges=not need_edges)
         if cfg.use_pp:
             self.data["feat"] = self._precompute_pp()
         if cfg.compute_dtype != jnp.float32:
@@ -109,7 +116,7 @@ class Trainer:
             # HBM read (and layer-0 halo exchange) is half-width; the pp
             # precompute above still ran in f32
             self.data["feat"] = self.data["feat"].astype(cfg.compute_dtype)
-        if self._edges_trimmed and cfg.use_pp:
+        if self._edges_trimmed and need_edges:
             # edges were uploaded only for the precompute above; drop
             # them now
             dummy = jnp.zeros((self.P, 8), jnp.int32)
@@ -356,29 +363,48 @@ class Trainer:
 
         Defaults to the trainer's own sharded graph/data; an explicit
         (sg, data) pair computes the same concat for another graph on the
-        same mesh (the sharded evaluator's use_pp input)."""
+        same mesh (the sharded evaluator's use_pp input).
+
+        Aggregates through bucket/block kernel tables when `data`
+        carries them (the raw edge list then never needs to reach the
+        device at all); the pallas kernel is excluded — its VMEM gate
+        was checked for the layer widths, not the raw feature width."""
         sg = sg if sg is not None else self.sg
         data = data if data is not None else self.data
         n_max = sg.n_max
+        use_tables = ("bkt_fwd_inv" in data) or ("blk_a" in data)
 
-        def pp(feat, edge_src, edge_dst, in_deg, send_idx, send_mask):
-            feat, edge_src, edge_dst = feat[0], edge_src[0], edge_dst[0]
-            in_deg, send_idx, send_mask = in_deg[0], send_idx[0], send_mask[0]
-            fbuf = halo_exchange(feat, send_idx, send_mask, PARTS_AXIS, self.P)
-            ah = spmm_mean(fbuf, edge_src, edge_dst, in_deg, n_max,
-                           self.cfg.spmm_chunk, self.cfg.sorted_edges)
-            return jnp.concatenate([feat, ah], axis=1)[None]
+        def pp(d):
+            d = {k: v[0] for k, v in d.items()}
+            fbuf = halo_exchange(d["feat"], d["send_idx"], d["send_mask"],
+                                 PARTS_AXIS, self.P)
+            if use_tables:
+                spmm = self.make_device_spmm_closure(
+                    d, n_max=n_max, n_src_rows=n_max + sg.halo_size)
+                ah = spmm(fbuf)
+            else:
+                ah = spmm_mean(fbuf, d["edge_src"], d["edge_dst"],
+                               d["in_deg"], n_max, self.cfg.spmm_chunk,
+                               self.cfg.sorted_edges)
+            return jnp.concatenate([d["feat"], ah.astype(d["feat"].dtype)],
+                                   axis=1)[None]
 
         spec = PartitionSpec(PARTS_AXIS)
+        keys = ["feat", "in_deg", "send_idx", "send_mask"]
+        if use_tables:
+            keys += [k for k in data
+                     if k.startswith(("bkt_", "blk_", "blkrem_"))]
+        else:
+            keys += ["edge_src", "edge_dst"]
+        d_in = {k: data[k] for k in keys}
         fn = jax.jit(
             jax.shard_map(
                 pp, mesh=self.mesh,
-                in_specs=(spec,) * 6, out_specs=spec,
+                in_specs=(jax.tree_util.tree_map(lambda _: spec, d_in),),
+                out_specs=spec,
             )
         )
-        d = data
-        return fn(d["feat"], d["edge_src"], d["edge_dst"], d["in_deg"],
-                  d["send_idx"], d["send_mask"])
+        return fn(d_in)
 
     # ---------------- the train step ----------------------------------
 

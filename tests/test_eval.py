@@ -67,15 +67,23 @@ def test_sharded_eval_through_kernel_tables_matches():
 
 
 def test_sharded_eval_through_block_tables_matches():
+    """Block trainer with use_pp: the layer-0 precompute AND the
+    per-layer aggregation run through the block tables, the raw edge
+    arrays never reach the device, and sharded eval matches the
+    single-device eval (whose pp aggregation uses the raw-edge path) to
+    1e-9 — pinning the pp-through-block-tables numerics."""
     g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
                         seed=36)
-    t = _trainer(g, spmm_impl="block")
+    t = _trainer(g, spmm_impl="block", use_pp=True)
     assert t._edges_trimmed
+    assert t.data["edge_src"].shape[-1] != t.sg.e_max  # never uploaded
     for e in range(3):
         t.train_epoch(e)
     full = t.evaluate(g, "val_mask")
     sharded = t.evaluate(g, "val_mask", sharded=True)
     assert full == pytest.approx(sharded, abs=1e-9)
+    ev = t._get_sharded_evaluator(g)
+    assert ev._dev_data["edge_src"] is t.data["edge_src"]  # dummies reused
 
 
 def test_sharded_eval_through_pallas_tables_matches():
