@@ -119,15 +119,31 @@ class BlockPlan:
         # ---- dense blocks ----
         dense_ids = uniq[dense_sel]
         B = int(dense_ids.shape[0])
-        # one vectorized scatter-add over all dense-block edges (a
-        # per-block Python loop is minutes at 100M-edge scale)
+        # vectorized scatter-add over all dense-block edges (a per-block
+        # Python loop is minutes at 100M-edge scale), chunked over block
+        # ranges so the int64 bincount transient stays ~2 GB instead of
+        # B*T*S*8 bytes (17 GB at Reddit scale)
         in_dense_o = dense_sel[np.searchsorted(uniq, bid_o)]
         k_of_edge = np.searchsorted(dense_ids, bid_o[in_dense_o])
-        flat_idx = (k_of_edge * (T * S)
-                    + (dst_o[in_dense_o] % T) * S + (src_o[in_dense_o] % S))
-        self.a_blocks = np.bincount(
-            flat_idx, minlength=B * T * S
-        ).astype(np.float32).reshape(B, T, S)
+        src_d = src_o[in_dense_o] % S
+        dst_d = dst_o[in_dense_o] % T
+        self.a_blocks = np.zeros((B, T, S), np.float32)
+        blk_chunk = max(1, (1 << 28) // (T * S))  # ~2 GB int64 transient
+        # k_of_edge is ascending (edges sorted by bid) -> one searchsorted
+        # split per chunk boundary instead of boolean masks
+        bounds = np.searchsorted(
+            k_of_edge, np.arange(0, B + blk_chunk, blk_chunk))
+        for ci in range(len(bounds) - 1):
+            lo, hi = bounds[ci], bounds[ci + 1]
+            if lo == hi:
+                continue
+            k0 = ci * blk_chunk
+            n_blk = min(blk_chunk, B - k0)
+            flat = ((k_of_edge[lo:hi] - k0) * (T * S)
+                    + dst_d[lo:hi] * S + src_d[lo:hi])
+            self.a_blocks[k0:k0 + n_blk] += np.bincount(
+                flat, minlength=n_blk * T * S
+            ).astype(np.float32).reshape(n_blk, T, S)
         bd = (dense_ids // n_src_tiles).astype(np.int64)
         bs = (dense_ids % n_src_tiles).astype(np.int64)
 
