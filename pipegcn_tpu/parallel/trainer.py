@@ -149,7 +149,7 @@ class Trainer:
     # ---------------- pallas spmm selection ---------------------------
 
     # bump when any kernel-table layout changes: stale caches must miss
-    _TABLES_FORMAT = 2  # v2: int8 A-blocks under the 1-byte-first budget
+    _TABLES_FORMAT = 3  # v3: bit-packed A-blocks (blk_a_bits)
 
     def _cached_tables(self, kind: str, build_fn):
         """Disk-cache derived kernel tables next to the partition
@@ -372,7 +372,8 @@ class Trainer:
         sg = sg if sg is not None else self.sg
         data = data if data is not None else self.data
         n_max = sg.n_max
-        use_tables = ("bkt_fwd_inv" in data) or ("blk_a" in data)
+        use_tables = ("bkt_fwd_inv" in data) or ("blk_a" in data) \
+            or ("blk_a_bits" in data)
 
         def pp(d):
             d = {k: v[0] for k, v in d.items()}
@@ -436,7 +437,7 @@ class Trainer:
             return make_device_bucket_spmm_fn(
                 d, d["in_deg"], n_src_rows, chunk_edges=cfg.spmm_chunk,
             )
-        if "blk_a" in d:
+        if "blk_a" in d or "blk_a_bits" in d:
             from ..ops.block_spmm import make_device_block_spmm_fn
 
             return make_device_block_spmm_fn(
