@@ -259,8 +259,10 @@ def main():
         name = f"bench-reddit-{n_parts}"
 
     # "-c" suffix: artifacts with cluster-reordered local ids (the same
-    # format; a different, locality-aware numbering)
-    part_path = os.path.join("partitions", name + "-c")
+    # format; a different, locality-aware numbering). "2": generator
+    # revision (simple graph — duplicate sampled pairs deduped, matching
+    # the real Reddit's multiplicity-1 adjacency).
+    part_path = os.path.join("partitions", name + "-c2")
     t0 = time.perf_counter()
     if ShardedGraph.exists(part_path):
         sg = ShardedGraph.load(part_path)

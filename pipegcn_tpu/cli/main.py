@@ -164,8 +164,10 @@ def run(args) -> dict:
             warnings.warn("Please enable `--fix-seed` for multi-node training.")
         args.seed = random.randint(0, 1 << 31)
 
-    if args.model != "graphsage":
+    if args.model not in ("graphsage", "gcn"):
         raise ValueError(f"unknown model: {args.model}")
+    if args.model == "gcn" and args.use_pp:
+        raise ValueError("--use-pp is a GraphSAGE-only optimization")
     if args.backend in ("nccl", "mpi"):
         raise NotImplementedError(
             f"backend {args.backend!r} is not supported; use 'xla'"
@@ -198,6 +200,7 @@ def run(args) -> dict:
     layer_sizes = (n_feat,) + (args.n_hidden,) * (args.n_layers - 1) + (n_class,)
     cfg = ModelConfig(
         layer_sizes=layer_sizes,
+        model=args.model,
         n_linear=args.n_linear,
         use_pp=args.use_pp,
         norm=None if args.norm == "none" else args.norm,
@@ -205,6 +208,8 @@ def run(args) -> dict:
         train_size=n_train,
         spmm_chunk=args.spmm_chunk or None,
         spmm_impl=args.spmm_impl,
+        block_tile=args.block_tile,
+        block_nnz=args.block_nnz or None,
         dtype=args.dtype,
     )
     tcfg = TrainConfig(

@@ -113,6 +113,14 @@ def create_parser() -> argparse.ArgumentParser:
                              "scatter-free degree-bucketed kernel, the "
                              "hybrid block-dense MXU kernel, or "
                              "auto-select by shard size")
+    parser.add_argument("--block-tile", "--block_tile", type=int,
+                        default=256,
+                        help="dense-tile edge length for the block-dense "
+                             "kernel")
+    parser.add_argument("--block-nnz", "--block_nnz", type=int, default=0,
+                        help="minimum edges for a tile pair to go dense "
+                             "in the block kernel (0 = read-cost "
+                             "break-even)")
     parser.add_argument("--fused-epochs", "--fused_epochs", type=int,
                         default=1,
                         help="epochs per compiled dispatch (lax.scan); "

@@ -37,21 +37,43 @@ def synthetic_graph(
     comm = rng.integers(0, n_class, size=num_nodes)
 
     n_edges = num_nodes * avg_degree // 2
-    # Endpoint A uniform; endpoint B intra-community w.p. `homophily`.
-    a = rng.integers(0, num_nodes, size=n_edges)
-    intra = rng.random(n_edges) < homophily
-    # For intra edges, pick B from the same community as A via a shuffled
-    # community-sorted lookup; for inter edges, uniform.
     order = np.argsort(comm, kind="stable")
     sorted_comm = comm[order]
     starts = np.searchsorted(sorted_comm, np.arange(n_class))
     ends = np.searchsorted(sorted_comm, np.arange(n_class), side="right")
-    ca = comm[a]
-    span = np.maximum(ends[ca] - starts[ca], 1)
-    b_intra = order[starts[ca] + (rng.integers(0, 1 << 62, size=n_edges) % span)]
-    b_uniform = rng.integers(0, num_nodes, size=n_edges)
-    b = np.where(intra, b_intra, b_uniform)
 
+    def sample_pairs(k: int) -> np.ndarray:
+        """k undirected candidate pairs as canonical lo*N+hi keys
+        (self-pairs dropped). Endpoint A uniform; endpoint B
+        intra-community w.p. `homophily` via a community-sorted
+        lookup, else uniform."""
+        a = rng.integers(0, num_nodes, size=k)
+        intra = rng.random(k) < homophily
+        ca = comm[a]
+        span = np.maximum(ends[ca] - starts[ca], 1)
+        b_intra = order[starts[ca]
+                        + (rng.integers(0, 1 << 62, size=k) % span)]
+        b = np.where(intra, b_intra, rng.integers(0, num_nodes, size=k))
+        lo, hi = np.minimum(a, b), np.maximum(a, b)
+        return (lo * num_nodes + hi)[lo != hi]
+
+    # The real datasets this generator stands in for (Reddit, ogbn-*)
+    # are SIMPLE graphs; duplicate sampled pairs are dropped and topped
+    # up so the graph is simple at exactly the requested edge count
+    # (multiplicity-1 adjacency is also what lets the block-dense
+    # kernel bit-pack its A tiles, ops/block_spmm.pack_a_blocks).
+    keys = np.unique(sample_pairs(n_edges))
+    while keys.size < n_edges:
+        extra = sample_pairs(2 * (n_edges - keys.size))
+        merged = np.union1d(keys, extra)
+        if merged.size == keys.size:  # saturated (requested degree
+            break                     # exceeds the simple-pair space)
+        keys = merged
+    if keys.size > n_edges:
+        keys = rng.permutation(keys)[:n_edges]
+
+    a = keys // num_nodes
+    b = keys % num_nodes
     src = np.concatenate([a, b]).astype(np.int64)
     dst = np.concatenate([b, a]).astype(np.int64)
 

@@ -42,6 +42,13 @@ PsumFn = Callable[[jax.Array], jax.Array]
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     layer_sizes: Tuple[int, ...]   # [in_feat, hidden..., n_class]
+    # 'graphsage' (reference parity, module/layer.py) | 'gcn' (framework
+    # extension: symmetric-normalized convolution, h_i = W Σ_j h_j /
+    # sqrt(d_i d_j) with the self-loop already in the finalized graph).
+    # GCN reuses every aggregation kernel unchanged: the src-side
+    # 1/sqrt(d) scaling happens on the owner BEFORE the halo exchange,
+    # the dst side folds into the mean kernel's output (mean * sqrt(d)).
+    model: str = "graphsage"
     n_linear: int = 0              # dense tail layers (Yelp uses 2)
     use_pp: bool = False
     norm: Optional[str] = "layer"  # 'layer' | 'batch' | None
@@ -53,7 +60,18 @@ class ModelConfig:
     # with cli/parser.py --spmm-impl and Trainer._setup_pallas_spmm
     spmm_impl: str = "xla"
     block_tile: int = 256          # dense-tile edge for spmm_impl='block'
+    # minimum edges for a (dst, src) tile to go dense; None = the
+    # read-cost break-even tile*tile/n_feat (block_spmm.BlockPlan)
+    block_nnz: Optional[int] = None
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
+
+    def __post_init__(self):
+        if self.model not in ("graphsage", "gcn"):
+            raise ValueError(f"unknown model: {self.model}")
+        if self.model == "gcn" and self.use_pp:
+            # the pp precompute caches SAGE's mean-neighbor concat;
+            # GCN's first layer aggregates like every other layer
+            raise ValueError("use_pp is a GraphSAGE-only optimization")
 
     @property
     def n_layers(self) -> int:
@@ -102,6 +120,12 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
                 bound = 1.0 / (2 * d_in) ** 0.5
                 layers.append({
                     "w": _uniform(k1, (2 * d_in, d_out), bound),
+                    "b": _uniform(k2, (d_out,), bound),
+                })
+            elif cfg.model == "gcn":
+                bound = 1.0 / d_in ** 0.5
+                layers.append({
+                    "w": _uniform(k1, (d_in, d_out), bound),
                     "b": _uniform(k2, (d_out,), bound),
                 })
             else:
@@ -267,6 +291,17 @@ def forward(
         if training and cfg.dropout > 0:
             rng, sub = jax.random.split(rng)
         if is_graph:
+            is_gcn = cfg.model == "gcn"
+            if is_gcn:
+                # src-side symmetric normalization h_j / sqrt(d_j),
+                # applied while every row is still on its owner (so the
+                # halo exchange ships already-scaled values and halo
+                # degrees are never needed); for full-graph eval the
+                # rows ARE all the sources. d = full-graph in-degree of
+                # A + I on both endpoints (the PyG gcn_norm convention).
+                d_sqrt = jnp.sqrt(in_deg.astype(jnp.float32))
+                h = (h.astype(jnp.float32)
+                     / d_sqrt[: h.shape[0], None]).astype(cdt)
             if training or halo_eval:
                 if (i > 0 or not cfg.use_pp) and comm_update is not None:
                     h = comm_update(i, h)
@@ -284,13 +319,23 @@ def forward(
                         ah = spmm_mean(h, edge_src, edge_dst, in_deg,
                                        n_dst, cfg.spmm_chunk,
                                        cfg.sorted_edges)
-                    h = (dense(h[:n_dst], lp["w1"], lp["b1"], out_dt)
-                         + dense(ah.astype(cdt), lp["w2"], lp["b2"], out_dt))
+                    if is_gcn:
+                        # mean * sqrt(d_i) = (Σ_j h_j/sqrt(d_j))/sqrt(d_i)
+                        ah = ah.astype(jnp.float32) * d_sqrt[:, None]
+                        h = dense(ah.astype(cdt), lp["w"], lp["b"],
+                                  out_dt)
+                    else:
+                        h = (dense(h[:n_dst], lp["w1"], lp["b1"], out_dt)
+                             + dense(ah.astype(cdt), lp["w2"], lp["b2"],
+                                     out_dt))
             else:
                 lp = params["layers"][i]
                 ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,
                                cfg.spmm_chunk, cfg.sorted_edges)
-                if cfg.use_pp and i == 0:
+                if is_gcn:
+                    ah = ah.astype(jnp.float32) * d_sqrt[:, None]
+                    h = dense(ah.astype(cdt), lp["w"], lp["b"], out_dt)
+                elif cfg.use_pp and i == 0:
                     if not eval_pp_agg:
                         raise ValueError(
                             "use_pp model evaluated without eval_pp_agg"

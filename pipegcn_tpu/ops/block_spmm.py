@@ -80,6 +80,86 @@ def _unpack_bits(blks: jax.Array, s: int, compute_dtype) -> jax.Array:
     return bits.reshape(blks.shape[:-1] + (s,)).astype(compute_dtype)
 
 
+def _max_group_count(keys: np.ndarray, n_groups: int) -> int:
+    return max(int(np.bincount(keys, minlength=n_groups).max(initial=0)),
+               1)
+
+
+def _group_by_key(keys, vals_a, vals_b, n_groups, widths, pad_a, pad_b):
+    """Bucket the (vals_a[i], vals_b[i]) pairs of each key into
+    power-of-2 width classes by the key's pair count — the tile-level
+    analogue of bucket_spmm's degree bucketing. A flat [n_groups, K_max]
+    layout wastes (K_max - K_mean)/K_max of the dense path (measured 60%
+    at Reddit scale: K_max 90 vs K_mean 36); per-width classes bound the
+    padding at 2x and concentrate it in the cheap small-K classes.
+
+    Returns (mats, inv, counts): mats[w] = (a_mat, b_mat), each
+    [n_w, widths[w]] int32 padded with pad_a/pad_b; inv [n_groups] int32
+    mapping each key to its row in the width-class concatenation (keys
+    with no pairs -> sum(counts), the caller's zero sentinel row);
+    counts[w] = real rows in class w."""
+    order = np.argsort(keys, kind="stable")
+    va, vb = vals_a[order], vals_b[order]
+    cnt = np.bincount(keys, minlength=n_groups)
+    ptr = np.zeros(n_groups + 1, np.int64)
+    np.cumsum(cnt, out=ptr[1:])
+    widths_arr = np.asarray(widths, dtype=np.int64)
+    wid = np.minimum(np.searchsorted(widths_arr, np.maximum(cnt, 1)),
+                     len(widths) - 1)
+    mats, counts = [], []
+    inv = np.full(n_groups, -1, np.int64)
+    offset = 0
+    for w_i, w in enumerate(widths):
+        rows = np.nonzero((wid == w_i) & (cnt > 0))[0]
+        n_w = rows.shape[0]
+        a_mat = np.full((n_w, w), pad_a, np.int32)
+        b_mat = np.full((n_w, w), pad_b, np.int32)
+        if n_w:
+            j = np.arange(w)[None, :]
+            mask = j < cnt[rows][:, None]
+            pos = (ptr[rows][:, None] + j)[mask]
+            r, c = np.nonzero(mask)
+            a_mat[r, c] = va[pos]
+            b_mat[r, c] = vb[pos]
+            inv[rows] = offset + np.arange(n_w)
+        mats.append((a_mat, b_mat))
+        counts.append(n_w)
+        offset += n_w
+    inv[inv < 0] = offset
+    return mats, inv.astype(np.int32), counts
+
+
+def estimate_block_coverage(sg, tile: int, n_feat_hint: int,
+                            nnz_threshold: Optional[int] = None) -> float:
+    """Fraction of real edges lying in (dst-tile, src-tile) blocks dense
+    enough for the MXU path (>= `nnz_threshold`, defaulting to
+    BlockPlan's read-cost break-even).
+
+    The cheap O(E) structural signal `auto` uses to choose between the
+    hybrid block kernel and the pure bucket kernel without paying for a
+    full plan build. High coverage means the layout (usually
+    cluster-renumbered, partition/halo.py `cluster`) concentrates
+    community edges into dense tiles. Counting goes through np.unique
+    on the occupied block ids (O(E) memory) — a dense bincount over the
+    n_dst_tiles x n_src_tiles id space would be tens of GB at
+    10M-node-shard scale."""
+    thr = nnz_threshold if nnz_threshold else max(
+        1, (tile * tile) // max(n_feat_hint, 1))
+    n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
+    dense = tot = 0
+    for r in range(sg.num_parts):
+        e = int(sg.edge_count[r])
+        src = sg.edge_src[r][:e].astype(np.int64)
+        dst = sg.edge_dst[r][:e].astype(np.int64)
+        real = dst < sg.n_max
+        src, dst = src[real], dst[real]
+        _, counts = np.unique((dst // tile) * n_src_tiles + (src // tile),
+                              return_counts=True)
+        dense += int(counts[counts >= thr].sum())
+        tot += int(src.shape[0])
+    return dense / max(tot, 1)
+
+
 class BlockPlan:
     """Host-side hybrid plan for one device's edge list.
 
@@ -87,12 +167,13 @@ class BlockPlan:
       a_blocks:    [B, T, S] f32 — dense block values (1.0 per edge);
                    block B-1 is NOT special; a zero block is appended
                    on device as index B.
-      fwd_blk/fwd_tile: [n_dst_tiles, K] int32 — per destination tile,
-                   the A-block indices (pad B) and source-tile ids
-                   (pad n_src_tiles, the zero tile).
-      bwd_blk/bwd_tile: [n_src_tiles, K2] int32 — per source tile, the
-                   A-block indices and destination-tile ids for the
-                   transpose.
+      fwd_groups/fwd_ginv/fwd_gcounts: destination tiles' (A-block,
+                   source-tile) pair lists, K-bucketed into power-of-2
+                   width classes (_group_by_key) so per-tile padding
+                   never exceeds 2x; fwd_ginv restores tile order from
+                   the class concatenation.
+      bwd_groups/bwd_ginv/bwd_gcounts: the transpose — per source tile,
+                   the A-block and destination-tile pairs.
       rem_*:       remainder edges' bucket tables (fwd + transpose).
     """
 
@@ -102,6 +183,8 @@ class BlockPlan:
                  nnz_threshold: Optional[int] = None,
                  fwd_widths: Optional[Sequence[int]] = None,
                  bwd_widths: Optional[Sequence[int]] = None,
+                 fwd_k_widths: Optional[Sequence[int]] = None,
+                 bwd_k_widths: Optional[Sequence[int]] = None,
                  max_blocks: Optional[int] = None):
         T = S = tile
         self.tile = tile
@@ -168,25 +251,19 @@ class BlockPlan:
         bd = (dense_ids // n_src_tiles).astype(np.int64)
         bs = (dense_ids % n_src_tiles).astype(np.int64)
 
-        def group(keys, vals_blk, vals_tile, n_groups, pad_blk, pad_tile):
-            k_max = int(np.bincount(keys, minlength=n_groups).max(
-                initial=0))
-            k_max = max(k_max, 1)
-            blk = np.full((n_groups, k_max), pad_blk, np.int32)
-            tl = np.full((n_groups, k_max), pad_tile, np.int32)
-            fill = np.zeros(n_groups, np.int64)
-            for i in range(keys.shape[0]):
-                g = keys[i]
-                blk[g, fill[g]] = vals_blk[i]
-                tl[g, fill[g]] = vals_tile[i]
-                fill[g] += 1
-            return blk, tl
-
         blk_idx = np.arange(B, dtype=np.int64)
-        self.fwd_blk, self.fwd_tile = group(
-            bd, blk_idx, bs, n_dst_tiles, B, n_src_tiles)
-        self.bwd_blk, self.bwd_tile = group(
-            bs, blk_idx, bd, n_src_tiles, B, n_dst_tiles)
+        self.fwd_k_widths = list(
+            fwd_k_widths if fwd_k_widths is not None
+            else _bucket_widths(_max_group_count(bd, n_dst_tiles)))
+        self.bwd_k_widths = list(
+            bwd_k_widths if bwd_k_widths is not None
+            else _bucket_widths(_max_group_count(bs, n_src_tiles)))
+        self.fwd_groups, self.fwd_ginv, self.fwd_gcounts = _group_by_key(
+            bd, blk_idx, bs, n_dst_tiles, self.fwd_k_widths,
+            pad_a=B, pad_b=n_src_tiles)
+        self.bwd_groups, self.bwd_ginv, self.bwd_gcounts = _group_by_key(
+            bs, blk_idx, bd, n_src_tiles, self.bwd_k_widths,
+            pad_a=B, pad_b=n_dst_tiles)
 
         # ---- sparse remainder (bucket tables both directions) ----
         r_src, r_dst = src_o[~in_dense_o], dst_o[~in_dense_o]
@@ -208,32 +285,72 @@ class BlockPlan:
                                    self.rem_bwd_widths)
 
 
-def _dense_apply(a_pad, blk_idx, tile_idx, tiles, T, out_rows, n_feat,
-                 compute_dtype, transpose=False, packed=False):
-    """sum_k A[blk_idx[i,k]] (@ or transposed-@) tiles[tile_idx[i,k]]
-    for every group i, via lax.scan. a_pad: [B+1, T, S] in its STORED
-    dtype (possibly int8; last block = zeros) — or, with packed=True,
-    bit-packed [B+1, T, S//8] uint8 — the cast/unpack to the compute
-    dtype happens per scan step on the gathered [K, T, S] slice, so the
-    full A tensor is never materialized in a wider dtype; likewise the
-    backward's A^T lives in the einsum spec, never as a transposed
-    copy. tiles: [n_tiles+1, S, F] (last = zeros). Returns
-    [n_groups*T, F] f32."""
-    spec = "kts,ktf->sf" if transpose else "kts,ksf->tf"
-    s = a_pad.shape[-1] * 8 if packed else a_pad.shape[-1]
+# bound on one dense-apply chunk's materialized A elements (unpacked,
+# compute dtype): 32M elems = 64 MB bf16
+_DENSE_CHUNK_ELEMS = 32 * 1024 * 1024
 
-    def body(_, idx):
-        bi, ti = idx
+
+def _dense_apply(a_pad, groups, ginv, tiles, T, out_rows, n_feat,
+                 compute_dtype, transpose=False, packed=False):
+    """For every output tile i: sum_k A[blk(i,k)] (@ or transposed-@)
+    tiles[tile(i,k)], where the (blk, tile) pair lists are K-bucketed
+    into power-of-2 width classes (`groups`: [(blk_mat, tile_mat)] per
+    class, `ginv` restoring tile order — see _group_by_key).
+
+    a_pad: [B+1, T, S] in its STORED dtype (possibly int8; last block =
+    zeros) — or, with packed=True, bit-packed [B+1, T, S//8] uint8 —
+    the cast/unpack to the compute dtype happens per chunk on the
+    gathered [R, K, T, S] slice, so the full A tensor is never
+    materialized in a wider dtype; likewise the backward's A^T lives in
+    the einsum spec, never as a transposed copy. tiles: [n_tiles+1, S,
+    F] (last = zeros). Returns [n_out_tiles*T, F] f32.
+
+    Each class runs as one batched contraction ([R, T, K*S] @
+    [R, K*S, F] after XLA canonicalization — MXU-shaped), chunked over
+    rows so the unpacked A transient stays bounded."""
+    spec = "rkts,rktf->rsf" if transpose else "rkts,rksf->rtf"
+    s = a_pad.shape[-1] * 8 if packed else a_pad.shape[-1]
+    pad_blk = a_pad.shape[0] - 1
+
+    def compute(bi, ti):  # [R, K] x2 -> [R, T, F] f32
         blks = jnp.take(a_pad, bi, axis=0)
         blks = _unpack_bits(blks, s, compute_dtype) if packed \
             else blks.astype(compute_dtype)
-        tls = jnp.take(tiles, ti, axis=0)       # [K, S|T, F]
-        out = jnp.einsum(spec, blks, tls,
-                         preferred_element_type=jnp.float32)
-        return None, out
+        tls = jnp.take(tiles, ti, axis=0)       # [R, K, S|T, F]
+        return jnp.einsum(spec, blks, tls,
+                          preferred_element_type=jnp.float32)
 
-    _, outs = jax.lax.scan(body, None, (blk_idx, tile_idx))
-    return outs.reshape(-1, n_feat)[:out_rows]
+    outs = []
+    for bi, ti in groups:
+        n_w, k = bi.shape
+        if n_w == 0:
+            continue
+        # bound both transients: unpacked A [R,K,T,S] and the gathered
+        # feature tiles [R,K,S,F]
+        rows_per_chunk = max(
+            1, _DENSE_CHUNK_ELEMS // max(1, k * s * max(T, n_feat)))
+        if n_w <= rows_per_chunk:
+            out = compute(bi, ti)
+        else:
+            n_chunks = -(-n_w // rows_per_chunk)
+            pad_rows = n_chunks * rows_per_chunk - n_w
+            bi_p = jnp.pad(bi, ((0, pad_rows), (0, 0)),
+                           constant_values=pad_blk)
+            ti_p = jnp.pad(ti, ((0, pad_rows), (0, 0)),
+                           constant_values=tiles.shape[0] - 1)
+            shape = (n_chunks, rows_per_chunk, k)
+
+            def body(_, idx):
+                return None, compute(*idx)
+
+            _, chunks = jax.lax.scan(
+                body, None,
+                (bi_p.reshape(shape), ti_p.reshape(shape)))
+            out = chunks.reshape(-1, T, n_feat)[:n_w]
+        outs.append(out)
+    outs.append(jnp.zeros((1, T, n_feat), jnp.float32))  # zero sentinel
+    res = jnp.take(jnp.concatenate(outs, axis=0), ginv, axis=0)
+    return res.reshape(-1, n_feat)[:out_rows]
 
 
 def make_block_spmm_fn(
@@ -261,6 +378,12 @@ def make_block_spmm_fn(
         return [d[k] for k in sorted(d)
                 if k.startswith(prefix) and not k.endswith("inv")]
 
+    def dense_groups(direction):  # [(blk_mat, tile_mat)] in width order
+        bs_ = sorted(k[:-1] for k in d
+                     if k.startswith(f"blk_{direction}_g")
+                     and k.endswith("b"))
+        return [(d[k + "b"], d[k + "t"]) for k in bs_]
+
     packed = "blk_a_bits" in d
 
     def a_padded():
@@ -275,8 +398,8 @@ def make_block_spmm_fn(
     def f(fbuf):
         n_s_tiles = -(-n_src_rows // T)
         tiles = tiles_of(fbuf, n_s_tiles, T)
-        dense = _dense_apply(a_padded(), d["blk_fwd_blk"],
-                             d["blk_fwd_tile"], tiles, T, n_out,
+        dense = _dense_apply(a_padded(), dense_groups("fwd"),
+                             d["blk_fwd_ginv"], tiles, T, n_out,
                              fbuf.shape[-1], fbuf.dtype, packed=packed)
         rem = bucket_aggregate(fbuf, rem_mats("blkrem_fwd_"),
                                d["blkrem_fwd_inv"],
@@ -291,8 +414,8 @@ def make_block_spmm_fn(
         # transpose dense: per source tile, sum A^T @ g_tile
         n_d_tiles = -(-n_out // T)
         g_tiles = tiles_of(gd, n_d_tiles, T)
-        dense = _dense_apply(a_padded(), d["blk_bwd_blk"],
-                             d["blk_bwd_tile"], g_tiles, T, n_src_rows,
+        dense = _dense_apply(a_padded(), dense_groups("bwd"),
+                             d["blk_bwd_ginv"], g_tiles, T, n_src_rows,
                              g.shape[-1], gd.dtype, transpose=True,
                              packed=packed)
         rem = bucket_aggregate(gd, rem_mats("blkrem_bwd_"),
@@ -308,13 +431,17 @@ def plan_to_arrays(p: BlockPlan) -> Dict[str, np.ndarray]:
     """Flatten a BlockPlan into the array dict make_block_spmm_fn uses."""
     arrs = {
         "blk_a": p.a_blocks,
-        "blk_fwd_blk": p.fwd_blk.astype(np.int32),
-        "blk_fwd_tile": p.fwd_tile.astype(np.int32),
-        "blk_bwd_blk": p.bwd_blk.astype(np.int32),
-        "blk_bwd_tile": p.bwd_tile.astype(np.int32),
+        "blk_fwd_ginv": p.fwd_ginv,
+        "blk_bwd_ginv": p.bwd_ginv,
         "blkrem_fwd_inv": p.rem_fwd_inv,
         "blkrem_bwd_inv": p.rem_bwd_inv,
     }
+    for direction, groups in (("fwd", p.fwd_groups),
+                              ("bwd", p.bwd_groups)):
+        for w_i, (a_mat, b_mat) in enumerate(groups):
+            if a_mat.shape[0]:
+                arrs[f"blk_{direction}_g{w_i:02d}b"] = a_mat
+                arrs[f"blk_{direction}_g{w_i:02d}t"] = b_mat
     for b, m in enumerate(p.rem_fwd_mats):
         if m.shape[0]:
             arrs[f"blkrem_fwd_{b:02d}"] = m
@@ -327,6 +454,7 @@ def plan_to_arrays(p: BlockPlan) -> Dict[str, np.ndarray]:
 def build_sharded_block_tables(sg, tile: int = 256,
                                n_feat_hint: int = 256,
                                byte_budget: int = 2 << 30,
+                               nnz_threshold: Optional[int] = None,
                                ) -> Tuple[Dict[str, np.ndarray], int]:
     """Stacked per-device hybrid plans (leading device axis), padded to
     shared shapes: same B (dense block count), same K (per-tile block
@@ -352,7 +480,7 @@ def build_sharded_block_tables(sg, tile: int = 256,
     # unpacks/casts A to the activation dtype at use)
     import ml_dtypes
 
-    def build_plans(cap, fw=None, bw=None):
+    def build_plans(cap, fw=None, bw=None, fk=None, bk=None):
         # fresh ladders unless given: a different block cap changes
         # which edges land in the remainder, and reusing a ladder built
         # for a different remainder can under-size its top bucket —
@@ -360,7 +488,9 @@ def build_sharded_block_tables(sg, tile: int = 256,
         return [
             BlockPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
                       n_src_rows, n_feat_hint, tile=tile,
-                      fwd_widths=fw, bwd_widths=bw, max_blocks=cap)
+                      nnz_threshold=nnz_threshold,
+                      fwd_widths=fw, bwd_widths=bw,
+                      fwd_k_widths=fk, bwd_k_widths=bk, max_blocks=cap)
             for r in range(P)
         ]
 
@@ -390,31 +520,31 @@ def build_sharded_block_tables(sg, tile: int = 256,
             break
         bits = emit_bits
 
-    # unify remainder widths (ladder length = max over devices); the
-    # re-build keeps the SAME cap, so the dense selection — and thus
-    # every remainder degree — is unchanged and the unified ladder
-    # (covering the global max) is safe for every device
+    # unify ladders (length = max over devices): remainder bucket widths
+    # AND dense K-class widths. The re-build keeps the SAME cap, so the
+    # dense selection — and thus every remainder degree and per-tile
+    # block count — is unchanged and the unified ladders (covering the
+    # global max) are safe for every device
     fw_len = max(len(p.rem_fwd_widths) for p in plans)
     bw_len = max(len(p.rem_bwd_widths) for p in plans)
+    fk_len = max(len(p.fwd_k_widths) for p in plans)
+    bk_len = max(len(p.bwd_k_widths) for p in plans)
     fw = [1 << i for i in range(fw_len)]
     bw = [1 << i for i in range(bw_len)]
+    fk = [1 << i for i in range(fk_len)]
+    bk = [1 << i for i in range(bk_len)]
     if any(p.rem_fwd_widths != fw or p.rem_bwd_widths != bw
+           or p.fwd_k_widths != fk or p.bwd_k_widths != bk
            for p in plans):
-        plans = build_plans(cap_for(bits), fw=fw, bw=bw)
+        plans = build_plans(cap_for(bits), fw=fw, bw=bw, fk=fk, bk=bk)
 
     B_max = max(p.a_blocks.shape[0] for p in plans)
-    kf_max = max(p.fwd_blk.shape[1] for p in plans)
-    kb_max = max(p.bwd_blk.shape[1] for p in plans)
     fwd_caps = [max(p.rem_fwd_counts[b] for p in plans)
                 for b in range(fw_len)]
     bwd_caps = [max(p.rem_bwd_counts[b] for p in plans)
                 for b in range(bw_len)]
-
-    def pad_k(mat, k, fill):
-        if mat.shape[1] == k:
-            return mat
-        return np.pad(mat, ((0, 0), (0, k - mat.shape[1])),
-                      constant_values=fill)
+    fk_caps = [max(p.fwd_gcounts[w] for p in plans) for w in range(fk_len)]
+    bk_caps = [max(p.bwd_gcounts[w] for p in plans) for w in range(bk_len)]
 
     def reoffset_inv(inv, counts, caps):
         inv = inv.astype(np.int64)
@@ -437,21 +567,30 @@ def build_sharded_block_tables(sg, tile: int = 256,
             ("blk_a_bits" if emit_bits == 1 else "blk_a"):
                 pack_a_blocks(a_pad) if emit_bits == 1
                 else a_pad.astype(a_dtype),
-            "blk_fwd_blk": np.where(
-                pad_k(p.fwd_blk, kf_max, B) == B, B_max,
-                pad_k(p.fwd_blk, kf_max, B)).astype(np.int32),
-            "blk_fwd_tile": pad_k(p.fwd_tile, kf_max,
-                                  p.n_src_tiles).astype(np.int32),
-            "blk_bwd_blk": np.where(
-                pad_k(p.bwd_blk, kb_max, B) == B, B_max,
-                pad_k(p.bwd_blk, kb_max, B)).astype(np.int32),
-            "blk_bwd_tile": pad_k(p.bwd_tile, kb_max,
-                                  p.n_dst_tiles).astype(np.int32),
+            "blk_fwd_ginv": reoffset_inv(p.fwd_ginv, p.fwd_gcounts,
+                                         fk_caps),
+            "blk_bwd_ginv": reoffset_inv(p.bwd_ginv, p.bwd_gcounts,
+                                         bk_caps),
             "blkrem_fwd_inv": reoffset_inv(p.rem_fwd_inv,
                                            p.rem_fwd_counts, fwd_caps),
             "blkrem_bwd_inv": reoffset_inv(p.rem_bwd_inv,
                                            p.rem_bwd_counts, bwd_caps),
         }
+        for direction, groups, caps in (("fwd", p.fwd_groups, fk_caps),
+                                        ("bwd", p.bwd_groups, bk_caps)):
+            for w_i, (a_mat, b_mat) in enumerate(groups):
+                if not caps[w_i]:
+                    continue
+                # remap this device's pad-block id B to the shared
+                # zero block B_max; pad rows point at it entirely (the
+                # matching tile pad is the zero tile, already shared)
+                a_mat = np.where(a_mat == B, B_max, a_mat)
+                arrs[f"blk_{direction}_g{w_i:02d}b"] = _pad_rows(
+                    a_mat, caps[w_i], B_max).astype(np.int32)
+                arrs[f"blk_{direction}_g{w_i:02d}t"] = _pad_rows(
+                    b_mat, caps[w_i],
+                    p.n_src_tiles if direction == "fwd"
+                    else p.n_dst_tiles).astype(np.int32)
         for b in range(fw_len):
             if fwd_caps[b]:
                 arrs[f"blkrem_fwd_{b:02d}"] = _pad_rows(

@@ -52,6 +52,13 @@ from .halo import (
 )
 from .mesh import PARTS_AXIS, make_mesh
 
+# 'auto' SpMM selection at non-VMEM scale (see _setup_pallas_spmm):
+# the hybrid block kernel only beats bucket when the shard is big
+# enough for dispatch overheads to amortize AND the layout puts a
+# meaningful fraction of edges into MXU-worthy dense tiles
+_AUTO_BLOCK_MIN_EDGES = 1_000_000     # avg edges per device
+_AUTO_BLOCK_MIN_COVERAGE = 0.3        # estimate_block_coverage
+
 
 @dataclasses.dataclass
 class TrainConfig:
@@ -149,7 +156,7 @@ class Trainer:
     # ---------------- pallas spmm selection ---------------------------
 
     # bump when any kernel-table layout changes: stale caches must miss
-    _TABLES_FORMAT = 3  # v3: bit-packed A-blocks (blk_a_bits)
+    _TABLES_FORMAT = 4  # v4: bit-packed A + K-bucketed tile lists
 
     def _cached_tables(self, kind: str, build_fn):
         """Disk-cache derived kernel tables next to the partition
@@ -216,8 +223,12 @@ class Trainer:
     def _setup_pallas_spmm(self) -> None:
         """Resolve cfg.spmm_impl: 'pallas' forces the VMEM-resident CSR
         kernel (ops/pallas_spmm.py), 'bucket' the scatter-free
-        degree-bucketed aggregation (ops/bucket_spmm.py), 'auto' picks
-        pallas when the shard fits the VMEM budget else bucket, 'xla'
+        degree-bucketed aggregation (ops/bucket_spmm.py), 'block' the
+        hybrid dense-tile MXU kernel (ops/block_spmm.py). 'auto' picks
+        pallas when the shard fits the VMEM budget; otherwise block when
+        the shard is large AND its layout concentrates enough edges into
+        dense tiles (estimate_block_coverage), else bucket — the
+        v5e-measured ranking at each regime (docs/PERF_NOTES.md). 'xla'
         (default) keeps gather+segment-sum."""
         from ..ops.pallas_spmm import build_sharded_tables, sharded_applicable
 
@@ -238,19 +249,43 @@ class Trainer:
             self._bucket_tables = self._cached_tables(
                 "bucket", lambda: build_sharded_bucket_tables(self.sg))
 
-        if impl == "bucket":
-            use_bucket()
-            return
-        if impl == "block":
+        def use_block():
             from ..ops.block_spmm import build_sharded_block_tables
 
             w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
             tile = self.cfg.block_tile
+            nnz = self.cfg.block_nnz
             self._block_tables = self._cached_tables(
-                f"block_{tile}_{w_hint}",
+                f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else ""),
                 lambda: build_sharded_block_tables(
-                    self.sg, tile=tile, n_feat_hint=w_hint)[0])
+                    self.sg, tile=tile, n_feat_hint=w_hint,
+                    nnz_threshold=nnz)[0])
             self._block_tile = tile
+
+        def use_large():
+            # non-VMEM shards: the hybrid block-dense kernel wins when
+            # the layout concentrates enough edges into MXU-worthy
+            # tiles (measured on v5e at Reddit scale — see
+            # docs/PERF_NOTES.md); otherwise the dense blocks would be
+            # too few to matter and the scatter-free bucket kernel's
+            # slabbed gathers are the best remaining formulation
+            from ..ops.block_spmm import estimate_block_coverage
+
+            w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
+            if (float(np.mean(self.sg.edge_count)) >= _AUTO_BLOCK_MIN_EDGES
+                    and estimate_block_coverage(
+                        self.sg, self.cfg.block_tile, w_hint,
+                        nnz_threshold=self.cfg.block_nnz)
+                    >= _AUTO_BLOCK_MIN_COVERAGE):
+                use_block()
+            else:
+                use_bucket()
+
+        if impl == "bucket":
+            use_bucket()
+            return
+        if impl == "block":
+            use_block()
             return
 
         # cheap VMEM gate first (needs only shapes) — skip the O(E) table
@@ -263,12 +298,12 @@ class Trainer:
         ]
         w_max = max(widths, default=1)
         if impl == "auto" and not sharded_applicable(n_src_rows, w_max, 0):
-            use_bucket()
+            use_large()
             return
         tables, max_e, n_src_rows = build_sharded_tables(self.sg)
         fits = sharded_applicable(n_src_rows, w_max, max_e)
         if impl == "auto" and not fits:
-            use_bucket()
+            use_large()
             return
         if impl == "pallas" and not fits:
             import warnings

@@ -259,3 +259,39 @@ def test_bitpacked_a_parity_and_selection():
     unpacked = np.asarray(_unpack_bits(jnp2.asarray(packed), 16,
                                        jnp2.float32))
     np.testing.assert_array_equal(unpacked, a)
+
+
+def test_auto_picks_block_on_clustered_large_shards(monkeypatch):
+    """'auto' beyond the VMEM regime: block when the layout has dense-
+    tile coverage (clustered community graph), bucket when it does not
+    (uniform random edges). Thresholds patched down to test scale."""
+    import pipegcn_tpu.parallel.trainer as tr
+    from pipegcn_tpu.ops.pallas_spmm import sharded_applicable
+    from pipegcn_tpu.partition import locality_clusters
+
+    monkeypatch.setattr(tr, "_AUTO_BLOCK_MIN_EDGES", 100)
+
+    def build(homophily, use_cluster):
+        g = synthetic_graph(num_nodes=600, avg_degree=10, n_feat=12,
+                            n_class=4, homophily=homophily, seed=31)
+        parts = partition_graph(g, 4, seed=0)
+        cl = locality_clusters(g, target_size=32, seed=0) \
+            if use_cluster else None
+        sg = ShardedGraph.build(g, parts, n_parts=4, cluster=cl)
+        cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
+                          dropout=0.0, train_size=sg.n_train_global,
+                          spmm_impl="auto", block_tile=32)
+        return Trainer(sg, cfg, TrainConfig(seed=1))
+
+    # force auto past the pallas VMEM gate so the large-shard choice runs
+    monkeypatch.setattr(
+        "pipegcn_tpu.ops.pallas_spmm.sharded_applicable",
+        lambda *a, **k: False)
+    monkeypatch.setattr(
+        tr, "sharded_applicable", lambda *a, **k: False, raising=False)
+
+    t_clustered = build(homophily=0.95, use_cluster=True)
+    t_uniform = build(homophily=0.0, use_cluster=False)
+    assert t_clustered._block_tables is not None
+    assert t_uniform._block_tables is None
+    assert t_uniform._bucket_tables is not None
