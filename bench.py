@@ -181,6 +181,10 @@ def main():
                          "= block time / fused")
     ap.add_argument("--spmm-impl", default="auto",
                     choices=["xla", "pallas", "bucket", "block", "auto"])
+    ap.add_argument("--block-tile", type=int, default=256,
+                    help="dense-tile edge for the block kernel")
+    ap.add_argument("--block-nnz", type=int, default=0,
+                    help="dense threshold override (0 = break-even)")
     ap.add_argument("--sweep-spmm", action="store_true",
                     help="also time every SpMM impl and report the winner")
     ap.add_argument("--probe-tries", type=int, default=3)
@@ -304,6 +308,8 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         train_size=sg.n_train_global, spmm_chunk=spmm_chunk,
         dtype="float32" if args.f32 else "bfloat16",
         spmm_impl=args.spmm_impl,
+        block_tile=args.block_tile,
+        block_nnz=args.block_nnz or None,
     )
     blk = max(1, args.fused)
 

@@ -164,9 +164,9 @@ def run(args) -> dict:
             warnings.warn("Please enable `--fix-seed` for multi-node training.")
         args.seed = random.randint(0, 1 << 31)
 
-    if args.model not in ("graphsage", "gcn"):
+    if args.model not in ("graphsage", "gcn", "gat"):
         raise ValueError(f"unknown model: {args.model}")
-    if args.model == "gcn" and args.use_pp:
+    if args.model in ("gcn", "gat") and args.use_pp:
         raise ValueError("--use-pp is a GraphSAGE-only optimization")
     if args.backend in ("nccl", "mpi"):
         raise NotImplementedError(
@@ -201,6 +201,7 @@ def run(args) -> dict:
     cfg = ModelConfig(
         layer_sizes=layer_sizes,
         model=args.model,
+        n_heads=args.n_heads,
         n_linear=args.n_linear,
         use_pp=args.use_pp,
         norm=None if args.norm == "none" else args.norm,

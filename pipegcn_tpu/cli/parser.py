@@ -113,6 +113,8 @@ def create_parser() -> argparse.ArgumentParser:
                              "scatter-free degree-bucketed kernel, the "
                              "hybrid block-dense MXU kernel, or "
                              "auto-select by shard size")
+    parser.add_argument("--n-heads", "--n_heads", type=int, default=4,
+                        help="attention heads for --model gat")
     parser.add_argument("--block-tile", "--block_tile", type=int,
                         default=256,
                         help="dense-tile edge length for the block-dense "

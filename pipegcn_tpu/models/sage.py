@@ -42,13 +42,20 @@ PsumFn = Callable[[jax.Array], jax.Array]
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     layer_sizes: Tuple[int, ...]   # [in_feat, hidden..., n_class]
-    # 'graphsage' (reference parity, module/layer.py) | 'gcn' (framework
-    # extension: symmetric-normalized convolution, h_i = W Σ_j h_j /
-    # sqrt(d_i d_j) with the self-loop already in the finalized graph).
-    # GCN reuses every aggregation kernel unchanged: the src-side
-    # 1/sqrt(d) scaling happens on the owner BEFORE the halo exchange,
-    # the dst side folds into the mean kernel's output (mean * sqrt(d)).
+    # 'graphsage' (reference parity, module/layer.py) | 'gcn' | 'gat'
+    # (framework extensions). GCN: symmetric-normalized convolution,
+    # h_i = W Σ_j h_j / sqrt(d_i d_j) with the self-loop already in the
+    # finalized graph; reuses every aggregation kernel unchanged — the
+    # src-side 1/sqrt(d) scaling happens on the owner BEFORE the halo
+    # exchange, the dst side folds into the mean kernel's output
+    # (mean * sqrt(d)). GAT: multi-head edge-softmax attention
+    # (n_heads); runs on the raw-edge formulation (attention weights
+    # are per-edge, so the precomputed unweighted kernel tables do not
+    # apply); halo sources attend with their (possibly stale) features,
+    # exactly the staleness semantics of the mean path.
     model: str = "graphsage"
+    n_heads: int = 4               # GAT attention heads
+    leaky_slope: float = 0.2       # GAT LeakyReLU slope
     n_linear: int = 0              # dense tail layers (Yelp uses 2)
     use_pp: bool = False
     norm: Optional[str] = "layer"  # 'layer' | 'batch' | None
@@ -66,12 +73,28 @@ class ModelConfig:
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     def __post_init__(self):
-        if self.model not in ("graphsage", "gcn"):
+        if self.model not in ("graphsage", "gcn", "gat"):
             raise ValueError(f"unknown model: {self.model}")
-        if self.model == "gcn" and self.use_pp:
+        if self.model in ("gcn", "gat") and self.use_pp:
             # the pp precompute caches SAGE's mean-neighbor concat;
-            # GCN's first layer aggregates like every other layer
+            # gcn/gat first layers aggregate like every other layer
             raise ValueError("use_pp is a GraphSAGE-only optimization")
+        if self.model == "gat":
+            if self.n_heads < 1:
+                raise ValueError(f"n_heads must be >= 1, got "
+                                 f"{self.n_heads}")
+            if self.spmm_impl not in ("xla", "auto"):
+                # attention weights are per-edge: the precomputed
+                # unweighted kernel tables cannot express them
+                raise ValueError(
+                    f"spmm_impl={self.spmm_impl!r} does not apply to "
+                    f"gat (per-edge attention weights); use 'xla'/'auto'")
+            for i in range(self.n_layers - self.n_linear):
+                if i < self.n_layers - 1 \
+                        and self.layer_sizes[i + 1] % self.n_heads:
+                    raise ValueError(
+                        f"gat hidden width {self.layer_sizes[i + 1]} not "
+                        f"divisible by n_heads={self.n_heads}")
 
     @property
     def n_layers(self) -> int:
@@ -127,6 +150,18 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
                 layers.append({
                     "w": _uniform(k1, (d_in, d_out), bound),
                     "b": _uniform(k2, (d_out,), bound),
+                })
+            elif cfg.model == "gat":
+                # hidden layers concat H heads of d_out/H; a final graph
+                # layer (producing logits) averages H heads of d_out
+                h_ = cfg.n_heads
+                dh = d_out if i == cfg.n_layers - 1 else d_out // h_
+                bound = 1.0 / d_in ** 0.5
+                layers.append({
+                    "w": _uniform(k1, (d_in, h_ * dh), bound),
+                    "b": _uniform(k2, (d_out,), bound),
+                    "a_src": _uniform(k3, (h_, dh), 1.0 / dh ** 0.5),
+                    "a_dst": _uniform(k4, (h_, dh), 1.0 / dh ** 0.5),
                 })
             else:
                 bound = 1.0 / d_in ** 0.5
@@ -217,6 +252,93 @@ def _sync_batch_norm_eval(h, scale, bias, state, eps=1e-5):
     return (x_hat * scale + bias).astype(h.dtype)
 
 
+def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
+               is_last, out_dtype, chunk=None):
+    """Multi-head edge-softmax attention aggregation over the raw edge
+    list (halo sources included; pad edges carry dst == n_dst and fall
+    into a discarded sentinel segment).
+
+    fbuf: [R, d_in] source rows. Returns [n_dst, d_out] — heads
+    concatenated on hidden layers, averaged on a final (logits) layer.
+    Attention statistics and all segment accumulations run in f32
+    regardless of the compute dtype; a final (logits) layer accumulates
+    its matmul in f32 like dense() does. `chunk` (cfg.spmm_chunk)
+    bounds the per-pass edge intermediates the way spmm_mean's chunking
+    does — without it the [E, H, dh] message tensor is materialized
+    whole."""
+    h_ = n_heads
+    z = jnp.matmul(fbuf, lp["w"].astype(fbuf.dtype),
+                   preferred_element_type=jnp.float32 if is_last
+                   else fbuf.dtype)
+    dh = z.shape[-1] // h_
+    z = z.reshape(-1, h_, dh)
+    zf = z.astype(jnp.float32)
+    el = (zf * lp["a_src"]).sum(-1)                    # [R, H]
+    er = (zf[:n_dst] * lp["a_dst"]).sum(-1)            # [n_dst, H]
+    er = jnp.concatenate([er, jnp.zeros((1, h_), jnp.float32)])
+    n_seg = n_dst + 1
+    e_cnt = edge_src.shape[0]
+
+    def seg_passes(es, ed):
+        """(max, sum, weighted-out) segment passes for one edge slab."""
+        e = jax.nn.leaky_relu(el[es] + er[ed], slope)   # [E, H]
+        m = jax.ops.segment_max(e, ed, n_seg)
+        return e, m
+
+    if chunk and e_cnt > chunk:
+        n_chunks = -(-e_cnt // chunk)
+        pad = n_chunks * chunk - e_cnt
+        # pad edges: dst -> sentinel segment, src -> row 0 (finite)
+        es_p = jnp.pad(edge_src, (0, pad)).reshape(n_chunks, chunk)
+        ed_p = jnp.pad(edge_dst, (0, pad),
+                       constant_values=n_dst).reshape(n_chunks, chunk)
+
+        # carry inits must share the body outputs' device-varying type
+        # under shard_map: a literal constant is 'unvarying' and scan
+        # rejects the mismatch, so seed them with a varying zero
+        vzero = el[:1].sum() * 0.0
+
+        def max_body(m_acc, idx):
+            e, m = seg_passes(*idx)
+            return jnp.maximum(m_acc, m), None
+
+        m, _ = jax.lax.scan(
+            max_body,
+            jnp.full((n_seg, h_), -jnp.inf, jnp.float32) + vzero,
+            (es_p, ed_p))
+        m = jnp.where(jnp.isfinite(m), m, 0.0)
+
+        def sum_body(s_acc, idx):
+            es, ed = idx
+            e = jax.nn.leaky_relu(el[es] + er[ed], slope)
+            ex = jnp.exp(e - m[ed])
+            return s_acc + jax.ops.segment_sum(ex, ed, n_seg), None
+
+        s, _ = jax.lax.scan(sum_body, jnp.zeros((n_seg, h_), jnp.float32) + vzero,
+                            (es_p, ed_p))
+
+        def out_body(o_acc, idx):
+            es, ed = idx
+            e = jax.nn.leaky_relu(el[es] + er[ed], slope)
+            alpha = jnp.exp(e - m[ed]) / jnp.maximum(s[ed], 1e-16)
+            msg = z[es].astype(jnp.float32) * alpha[..., None]
+            return o_acc + jax.ops.segment_sum(msg, ed, n_seg), None
+
+        out, _ = jax.lax.scan(out_body, jnp.zeros((n_seg, h_, dh), jnp.float32) + vzero,
+                              (es_p, ed_p))
+        out = out[:n_dst]
+    else:
+        e, m = seg_passes(edge_src, edge_dst)
+        m = jnp.where(jnp.isfinite(m), m, 0.0)  # empty segments
+        ex = jnp.exp(e - m[edge_dst])
+        s = jax.ops.segment_sum(ex, edge_dst, n_seg)
+        alpha = ex / jnp.maximum(s[edge_dst], 1e-16)
+        msg = z[edge_src].astype(jnp.float32) * alpha[..., None]
+        out = jax.ops.segment_sum(msg, edge_dst, n_seg)[:n_dst]
+    out = out.mean(axis=1) if is_last else out.reshape(n_dst, h_ * dh)
+    return out.astype(out_dtype) + lp["b"].astype(out_dtype)
+
+
 def _dropout(rng, h, rate):
     if rate <= 0.0:
         return h
@@ -292,6 +414,7 @@ def forward(
             rng, sub = jax.random.split(rng)
         if is_graph:
             is_gcn = cfg.model == "gcn"
+            is_gat = cfg.model == "gat"
             if is_gcn:
                 # src-side symmetric normalization h_j / sqrt(d_j),
                 # applied while every row is still on its owner (so the
@@ -310,6 +433,11 @@ def forward(
                 lp = params["layers"][i]
                 if cfg.use_pp and i == 0:
                     h = dense(h, lp["w"], lp["b"], out_dt)
+                elif is_gat:
+                    h = _gat_layer(h, lp, edge_src, edge_dst, n_dst,
+                                   cfg.n_heads, cfg.leaky_slope,
+                                   i == cfg.n_layers - 1, out_dt,
+                                   chunk=cfg.spmm_chunk)
                 else:
                     # spmm_fn (e.g. the Pallas VMEM-resident kernel)
                     # returns the mean directly when injected
@@ -328,6 +456,12 @@ def forward(
                         h = (dense(h[:n_dst], lp["w1"], lp["b1"], out_dt)
                              + dense(ah.astype(cdt), lp["w2"], lp["b2"],
                                      out_dt))
+            elif is_gat:
+                lp = params["layers"][i]
+                h = _gat_layer(h, lp, edge_src, edge_dst, n_dst,
+                               cfg.n_heads, cfg.leaky_slope,
+                               i == cfg.n_layers - 1, out_dt,
+                               chunk=cfg.spmm_chunk)
             else:
                 lp = params["layers"][i]
                 ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,

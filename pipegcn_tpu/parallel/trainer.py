@@ -240,6 +240,11 @@ class Trainer:
         self._block_tile = 0
         if impl not in ("xla", "pallas", "auto", "bucket", "block"):
             raise ValueError(f"unknown spmm_impl: {impl}")
+        if self.cfg.model == "gat":
+            # attention weights are per-edge: the unweighted kernel
+            # tables (pallas/bucket/block) do not apply — GAT always
+            # aggregates over the raw edge list
+            return
         if impl == "xla":
             return
 

@@ -102,4 +102,18 @@ def test_cli_rejects_bad_backend(tmp_path):
 
 def test_cli_rejects_bad_model(tmp_path):
     with pytest.raises(ValueError):
-        run(_args(tmp_path, ["--model", "gat"]))
+        run(_args(tmp_path, ["--model", "gin"]))
+
+
+def test_cli_gcn_end_to_end(tmp_path):
+    res = run(_args(tmp_path, ["--model", "gcn", "--enable-pipeline"]))
+    assert res["best_val"] > 0.7
+    # gcn + use_pp is rejected (SAGE-only precompute)
+    with pytest.raises(ValueError, match="GraphSAGE-only"):
+        run(_args(tmp_path, ["--model", "gcn", "--use-pp"]))
+
+
+def test_cli_gat_end_to_end(tmp_path):
+    res = run(_args(tmp_path, ["--model", "gat", "--n-heads", "4",
+                               "--enable-pipeline"]))
+    assert res["best_val"] > 0.7
