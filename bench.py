@@ -440,6 +440,17 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
     # below must never discard it, so a crash there falls through to the
     # JSON print instead of the stage-degrading re-exec.
     try:
+        if trainer._block_tables is not None:
+            from pipegcn_tpu.ops.block_spmm import estimate_block_coverage
+
+            w_hint = max(cfg.layer_sizes[:cfg.n_graph_layers])
+            extras["dense_coverage"] = round(estimate_block_coverage(
+                sg, args.block_tile, w_hint, nnz_threshold=args.block_nnz
+            ), 3)
+            extras["dense_blocks"] = int(
+                next(v for k, v in trainer._block_tables.items()
+                     if k in ("blk_a", "blk_a_bits")).shape[1])
+
         # ---- overlap evidence: pipelined vs vanilla -------------------
         if not args.no_compare:
             del trainer  # free HBM before compiling the second program
@@ -463,6 +474,26 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         if args.sweep_spmm:
             sweep = {}
             for impl in ("xla", "bucket", "block", "pallas"):
+                if impl == "pallas":
+                    # forcing the VMEM-resident kernel on a shard that
+                    # cannot fit compiles a heavily-spilled program —
+                    # observed to crash the tunneled TPU worker; skip
+                    # out-of-domain rather than risk the run. Cheap
+                    # shape-only gate first; the O(E) table build only
+                    # runs when shapes alone cannot reject the shard.
+                    from pipegcn_tpu.ops.pallas_spmm import (
+                        build_sharded_tables, sharded_applicable)
+
+                    nsr = sg.n_max + sg.halo_size
+                    fits = sharded_applicable(nsr, hidden, 0)
+                    if fits:
+                        _, me, nsr = build_sharded_tables(sg)
+                        fits = sharded_applicable(nsr, hidden, me)
+                    if not fits:
+                        sweep[impl] = None
+                        print("# spmm sweep: pallas skipped (shard "
+                              "exceeds the VMEM domain)", file=sys.stderr)
+                        continue
                 try:
                     t0 = time.perf_counter()
                     tr = Trainer(sg,

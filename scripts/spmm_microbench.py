@@ -1,0 +1,102 @@
+"""Decompose the block-SpMM epoch cost on the real chip.
+
+Loads the cached Reddit-scale bench artifact + block tables, then times
+the device aggregation closure in three configurations — full hybrid,
+dense-tiles-only, remainder-only — forward and forward+backward, at the
+training feature width. This attributes the measured epoch time between
+the MXU dense path, the slabbed gather remainder, and everything else
+(the bench's per-epoch number minus 6x the SpMM cost).
+
+Timing forces a device->host scalar read per call: through the axon
+tunnel, block_until_ready alone does not synchronize (docs/PERF_NOTES).
+
+Usage: python scripts/spmm_microbench.py [--part partitions/...]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--part", default="partitions/bench-reddit-1-c2")
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--block-nnz", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import Trainer, TrainConfig
+    from pipegcn_tpu.partition import ShardedGraph
+
+    sg = ShardedGraph.load(args.part)
+    cfg = ModelConfig(
+        layer_sizes=(sg.n_feat, 256, 256, 256, sg.n_class),
+        use_pp=True, norm="layer", dropout=0.5,
+        train_size=sg.n_train_global, spmm_chunk=2_097_152,
+        dtype="bfloat16", spmm_impl="block",
+        block_nnz=args.block_nnz or None,
+    )
+    tr = Trainer(sg, cfg, TrainConfig(lr=0.01, n_epochs=1, eval=False))
+    d = {k: v[0] for k, v in tr.data.items()}
+    n_max = sg.n_max
+    n_src = n_max + sg.halo_size
+
+    rng = np.random.default_rng(0)
+    fbuf = jnp.asarray(
+        rng.standard_normal((n_src, args.width)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+
+    from pipegcn_tpu.ops.block_spmm import make_device_block_spmm_fn
+
+    def variant(name, keep):
+        dd = {k: v for k, v in d.items() if keep(k)}
+        fn = jax.jit(make_device_block_spmm_fn(
+            dd, d["in_deg"], n_max, n_src, tr._block_tile,
+            chunk_edges=cfg.spmm_chunk))
+        grad = jax.jit(jax.grad(lambda f: fn(f).astype(jnp.float32).sum()))
+
+        def timed(g, label):
+            g(fbuf)  # compile
+            float(jnp.sum(g(fbuf)[0]))
+            ts = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                float(jnp.sum(g(fbuf)[0]))
+                ts.append(time.perf_counter() - t0)
+            print(f"{name:12s} {label:8s} {min(ts)*1e3:8.1f} ms",
+                  file=sys.stderr)
+            return min(ts)
+
+        f = timed(fn, "fwd")
+        fb = timed(grad, "fwd+bwd")
+        return f, fb
+
+    is_dense = lambda k: k.startswith("blk_")
+    is_rem = lambda k: k.startswith("blkrem_")
+    aux = lambda k: not (is_dense(k) or is_rem(k))
+    inv_only = lambda k: k.endswith("inv") or k.endswith("ginv")
+
+    full = variant("full", lambda k: True)
+    dense = variant("dense-only",
+                    lambda k: aux(k) or is_dense(k)
+                    or (is_rem(k) and inv_only(k)))
+    rem = variant("rem-only",
+                  lambda k: aux(k) or is_rem(k)
+                  or (is_dense(k) and (inv_only(k) or k in
+                                       ("blk_a", "blk_a_bits"))))
+    print(f"# per-SpMM (fwd+bwd avg ~ epoch has 3 fwd + 3 bwd):")
+    print(f"full fwd {full[0]*1e3:.1f} ms, fwd+bwd {full[1]*1e3:.1f} ms; "
+          f"dense fwd {dense[0]*1e3:.1f}, rem fwd {rem[0]*1e3:.1f}")
+    est_epoch = 3 * full[1]
+    print(f"# est SpMM-only epoch: {est_epoch:.3f}s")
+
+
+if __name__ == "__main__":
+    main()
