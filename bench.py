@@ -474,27 +474,24 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         if args.sweep_spmm:
             sweep = {}
             for impl in ("xla", "bucket", "block", "pallas"):
-                if impl == "pallas":
-                    # forcing the VMEM-resident kernel on a shard that
-                    # cannot fit compiles a heavily-spilled program —
-                    # observed to crash the tunneled TPU worker; skip
-                    # out-of-domain rather than risk the run. Cheap
-                    # shape-only gate first; the O(E) table build only
-                    # runs when shapes alone cannot reject the shard.
-                    from pipegcn_tpu.ops.pallas_spmm import (
-                        build_sharded_tables, sharded_applicable)
-
-                    nsr = sg.n_max + sg.halo_size
-                    fits = sharded_applicable(nsr, hidden, 0)
-                    if fits:
-                        _, me, nsr = build_sharded_tables(sg)
-                        fits = sharded_applicable(nsr, hidden, me)
-                    if not fits:
-                        sweep[impl] = None
-                        print("# spmm sweep: pallas skipped (shard "
-                              "exceeds the VMEM domain)", file=sys.stderr)
-                        continue
                 try:
+                    if impl == "pallas":
+                        # forcing the VMEM-resident kernel on a shard
+                        # that cannot fit compiles a heavily-spilled
+                        # program — observed to crash the tunneled TPU
+                        # worker; skip out-of-domain rather than risk
+                        # the run (inside this try so a gate failure
+                        # records None instead of discarding the
+                        # already-measured sweep entries)
+                        from pipegcn_tpu.ops.pallas_spmm import \
+                            sharded_fits
+
+                        if not sharded_fits(sg, hidden):
+                            sweep[impl] = None
+                            print("# spmm sweep: pallas skipped (shard "
+                                  "exceeds the VMEM domain)",
+                                  file=sys.stderr)
+                            continue
                     t0 = time.perf_counter()
                     tr = Trainer(sg,
                         dataclasses.replace(cfg, spmm_impl=impl),

@@ -248,3 +248,17 @@ def make_device_spmm_fn(d: dict, n_max: int, n_src_rows: int, max_e: int,
 def sharded_applicable(n_src_rows: int, n_feat_max: int, max_e: int) -> bool:
     return (n_src_rows * n_feat_max * 4 <= VMEM_BUDGET
             and max_e * 4 <= (2 << 20))
+
+
+def sharded_fits(sg, width: int) -> bool:
+    """Full applicability check for a sharded graph at feature width
+    `width`: the cheap shape-only gate first, then — only when shapes
+    alone cannot reject — the O(E) table build to check max_e. Large
+    shards (where the build would be an expensive multi-GB transient)
+    always fail the shape gate, so the build only runs when it is
+    cheap."""
+    n_src_rows = sg.n_max + sg.halo_size
+    if not sharded_applicable(n_src_rows, width, 0):
+        return False
+    _, max_e, n_src_rows = build_sharded_tables(sg)
+    return sharded_applicable(n_src_rows, width, max_e)

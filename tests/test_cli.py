@@ -117,3 +117,16 @@ def test_cli_gat_end_to_end(tmp_path):
     res = run(_args(tmp_path, ["--model", "gat", "--n-heads", "4",
                                "--enable-pipeline"]))
     assert res["best_val"] > 0.7
+
+
+def test_cli_checkpoint_resume_gat(tmp_path):
+    """Checkpoint/resume is model-family agnostic (pytree npz): a GAT
+    run resumes from its own attention-param state."""
+    ckpt = str(tmp_path / "ckpt_gat")
+    run(_args(tmp_path, ["--model", "gat", "--checkpoint-dir", ckpt,
+                         "--checkpoint-every", "10"]))
+    assert os.path.exists(os.path.join(ckpt, "state.npz"))
+    res = run(_args(tmp_path, ["--model", "gat", "--checkpoint-dir",
+                               ckpt, "--resume", "--skip-partition",
+                               "--n-epochs", "40"]))
+    assert res["best_val"] > 0.6

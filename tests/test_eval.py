@@ -247,3 +247,27 @@ def test_async_eval_does_not_block_loop():
     # three boundaries -> three history entries, all with accuracies
     accs = [h for h in res["history"] if h[2] is not None]
     assert len(accs) == 3
+
+
+def test_sharded_eval_gcn_and_gat_match_full():
+    """The sharded evaluator must agree with single-device full-graph
+    eval for the extension model families too (gcn rides the kernel
+    tables; gat rides the raw-edge path)."""
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
+                        seed=33)
+    parts = partition_graph(g, 4, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4)
+    for model, extra in (("gcn", {"spmm_impl": "bucket"}),
+                         ("gat", {"n_heads": 4})):
+        cfg = ModelConfig(
+            layer_sizes=(sg.n_feat, 16, 16, sg.n_class), model=model,
+            norm="layer", dropout=0.0, train_size=sg.n_train_global,
+            **extra,
+        )
+        t = Trainer(sg, cfg, TrainConfig(seed=5, enable_pipeline=True))
+        for e in range(3):
+            t.train_epoch(e)
+        for mask in ("val_mask", "test_mask"):
+            full = t.evaluate(g, mask)
+            sharded = t.evaluate(g, mask, sharded=True)
+            assert full == pytest.approx(sharded, abs=1e-6), (model, mask)
