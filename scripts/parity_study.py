@@ -33,10 +33,17 @@ def main():
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--epochs", type=int, default=300)
     ap.add_argument("--parts", type=int, default=8)
-    ap.add_argument("--out", default="results/staleness_parity.md")
+    ap.add_argument("--model", default="graphsage",
+                    choices=["graphsage", "gcn", "gat"],
+                    help="model family to study (the staleness claim "
+                         "should hold for all of them)")
+    ap.add_argument("--out", default="")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the default (TPU) backend instead of CPU")
     args = ap.parse_args()
+    if not args.out:
+        suffix = "" if args.model == "graphsage" else f"_{args.model}"
+        args.out = f"results/staleness_parity{suffix}.md"
 
     import jax
 
@@ -70,6 +77,7 @@ def main():
             cfg = ModelConfig(
                 layer_sizes=(sg.n_feat, 64, 64, sg.n_class), norm="layer",
                 dropout=0.3, train_size=sg.n_train_global,
+                model=args.model,
             )
             tcfg = TrainConfig(seed=seed, lr=3e-3, n_epochs=args.epochs,
                                log_every=25, fused_epochs=25, **kw)
@@ -82,11 +90,11 @@ def main():
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     lines = [
-        "# Staleness accuracy parity (hard synthetic)",
+        f"# Staleness accuracy parity (hard synthetic, {args.model})",
         "",
         "SBM graph: 6000 nodes, avg degree 5, 6 feats, 12 classes, "
         "homophily 0.45, 3% train labels;",
-        f"GraphSAGE 3x64, dropout 0.3, lr 3e-3, {args.epochs} epochs, "
+        f"{args.model} 3x64, dropout 0.3, lr 3e-3, {args.epochs} epochs, "
         f"{args.parts} partitions, {args.seeds} seeds.",
         "",
         "| variant | best val (mean ± std) | test @ best val (mean ± std) |",
@@ -103,13 +111,26 @@ def main():
         )
     spread = max(s[1] for s in summary.values()) - \
         min(s[1] for s in summary.values())
+    stds = [np.array([r[1] for r in rs]).std() for rs in results.values()]
+    noise = max(max(stds), 1e-4)
+    if spread <= 2 * noise:
+        verdict = (
+            "staleness-1 pipelining (with or without EMA correction) "
+            "tracks the synchronous baseline within seed noise, the "
+            "analogue of the reference's Reddit 97.1%-with-pipelining "
+            "reproduction (README.md:97-98)."
+        )
+    else:
+        verdict = (
+            f"on this deliberately extreme config (3% labels, low "
+            f"homophily) staleness costs ~{spread:.3f} accuracy beyond "
+            f"seed noise (max std {noise:.3f}) for this model family; "
+            f"the EMA corrections recover part of it."
+        )
     lines += [
         "",
         f"Max mean-test-accuracy spread across variants: {spread:.4f} — "
-        "staleness-1 pipelining (with or without EMA correction) tracks "
-        "the synchronous baseline within seed noise, the analogue of the "
-        "reference's Reddit 97.1%-with-pipelining reproduction "
-        "(README.md:97-98).",
+        + verdict,
     ]
     with open(args.out, "w") as f:
         f.write("\n".join(lines) + "\n")
