@@ -1,0 +1,172 @@
+"""Project multi-chip epoch time for the Reddit-scale benchmark.
+
+Real hardware here is ONE v5e chip, so multi-chip numbers cannot be
+measured; this tool produces the next-best thing — a real P-way METIS
+partition of the benchmark graph and, from it, the measured quantities
+that determine multi-chip performance:
+
+  - per-device inner nodes / edges (compute balance),
+  - halo sizes and per-epoch ICI traffic (Trainer.est_ici_bytes_per_epoch,
+    the exact gather/ppermute volumes of the pipelined step),
+  - dense-tile coverage per device (the block kernel's regime survives
+    partitioning or it doesn't),
+  - a projected epoch time from the v5e-calibrated cost model
+    (docs/PERF_NOTES.md): slab-gather remainder at 390M rows/s, dense
+    F-tile+A reads at 819 GB/s, MXU at 50% peak — scaled by the
+    MAX-loaded device, plus the ICI time at v5e's 2x 400 GB/s links
+    (pipelined: overlapped, so counted only as a floor check).
+
+Writes results/multichip_projection.md.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/multichip_projection.py [--parts 8]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--parts", type=int, default=8)
+    ap.add_argument("--dataset", default="synthetic-reddit")
+    ap.add_argument("--out", default="results/multichip_projection.md")
+    ap.add_argument("--part-dir", default="partitions/projection")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        f"--xla_force_host_platform_device_count={args.parts}")
+
+    from pipegcn_tpu.graph import load_data
+    from pipegcn_tpu.ops.block_spmm import estimate_block_coverage
+    from pipegcn_tpu.partition import (ShardedGraph, locality_clusters,
+                                       partition_graph)
+
+    path = f"{args.part_dir}-{args.parts}"
+    t0 = time.time()
+    if ShardedGraph.exists(path):
+        sg = ShardedGraph.load(path)
+        print(f"# loaded cached projection partitions "
+              f"({time.time()-t0:.0f}s)", file=sys.stderr)
+    else:
+        g = load_data(args.dataset)
+        parts = partition_graph(g, args.parts, method="metis", obj="vol",
+                                seed=0)
+        cluster = locality_clusters(g, seed=0)
+        sg = ShardedGraph.build(g, parts, n_parts=args.parts,
+                                cluster=cluster)
+        sg.save(path)
+        print(f"# built projection partitions ({time.time()-t0:.0f}s)",
+              file=sys.stderr)
+
+    P = sg.num_parts
+    inner = sg.inner_count.astype(np.int64)
+    edges = sg.edge_count.astype(np.int64)
+    halos = []
+    for r in range(P):
+        e = int(sg.edge_count[r])
+        src = sg.edge_src[r][:e]
+        halos.append(int((src >= sg.n_max).sum()))
+    send = sg.send_counts.sum(axis=1).astype(np.int64)
+
+    # ICI volume of the pipelined step: per layer, each device sends its
+    # boundary rows (send lists) and receives its halo rows, in the
+    # compute dtype, forward + backward; 3 graph layers exchange (use_pp
+    # skips layer 0). Width 256, bf16.
+    width, isz, n_exch = 256, 2, 3
+    tx_bytes = send * width * isz * n_exch * 2  # fwd feats + bwd grads
+
+    # v5e-calibrated per-device epoch cost (docs/PERF_NOTES.md)
+    GATHER_RPS, HBM_BPS, MXU = 390e6, 819e9, 0.5 * 197e12
+    tile, thr = 256, None
+    cov = np.array([
+        estimate_block_coverage(
+            type("S", (), {  # single-device view of shard r
+                "num_parts": 1, "n_max": sg.n_max,
+                "halo_size": sg.halo_size,
+                "edge_count": sg.edge_count[r:r + 1],
+                "edge_src": sg.edge_src[r:r + 1],
+                "edge_dst": sg.edge_dst[r:r + 1],
+            })(), tile, 602)
+        for r in range(P)
+    ])
+    uniq_blocks = []
+    n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
+    for r in range(P):
+        e = int(sg.edge_count[r])
+        src = sg.edge_src[r][:e].astype(np.int64)
+        dst = sg.edge_dst[r][:e].astype(np.int64)
+        real = dst < sg.n_max
+        bid = (dst[real] // tile) * n_src_tiles + (src[real] // tile)
+        u, c = np.unique(bid, return_counts=True)
+        t_ = max(1, (tile * tile) // 602)
+        uniq_blocks.append(int((c >= t_).sum()))
+    dense_blocks = np.array(uniq_blocks)
+
+    rem_edges = edges * (1 - cov)
+    t_rem = rem_edges * 2 * 6 / GATHER_RPS         # 2 slabs, 6 SpMMs
+    t_dense = dense_blocks * 6 * (
+        (tile * width * isz + tile * tile / 8) / HBM_BPS
+        + 2 * tile * tile * width / MXU)
+    t_ici = tx_bytes / 400e9                        # per-direction link
+    t_dev = t_rem + t_dense
+    # calibration: the same cost model predicts 1.12 s for the P=1
+    # configuration that MEASURES 1.59 s on the chip (docs/PERF_NOTES),
+    # so projections are scaled by that measured/model ratio
+    CALIB = 1.59 / 1.12
+    t_dev = t_dev * CALIB
+    proj = float(t_dev.max())
+
+    lines = [
+        f"# Multi-chip projection ({P}-way METIS, {args.dataset})",
+        "",
+        "One v5e chip is available; this projects the multi-chip epoch "
+        "from a REAL partition of the benchmark graph plus the "
+        "v5e-calibrated cost model (docs/PERF_NOTES.md), scaled by the "
+        "model's measured single-chip miss (x1.42: it predicts 1.12 s "
+        "where the chip measures 1.59 s). The sharded program itself is "
+        "validated on the virtual CPU mesh (dryrun_multichip, tests/).",
+        "",
+        "| device | inner nodes | edges | halo rows | send rows/layer | "
+        "dense cov | est ICI MB/epoch | est epoch s |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in range(P):
+        lines.append(
+            f"| {r} | {inner[r]:,} | {edges[r]:,} | {halos[r]:,} "
+            f"| {send[r]:,} | {cov[r]:.2f} | {tx_bytes[r]/2**20:.0f} "
+            f"| {t_dev[r]:.3f} |")
+    lines += [
+        "",
+        f"Projected epoch (max device, comm overlapped): **{proj:.3f} s**"
+        + (f" vs 1.59 s measured single-chip — {1.59/proj:.1f}x scaling "
+           f"at P={P}." if args.dataset == "synthetic-reddit" else "."),
+        f"Worst-case exposed-ICI floor if NOTHING overlapped: "
+        f"{float(t_ici.max()):.4f} s "
+        f"({100*float(t_ici.max())/proj:.1f}% of the projected epoch) — "
+        "the pipelined design exists to hide exactly this term "
+        "(results/overlap_study.md shows all pipelined exchanges leave "
+        "the critical path).",
+        "",
+        f"Reference baseline: 0.266 s/epoch on 2 GPUs; the projection "
+        f"crosses it at P={P} if {proj:.3f} <= 0.266 "
+        f"({'yes' if proj <= 0.266 else 'no'}).",
+    ]
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()
