@@ -52,8 +52,14 @@ def _block_tables(row_ptr: np.ndarray, n_out: int) -> Tuple[np.ndarray, np.ndarr
     ends = np.full(n_pad, row_ptr[-1], dtype=np.int32)
     starts[:n_out] = row_ptr[:-1]
     ends[:n_out] = row_ptr[1:]
-    blk_start = starts.reshape(n_blocks, ROW_BLOCK)[:, 0]
-    blk_end = ends.reshape(n_blocks, ROW_BLOCK)[:, -1]
+    # 2-D [n_blocks, ROW_BLOCK] layout: rank-1 SMEM blocks of width
+    # ROW_BLOCK fail Mosaic's lowering constraint (block width must be
+    # the whole array or a multiple of the 128-wide tiling); a
+    # (1, ROW_BLOCK) block over a 2-D table lowers fine
+    starts = starts.reshape(n_blocks, ROW_BLOCK)
+    ends = ends.reshape(n_blocks, ROW_BLOCK)
+    blk_start = starts[:, 0]
+    blk_end = ends[:, -1]
     max_e = int((blk_end - blk_start).max()) if n_blocks else 0
     max_e = max(-(-max_e // 128) * 128, 128)
     return starts, ends, max_e
@@ -61,15 +67,15 @@ def _block_tables(row_ptr: np.ndarray, n_out: int) -> Tuple[np.ndarray, np.ndarr
 
 def _kernel(starts_ref, ends_ref, deg_ref, esrc_hbm, fbuf_ref, out_ref,
             eidx, sem, *, max_e, n_feat):
-    s0 = starts_ref[0]
+    s0 = starts_ref[0, 0]
     # one DMA brings every edge-source index this block can touch
     cp = pltpu.make_async_copy(esrc_hbm.at[pl.ds(s0, max_e)], eidx, sem)
     cp.start()
     cp.wait()
 
     def row_body(r):
-        lo = starts_ref[r] - s0
-        hi = ends_ref[r] - s0
+        lo = starts_ref[0, r] - s0
+        hi = ends_ref[0, r] - s0
 
         def edge_body(k, acc):
             src = eidx[k]
@@ -78,7 +84,7 @@ def _kernel(starts_ref, ends_ref, deg_ref, esrc_hbm, fbuf_ref, out_ref,
         acc = jax.lax.fori_loop(
             lo, hi, edge_body, jnp.zeros((n_feat,), jnp.float32)
         )
-        out_ref[r, :] = acc / deg_ref[r]
+        out_ref[r, :] = acc / deg_ref[0, r]
 
     for r in range(ROW_BLOCK):  # static unroll over the 8 block rows
         row_body(r)
@@ -89,7 +95,7 @@ def _kernel(starts_ref, ends_ref, deg_ref, esrc_hbm, fbuf_ref, out_ref,
 )
 def _spmm_pallas_call(fbuf, edge_src_padded, starts, ends, in_deg_padded,
                       n_out, max_e, interpret=False, vma=None):
-    n_blocks = starts.shape[0] // ROW_BLOCK
+    n_blocks = starts.shape[0]
     n_feat = fbuf.shape[-1]
     kernel = functools.partial(_kernel, max_e=max_e, n_feat=n_feat)
     out_shape = (n_blocks * ROW_BLOCK, n_feat)
@@ -103,11 +109,12 @@ def _spmm_pallas_call(fbuf, edge_src_padded, starts, ends, in_deg_padded,
         kernel,
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((ROW_BLOCK,), lambda b: (b,),
+            pl.BlockSpec((1, ROW_BLOCK), lambda b: (b, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((ROW_BLOCK,), lambda b: (b,),
+            pl.BlockSpec((1, ROW_BLOCK), lambda b: (b, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((ROW_BLOCK,), lambda b: (b,)),
+            pl.BlockSpec((1, ROW_BLOCK), lambda b: (b, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),      # edge_src in HBM
             pl.BlockSpec(memory_space=pltpu.VMEM),  # fbuf resident
         ],
@@ -139,7 +146,7 @@ class PallasSpmm:
         self.n_out = n_out
         self.max_e = max_e
         self.interpret = interpret
-        n_pad = starts.shape[0]
+        n_pad = starts.size
         # pad the edge array so the fixed-size DMA never over-reads
         esrc = np.asarray(edge_src, dtype=np.int32)
         self._esrc = jnp.asarray(
@@ -149,7 +156,7 @@ class PallasSpmm:
         self._ends = jnp.asarray(ends)
         deg = np.ones(n_pad, np.float32)
         deg[:n_out] = np.asarray(in_deg, np.float32)[:n_out]
-        self._deg = jnp.asarray(deg)
+        self._deg = jnp.asarray(deg.reshape(starts.shape))
         self.applicable = sharded_applicable(n_src_rows, n_feat, max_e)
 
     def __call__(self, fbuf: jax.Array) -> jax.Array:
@@ -193,13 +200,14 @@ def build_sharded_tables(sg) -> Tuple[dict, int, int]:
         order = stable_argsort(scat)
         t_gather[r] = gath[order].astype(np.int32)
         t_scatter[r] = scat[order].astype(np.int32)
-    n_pad = all_starts[0].shape[0]
+    blk_shape = all_starts[0].shape
     esrc = np.concatenate(
         [sg.edge_src.astype(np.int32),
          np.zeros((P, max_e), np.int32)], axis=1,
     )
-    deg = np.ones((P, n_pad), np.float32)
+    deg = np.ones((P, blk_shape[0] * blk_shape[1]), np.float32)
     deg[:, : sg.n_max] = sg.in_deg
+    deg = deg.reshape((P,) + blk_shape)
     tables = {
         "spmm_starts": np.stack(all_starts),
         "spmm_ends": np.stack(all_ends),
@@ -219,7 +227,7 @@ def make_device_spmm_fn(d: dict, n_max: int, n_src_rows: int, max_e: int,
     transpose aggregation via the XLA sorted-segment path."""
     from .spmm import spmm_sum
 
-    deg_col = d["spmm_deg"][:n_max][:, None]
+    deg_col = d["spmm_deg"].reshape(-1)[:n_max][:, None]
     vma = frozenset((axis_name,))
 
     @jax.custom_vjp
