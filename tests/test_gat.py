@@ -114,3 +114,19 @@ def test_gat_rejects_table_impls_and_bad_heads():
         ModelConfig(layer_sizes=(4, 8, 2), model="gat", spmm_impl="block")
     with pytest.raises(ValueError, match="n_heads"):
         ModelConfig(layer_sizes=(4, 8, 2), model="gat", n_heads=0)
+
+
+def test_gat_multilabel_bce():
+    g = synthetic_graph(num_nodes=300, avg_degree=7, n_feat=10, n_class=5,
+                        multilabel=True, seed=19)
+    parts = partition_graph(g, 4, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4)
+    cfg = ModelConfig(layer_sizes=(sg.n_feat, 16, sg.n_class),
+                      model="gat", n_heads=4, norm="layer", dropout=0.1,
+                      train_size=sg.n_train_global)
+    t = Trainer(sg, cfg, TrainConfig(seed=2, enable_pipeline=True))
+    losses = [t.train_epoch(e) for e in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    f1 = t.evaluate(g, "val_mask")
+    assert 0.0 <= f1 <= 1.0

@@ -92,3 +92,17 @@ def test_gcn_fit_converges(graph):
 def test_gcn_rejects_use_pp():
     with pytest.raises(ValueError, match="GraphSAGE-only"):
         ModelConfig(layer_sizes=(4, 2), model="gcn", use_pp=True)
+
+
+def test_gcn_bf16_tracks_f32(graph):
+    losses = {}
+    for dt in ("float32", "bfloat16"):
+        parts = partition_graph(graph, 4, seed=0)
+        sg = ShardedGraph.build(graph, parts, n_parts=4)
+        cfg = ModelConfig(layer_sizes=(sg.n_feat, 16, sg.n_class),
+                          model="gcn", norm="layer", dropout=0.0,
+                          train_size=sg.n_train_global, dtype=dt)
+        t = Trainer(sg, cfg, TrainConfig(seed=3, enable_pipeline=True))
+        losses[dt] = [t.train_epoch(e) for e in range(8)]
+    np.testing.assert_allclose(losses["float32"], losses["bfloat16"],
+                               rtol=0.05, atol=0.05)
