@@ -279,62 +279,55 @@ def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
     n_seg = n_dst + 1
     e_cnt = edge_src.shape[0]
 
-    def seg_passes(es, ed):
-        """(max, sum, weighted-out) segment passes for one edge slab."""
-        e = jax.nn.leaky_relu(el[es] + er[ed], slope)   # [E, H]
-        m = jax.ops.segment_max(e, ed, n_seg)
-        return e, m
+    # One code path: the unchunked case is a single chunk. Each pass
+    # recomputes the cheap [E, H] logits; the expensive part (the
+    # z[src] message gather) happens once, in the final pass.
+    if not chunk or chunk >= e_cnt:
+        chunk = max(e_cnt, 1)
+    n_chunks = -(-e_cnt // chunk)
+    pad = n_chunks * chunk - e_cnt
+    # pad edges: dst -> sentinel segment, src -> row 0 (finite)
+    es_p = jnp.pad(edge_src, (0, pad)).reshape(n_chunks, chunk)
+    ed_p = jnp.pad(edge_dst, (0, pad),
+                   constant_values=n_dst).reshape(n_chunks, chunk)
 
-    if chunk and e_cnt > chunk:
-        n_chunks = -(-e_cnt // chunk)
-        pad = n_chunks * chunk - e_cnt
-        # pad edges: dst -> sentinel segment, src -> row 0 (finite)
-        es_p = jnp.pad(edge_src, (0, pad)).reshape(n_chunks, chunk)
-        ed_p = jnp.pad(edge_dst, (0, pad),
-                       constant_values=n_dst).reshape(n_chunks, chunk)
+    def logits(es, ed):
+        return jax.nn.leaky_relu(el[es] + er[ed], slope)  # [chunk, H]
 
-        # carry inits must share the body outputs' device-varying type
-        # under shard_map: a literal constant is 'unvarying' and scan
-        # rejects the mismatch, so seed them with a varying zero
-        vzero = el[:1].sum() * 0.0
+    # carry inits must share the body outputs' device-varying type
+    # under shard_map: a literal constant is 'unvarying' and scan
+    # rejects the mismatch, so seed them with a varying zero
+    vzero = el[:1].sum() * 0.0
 
-        def max_body(m_acc, idx):
-            e, m = seg_passes(*idx)
-            return jnp.maximum(m_acc, m), None
+    def max_body(m_acc, idx):
+        m = jax.ops.segment_max(logits(*idx), idx[1], n_seg)
+        return jnp.maximum(m_acc, m), None
 
-        m, _ = jax.lax.scan(
-            max_body,
-            jnp.full((n_seg, h_), -jnp.inf, jnp.float32) + vzero,
-            (es_p, ed_p))
-        m = jnp.where(jnp.isfinite(m), m, 0.0)
+    m, _ = jax.lax.scan(
+        max_body, jnp.full((n_seg, h_), -jnp.inf, jnp.float32) + vzero,
+        (es_p, ed_p))
+    m = jnp.where(jnp.isfinite(m), m, 0.0)  # empty segments
 
-        def sum_body(s_acc, idx):
-            es, ed = idx
-            e = jax.nn.leaky_relu(el[es] + er[ed], slope)
-            ex = jnp.exp(e - m[ed])
-            return s_acc + jax.ops.segment_sum(ex, ed, n_seg), None
+    def sum_body(s_acc, idx):
+        es, ed = idx
+        ex = jnp.exp(logits(es, ed) - m[ed])
+        return s_acc + jax.ops.segment_sum(ex, ed, n_seg), None
 
-        s, _ = jax.lax.scan(sum_body, jnp.zeros((n_seg, h_), jnp.float32) + vzero,
-                            (es_p, ed_p))
+    s, _ = jax.lax.scan(
+        sum_body, jnp.zeros((n_seg, h_), jnp.float32) + vzero,
+        (es_p, ed_p))
 
-        def out_body(o_acc, idx):
-            es, ed = idx
-            e = jax.nn.leaky_relu(el[es] + er[ed], slope)
-            alpha = jnp.exp(e - m[ed]) / jnp.maximum(s[ed], 1e-16)
-            msg = z[es].astype(jnp.float32) * alpha[..., None]
-            return o_acc + jax.ops.segment_sum(msg, ed, n_seg), None
+    def out_body(o_acc, idx):
+        es, ed = idx
+        alpha = jnp.exp(logits(es, ed) - m[ed]) \
+            / jnp.maximum(s[ed], 1e-16)
+        msg = z[es].astype(jnp.float32) * alpha[..., None]
+        return o_acc + jax.ops.segment_sum(msg, ed, n_seg), None
 
-        out, _ = jax.lax.scan(out_body, jnp.zeros((n_seg, h_, dh), jnp.float32) + vzero,
-                              (es_p, ed_p))
-        out = out[:n_dst]
-    else:
-        e, m = seg_passes(edge_src, edge_dst)
-        m = jnp.where(jnp.isfinite(m), m, 0.0)  # empty segments
-        ex = jnp.exp(e - m[edge_dst])
-        s = jax.ops.segment_sum(ex, edge_dst, n_seg)
-        alpha = ex / jnp.maximum(s[edge_dst], 1e-16)
-        msg = z[edge_src].astype(jnp.float32) * alpha[..., None]
-        out = jax.ops.segment_sum(msg, edge_dst, n_seg)[:n_dst]
+    out, _ = jax.lax.scan(
+        out_body, jnp.zeros((n_seg, h_, dh), jnp.float32) + vzero,
+        (es_p, ed_p))
+    out = out[:n_dst]
     out = out.mean(axis=1) if is_last else out.reshape(n_dst, h_ * dh)
     return out.astype(out_dtype) + lp["b"].astype(out_dtype)
 

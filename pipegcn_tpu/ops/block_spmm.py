@@ -148,16 +148,28 @@ def estimate_block_coverage(sg, tile: int, n_feat_hint: int,
     n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
     dense = tot = 0
     for r in range(sg.num_parts):
-        e = int(sg.edge_count[r])
-        src = sg.edge_src[r][:e].astype(np.int64)
-        dst = sg.edge_dst[r][:e].astype(np.int64)
-        real = dst < sg.n_max
-        src, dst = src[real], dst[real]
-        _, counts = np.unique((dst // tile) * n_src_tiles + (src // tile),
-                              return_counts=True)
-        dense += int(counts[counts >= thr].sum())
-        tot += int(src.shape[0])
+        cov, _, d, t = _part_block_stats(sg, r, tile, n_src_tiles, thr)
+        dense += d
+        tot += t
     return dense / max(tot, 1)
+
+
+def _part_block_stats(sg, r: int, tile: int, n_src_tiles: int, thr: int):
+    """(coverage, dense_block_count, dense_edges, real_edges) of one
+    device's shard at the given tile/threshold — the single definition
+    of the dense/remainder split shared by estimate_block_coverage and
+    the multichip projection tool."""
+    e = int(sg.edge_count[r])
+    src = sg.edge_src[r][:e].astype(np.int64)
+    dst = sg.edge_dst[r][:e].astype(np.int64)
+    real = dst < sg.n_max
+    src, dst = src[real], dst[real]
+    _, counts = np.unique((dst // tile) * n_src_tiles + (src // tile),
+                          return_counts=True)
+    sel = counts >= thr
+    dense = int(counts[sel].sum())
+    tot = int(src.shape[0])
+    return dense / max(tot, 1), int(sel.sum()), dense, tot
 
 
 class BlockPlan:

@@ -48,7 +48,7 @@ def main():
         f"--xla_force_host_platform_device_count={args.parts}")
 
     from pipegcn_tpu.graph import load_data
-    from pipegcn_tpu.ops.block_spmm import estimate_block_coverage
+    from pipegcn_tpu.ops.block_spmm import _part_block_stats
     from pipegcn_tpu.partition import (ShardedGraph, locality_clusters,
                                        partition_graph)
 
@@ -86,32 +86,16 @@ def main():
     width, isz, n_exch = 256, 2, 3
     tx_bytes = send * width * isz * n_exch * 2  # fwd feats + bwd grads
 
-    # v5e-calibrated per-device epoch cost (docs/PERF_NOTES.md)
+    # v5e-calibrated per-device epoch cost (docs/PERF_NOTES.md) —
+    # coverage and dense-block counts from one O(E) pass per device
     GATHER_RPS, HBM_BPS, MXU = 390e6, 819e9, 0.5 * 197e12
-    tile, thr = 256, None
-    cov = np.array([
-        estimate_block_coverage(
-            type("S", (), {  # single-device view of shard r
-                "num_parts": 1, "n_max": sg.n_max,
-                "halo_size": sg.halo_size,
-                "edge_count": sg.edge_count[r:r + 1],
-                "edge_src": sg.edge_src[r:r + 1],
-                "edge_dst": sg.edge_dst[r:r + 1],
-            })(), tile, 602)
-        for r in range(P)
-    ])
-    uniq_blocks = []
+    tile = 256
+    thr = max(1, (tile * tile) // 602)
     n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
-    for r in range(P):
-        e = int(sg.edge_count[r])
-        src = sg.edge_src[r][:e].astype(np.int64)
-        dst = sg.edge_dst[r][:e].astype(np.int64)
-        real = dst < sg.n_max
-        bid = (dst[real] // tile) * n_src_tiles + (src[real] // tile)
-        u, c = np.unique(bid, return_counts=True)
-        t_ = max(1, (tile * tile) // 602)
-        uniq_blocks.append(int((c >= t_).sum()))
-    dense_blocks = np.array(uniq_blocks)
+    stats = [_part_block_stats(sg, r, tile, n_src_tiles, thr)
+             for r in range(P)]
+    cov = np.array([st[0] for st in stats])
+    dense_blocks = np.array([st[1] for st in stats])
 
     rem_edges = edges * (1 - cov)
     t_rem = rem_edges * 2 * 6 / GATHER_RPS         # 2 slabs, 6 SpMMs
