@@ -676,7 +676,12 @@ class Trainer:
     def train_epoch(self, epoch: int) -> float:
         rng = jax.random.fold_in(self._epoch_rng_base(), epoch)
         self.state, loss = self._step(self.state, self.data, rng)
-        return float(loss)
+        loss = float(loss)  # blocks: the dispatch completed successfully
+        # floor of completed epochs, for crash checkpointing — advanced
+        # only AFTER the blocking conversion above so an async device
+        # failure surfacing at the sync never overstates progress
+        self.last_epoch = epoch + 1
+        return loss
 
     def train_epochs(self, start_epoch: int, k: int) -> np.ndarray:
         """Run epochs [start_epoch, start_epoch + k) as ONE compiled
@@ -689,7 +694,9 @@ class Trainer:
             jnp.arange(start_epoch, start_epoch + k)
         )
         self.state, losses = self._multi_step(self.state, self.data, rngs)
-        return np.asarray(losses)
+        losses = np.asarray(losses)  # blocks (see train_epoch)
+        self.last_epoch = start_epoch + k
+        return losses
 
     def fit(
         self,
@@ -814,86 +821,106 @@ class Trainer:
         # True while a dispatched-but-unfinished eval occupies the device
         # stream (its time would contaminate the next block's timing)
         eval_in_stream = False
-        while epoch < n_epochs:
-            if profile_dir and not profiling and \
-                    epoch >= min(start_epoch + 6, n_epochs - 1):
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-            chunk = min(fused, n_epochs - epoch)
-            for m in periods:
-                to_boundary = m - epoch % m
-                chunk = min(chunk, to_boundary)
-            if profiling or (profile_dir and epoch < start_epoch + 10):
-                chunk = 1  # epoch-granular around the profiled window
-            timer.clear()
-            with timer.timer("step"):
-                if chunk == 1:
-                    loss = self.train_epoch(epoch)
-                else:
-                    loss = float(self.train_epochs(epoch, chunk)[-1])
-                jax.block_until_ready(self.state["params"])
-            dur = timer.durations()["step"] / chunk
-            if profiling and epoch >= start_epoch + 8:
-                jax.profiler.stop_trace()
-                profiling = False
-                log_fn(f"profiler trace written to {profile_dir}")
-            # first 5 epochs after (re)start excluded from averaged
-            # timings — they include jit compilation (the reference
-            # excludes epochs <5 and log epochs, train.py:364). A chunk
-            # length seen for the first time also compiles (one scan
-            # program per distinct length) — exclude that block too. And
-            # a block right after an async eval dispatch waits on the
-            # eval's device time (enqueued ahead of it on the same
-            # stream), so exclude it as well — the reference's Time(s)
-            # likewise excludes eval (it runs on the CPU thread).
-            first_of_len = chunk not in seen_chunks
-            seen_chunks.add(chunk)
-            if epoch >= start_epoch + 5 and not first_of_len \
-                    and not eval_in_stream:
-                durs.extend([dur] * chunk)
-            eval_in_stream = False
-            epoch += chunk - 1  # body below sees the block's last epoch
-            if measure_comm_cost and not comm_measured and \
-                    epoch >= min(start_epoch + 5, n_epochs - 1):
-                # standalone collective cost, measured once post-compile
-                # (the reference reports per-epoch exposed comm/reduce
-                # waits, train.py:366-371; SPMD overlaps those inside
-                # the step, so we report the collectives' own cost)
-                comm_cost = self.measure_comm()
-                comm_measured = True
-
-            if reference_logs and (epoch + 1) % 10 == 0:
-                # reference log line format (train.py:369-371); rank is
-                # always 0 in SPMD (one controller)
-                log_fn("Process {:03d} | Epoch {:05d} | Time(s) {:.4f} | "
-                       "Comm(s) {:.4f} | Reduce(s) {:.4f} | Loss {:.4f}"
-                       .format(0, epoch, float(np.mean(durs or [dur])),
-                               comm_cost["comm"], comm_cost["reduce"],
-                               loss))
-
-            if (epoch + 1) % tcfg.log_every == 0:
-                do_eval = tcfg.eval and eval_graphs and "val" in eval_graphs
-                if do_eval:
-                    if pending is not None:
-                        _harvest_eval(pending)
-                        pending = None
-                    p = _dispatch_eval(epoch, loss, dur)
-                    if async_eval:
-                        pending = p
-                        eval_in_stream = True
+        try:
+            while epoch < n_epochs:
+                if profile_dir and not profiling and \
+                        epoch >= min(start_epoch + 6, n_epochs - 1):
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                chunk = min(fused, n_epochs - epoch)
+                for m in periods:
+                    to_boundary = m - epoch % m
+                    chunk = min(chunk, to_boundary)
+                if profiling or (profile_dir and epoch < start_epoch + 10):
+                    chunk = 1  # epoch-granular around the profiled window
+                timer.clear()
+                with timer.timer("step"):
+                    if chunk == 1:
+                        loss = self.train_epoch(epoch)
                     else:
-                        _harvest_eval(p)
-                else:
-                    history.append((epoch + 1, loss, None))
-                    if not reference_logs:
-                        log_fn(f"Epoch {epoch + 1:05d} | Time(s) "
-                               f"{np.mean(durs or [dur]):.4f} | Loss "
-                               f"{loss:.4f}")
+                        loss = float(self.train_epochs(epoch, chunk)[-1])
+                    jax.block_until_ready(self.state["params"])
+                dur = timer.durations()["step"] / chunk
+                if profiling and epoch >= start_epoch + 8:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log_fn(f"profiler trace written to {profile_dir}")
+                # first 5 epochs after (re)start excluded from averaged
+                # timings — they include jit compilation (the reference
+                # excludes epochs <5 and log epochs, train.py:364). A chunk
+                # length seen for the first time also compiles (one scan
+                # program per distinct length) — exclude that block too. And
+                # a block right after an async eval dispatch waits on the
+                # eval's device time (enqueued ahead of it on the same
+                # stream), so exclude it as well — the reference's Time(s)
+                # likewise excludes eval (it runs on the CPU thread).
+                first_of_len = chunk not in seen_chunks
+                seen_chunks.add(chunk)
+                if epoch >= start_epoch + 5 and not first_of_len \
+                        and not eval_in_stream:
+                    durs.extend([dur] * chunk)
+                eval_in_stream = False
+                epoch += chunk - 1  # body below sees the block's last epoch
+                if measure_comm_cost and not comm_measured and \
+                        epoch >= min(start_epoch + 5, n_epochs - 1):
+                    # standalone collective cost, measured once post-compile
+                    # (the reference reports per-epoch exposed comm/reduce
+                    # waits, train.py:366-371; SPMD overlaps those inside
+                    # the step, so we report the collectives' own cost)
+                    comm_cost = self.measure_comm()
+                    comm_measured = True
 
-            if checkpoint_dir and (epoch + 1) % checkpoint_every == 0:
-                save_checkpoint(checkpoint_dir,
-                                jax.device_get(self.state), epoch + 1)
-            epoch += 1
+                if reference_logs and (epoch + 1) % 10 == 0:
+                    # reference log line format (train.py:369-371); rank is
+                    # always 0 in SPMD (one controller)
+                    log_fn("Process {:03d} | Epoch {:05d} | Time(s) {:.4f} | "
+                           "Comm(s) {:.4f} | Reduce(s) {:.4f} | Loss {:.4f}"
+                           .format(0, epoch, float(np.mean(durs or [dur])),
+                                   comm_cost["comm"], comm_cost["reduce"],
+                                   loss))
+
+                if (epoch + 1) % tcfg.log_every == 0:
+                    do_eval = tcfg.eval and eval_graphs and "val" in eval_graphs
+                    if do_eval:
+                        if pending is not None:
+                            _harvest_eval(pending)
+                            pending = None
+                        p = _dispatch_eval(epoch, loss, dur)
+                        if async_eval:
+                            pending = p
+                            eval_in_stream = True
+                        else:
+                            _harvest_eval(p)
+                    else:
+                        history.append((epoch + 1, loss, None))
+                        if not reference_logs:
+                            log_fn(f"Epoch {epoch + 1:05d} | Time(s) "
+                                   f"{np.mean(durs or [dur]):.4f} | Loss "
+                                   f"{loss:.4f}")
+
+                if checkpoint_dir and (epoch + 1) % checkpoint_every == 0:
+                    save_checkpoint(checkpoint_dir,
+                                    jax.device_get(self.state), epoch + 1)
+                epoch += 1
+
+        except BaseException:
+            # crash-resilient training (the reference's collectives
+            # hang on any rank failure, SURVEY §5): best-effort save
+            # of the last COMPLETED state so --resume restarts from
+            # it, not epoch 0. self.state only advances after a
+            # fully-completed dispatch and self.last_epoch only
+            # after its blocking sync, so both are consistent here.
+            if checkpoint_dir:
+                try:
+                    done = int(getattr(self, "last_epoch",
+                                       start_epoch))
+                    save_checkpoint(checkpoint_dir,
+                                    jax.device_get(self.state), done)
+                    log_fn(f"crash checkpoint saved to "
+                           f"{checkpoint_dir} (epoch {done})")
+                except Exception as save_exc:  # noqa: BLE001
+                    log_fn(f"crash checkpoint failed: {save_exc!r}")
+            raise
 
         if pending is not None:
             # harvest the final in-flight evaluation

@@ -49,7 +49,20 @@ def save_pytree(path: str, tree: Any) -> None:
             arrays[_BF16_TAG + key] = arr.view(np.uint16)
         else:
             arrays[key] = arr
-    np.savez_compressed(path, **arrays)
+    # temp + atomic rename: an interrupted save (disk full, SIGTERM,
+    # crash-handler save racing a second failure) must never destroy
+    # the previous good checkpoint at `path`
+    # (np.savez appends ".npz" unless the name already ends with it)
+    tmp = path + ".tmp.npz"
+    try:
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def load_pytree(path: str, template: Any) -> Any:

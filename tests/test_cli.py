@@ -130,3 +130,28 @@ def test_cli_checkpoint_resume_gat(tmp_path):
                                ckpt, "--resume", "--skip-partition",
                                "--n-epochs", "40"]))
     assert res["best_val"] > 0.6
+
+
+def test_cli_crash_checkpoint(tmp_path, monkeypatch):
+    """A crash mid-training saves the last completed state so --resume
+    restarts from it (the reference's collectives just hang on failure,
+    SURVEY.md aux subsystems)."""
+    from pipegcn_tpu.parallel.trainer import Trainer
+
+    ckpt = str(tmp_path / "ckpt_crash")
+    orig = Trainer.train_epoch
+
+    def boom(self, epoch):
+        if epoch >= 12:
+            raise RuntimeError("injected device loss")
+        return orig(self, epoch)
+
+    monkeypatch.setattr(Trainer, "train_epoch", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        run(_args(tmp_path, ["--checkpoint-dir", ckpt,
+                             "--checkpoint-every", "100"]))
+    assert os.path.exists(os.path.join(ckpt, "state.npz"))
+    monkeypatch.setattr(Trainer, "train_epoch", orig)
+    res = run(_args(tmp_path, ["--checkpoint-dir", ckpt, "--resume",
+                               "--skip-partition"]))
+    assert res["best_val"] > 0.6
