@@ -49,6 +49,7 @@ from .bucket_spmm import (
     _bucket_widths,
     bucket_aggregate,
     build_tables_for_edges,
+    ladder_prefix,
 )
 
 
@@ -541,10 +542,10 @@ def build_sharded_block_tables(sg, tile: int = 256,
     bw_len = max(len(p.rem_bwd_widths) for p in plans)
     fk_len = max(len(p.fwd_k_widths) for p in plans)
     bk_len = max(len(p.bwd_k_widths) for p in plans)
-    fw = [1 << i for i in range(fw_len)]
-    bw = [1 << i for i in range(bw_len)]
-    fk = [1 << i for i in range(fk_len)]
-    bk = [1 << i for i in range(bk_len)]
+    fw = ladder_prefix(fw_len)
+    bw = ladder_prefix(bw_len)
+    fk = ladder_prefix(fk_len)
+    bk = ladder_prefix(bk_len)
     if any(p.rem_fwd_widths != fw or p.rem_bwd_widths != bw
            or p.fwd_k_widths != fk or p.bwd_k_widths != bk
            for p in plans):

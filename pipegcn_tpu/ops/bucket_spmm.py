@@ -6,7 +6,7 @@ does NOT fit VMEM (where ops/pallas_spmm.py applies). XLA lowers
 `segment_sum` to scatter-add, which serializes badly on TPU; this
 formulation removes every scatter from both the forward AND the backward:
 
-  1. Host: bucket destination rows by power-of-2 local degree. Each
+  1. Host: bucket destination rows by ~x1.5-ladder local degree. Each
      bucket b holds a padded neighbor-index matrix idx_b of shape
      [n_b, D_b] (D_b = bucket width; pad entries point at a zero
      sentinel row appended to fbuf).
@@ -20,14 +20,15 @@ itself an SpMM with edge roles swapped — so the host also builds
 transpose tables (bucket by *source* out-degree) and the custom VJP runs
 the same scatter-free kernel in the other direction, accumulating in f32.
 
-Padding overhead is bounded by 2x (power-of-2 widths) and is far smaller
-on real degree distributions. All shapes are static; per-device tables
+Padding overhead is bounded by 1.5x (the _ladder_rungs width steps)
+and is ~1.2x on real degree distributions. All shapes are static; per-device tables
 are padded to shared maxima so one traced program serves every device in
 shard_map (same approach as ops/pallas_spmm.build_sharded_tables).
 """
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,16 +48,33 @@ DEFAULT_CHUNK_ELEMS = 32 * 1024 * 1024
 SLAB_BYTES = 256
 
 
-def _bucket_widths(max_deg: int) -> List[int]:
-    """Power-of-2 ladder [1, 2, 4, ..., >= max_deg]."""
-    widths = []
+def _ladder_rungs():
+    """The single source of the bucket-width progression: ~x1.5 steps
+    [1, 2, 3, 4, 6, 9, 13, ...]. This bounds bucket padding at 1.5x
+    worst-case (~1.2x on real degree distributions) vs 2x/1.33x for
+    power-of-2 steps — measured at Reddit scale the pow-2 tables
+    carried 1.34x remainder and 1.43x dense-K padding, ~0.35 s/epoch
+    of pure pad work. A longer ladder only adds a few extra (cheap)
+    bucket launches."""
     w = 1
     while True:
+        yield w
+        w = max(w + 1, (w * 3) // 2)
+
+
+def _bucket_widths(max_deg: int) -> List[int]:
+    """Ladder rungs up to (and including) the first >= max_deg."""
+    widths = []
+    for w in _ladder_rungs():
         widths.append(w)
         if w >= max_deg:
-            break
-        w *= 2
-    return widths
+            return widths
+
+
+def ladder_prefix(n: int) -> List[int]:
+    """First n rungs (the sharded builders regenerate shared ladders
+    of a given length from the same generator)."""
+    return list(itertools.islice(_ladder_rungs(), n))
 
 
 def build_tables_for_edges(

@@ -156,7 +156,7 @@ class Trainer:
     # ---------------- pallas spmm selection ---------------------------
 
     # bump when any kernel-table layout changes: stale caches must miss
-    _TABLES_FORMAT = 4  # v4: bit-packed A + K-bucketed tile lists
+    _TABLES_FORMAT = 5  # v5: x1.5-step bucket/K ladders (pad <= 1.5x)
 
     def _cached_tables(self, kind: str, build_fn):
         """Disk-cache derived kernel tables next to the partition
@@ -676,12 +676,16 @@ class Trainer:
     def train_epoch(self, epoch: int) -> float:
         rng = jax.random.fold_in(self._epoch_rng_base(), epoch)
         self.state, loss = self._step(self.state, self.data, rng)
-        loss = float(loss)  # blocks: the dispatch completed successfully
-        # floor of completed epochs, for crash checkpointing — advanced
-        # only AFTER the blocking conversion above so an async device
-        # failure surfacing at the sync never overstates progress
+        # last_epoch labels the buffers self.state now references (the
+        # previous state was DONATED into the dispatch, so there is no
+        # older state to fall back to). If the dispatch failed, these
+        # buffers are poisoned and the crash handler's device_get raises
+        # — it then skips the save rather than writing a wrong pair; if
+        # it succeeded (even with the host interrupted during the
+        # blocking float() below), state and label are consistent and a
+        # resume neither skips nor repeats an epoch.
         self.last_epoch = epoch + 1
-        return loss
+        return float(loss)
 
     def train_epochs(self, start_epoch: int, k: int) -> np.ndarray:
         """Run epochs [start_epoch, start_epoch + k) as ONE compiled
@@ -694,9 +698,8 @@ class Trainer:
             jnp.arange(start_epoch, start_epoch + k)
         )
         self.state, losses = self._multi_step(self.state, self.data, rngs)
-        losses = np.asarray(losses)  # blocks (see train_epoch)
-        self.last_epoch = start_epoch + k
-        return losses
+        self.last_epoch = start_epoch + k  # see train_epoch
+        return np.asarray(losses)
 
     def fit(
         self,
@@ -905,11 +908,13 @@ class Trainer:
 
         except BaseException:
             # crash-resilient training (the reference's collectives
-            # hang on any rank failure, SURVEY §5): best-effort save
-            # of the last COMPLETED state so --resume restarts from
-            # it, not epoch 0. self.state only advances after a
-            # fully-completed dispatch and self.last_epoch only
-            # after its blocking sync, so both are consistent here.
+            # hang on any rank failure, SURVEY §5): best-effort save of
+            # the last good state so --resume restarts from it, not
+            # epoch 0. last_epoch labels self.state's buffers (see
+            # train_epoch); if those buffers come from a FAILED
+            # dispatch, device_get below raises and the save is
+            # skipped — the previous periodic checkpoint survives
+            # (saves are atomic).
             if checkpoint_dir:
                 try:
                     done = int(getattr(self, "last_epoch",

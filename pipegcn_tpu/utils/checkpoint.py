@@ -38,10 +38,13 @@ _BF16 = np.dtype(jax.numpy.bfloat16.dtype)
 _BF16_TAG = "__bf16__/"
 
 
-def save_pytree(path: str, tree: Any) -> None:
+def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
+    """`extra` adds raw scalar/array entries (e.g. the checkpoint
+    epoch) to the npz; load_pytree ignores them (it reads only the
+    template's paths)."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    arrays = {}
+    arrays = dict(extra or {})
     for p, v in leaves:
         arr = np.asarray(v)
         key = _path_str(p)
@@ -94,18 +97,25 @@ def load_pytree(path: str, template: Any) -> Any:
 
 
 def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int) -> None:
-    """Save full training state for resume."""
+    """Save full training state for resume.
+
+    The epoch rides INSIDE state.npz (one atomic os.replace), so a
+    crash between writes can never pair a new state with an old epoch
+    number — which would double-step the optimizer on resume."""
     os.makedirs(directory, exist_ok=True)
-    save_pytree(os.path.join(directory, "state.npz"), state)
-    with open(os.path.join(directory, "epoch.txt"), "w") as f:
-        f.write(str(epoch))
+    save_pytree(os.path.join(directory, "state.npz"), state,
+                extra={"__epoch__": np.asarray(epoch, np.int64)})
 
 
 def load_checkpoint(directory: str, template: Dict[str, Any]):
     """Returns (state, next_epoch) restored from save_checkpoint."""
     state = load_pytree(os.path.join(directory, "state.npz"), template)
-    with open(os.path.join(directory, "epoch.txt")) as f:
-        epoch = int(f.read().strip())
+    data = np.load(os.path.join(directory, "state.npz"))
+    if "__epoch__" in data.files:
+        epoch = int(data["__epoch__"])
+    else:  # checkpoints from before the epoch moved into the npz
+        with open(os.path.join(directory, "epoch.txt")) as f:
+            epoch = int(f.read().strip())
     return state, epoch
 
 
