@@ -519,13 +519,50 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
 
     metric = "reddit_scale_epoch_time" if not args.small else \
         "small_epoch_time"
-    print(json.dumps({
+    result = {
         "metric": metric,
         "value": round(epoch_s, 4),
         "unit": "s/epoch",
         "vs_baseline": round(BASELINE_EPOCH_S / epoch_s, 3),
         **extras,
-    }))
+    }
+    # anchored at the repo root (bench may be invoked from any CWD)
+    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "results", "last_tpu_bench.json")
+    if backend == "tpu" and metric == "reddit_scale_epoch_time" \
+            and not extras.get("degraded"):
+        # record the full-quality headline so a later degraded/CPU run
+        # can still surface the most recent real-TPU measurement
+        # (degraded re-exec stages are excluded: their reduced sampling
+        # is not comparable to a full run)
+        try:
+            import datetime
+
+            os.makedirs(os.path.dirname(last_path), exist_ok=True)
+            tmp = last_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({
+                    "metric": metric, "value": result["value"],
+                    "unit": "s/epoch",
+                    "vs_baseline": result["vs_baseline"],
+                    "backend": backend, "device": device_kind,
+                    "spmm_impl": args.spmm_impl, "dtype": extras["dtype"],
+                    "measured_utc": datetime.datetime.now(
+                        datetime.timezone.utc).isoformat(),
+                }, f)
+            os.replace(tmp, last_path)  # atomic: a mid-write kill must
+            # not destroy the previous good record
+        except OSError:
+            pass
+    elif backend != "tpu":
+        # a CPU-labeled number proves the harness, not the perf; attach
+        # the last real-TPU headline (clearly labeled) for context
+        try:
+            with open(last_path) as f:
+                result["last_tpu_measurement"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
