@@ -153,3 +153,20 @@ def test_trainer_bucket_bf16_fused():
     losses = list(t.train_epochs(0, 4)) + list(t.train_epochs(4, 16))
     assert np.isfinite(losses).all()
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def test_ladder_prefix_lockstep():
+    """ladder_prefix and _bucket_widths must come from the same
+    progression: the sharded builders regenerate shared ladders by
+    length and silently corrupt tables if the two ever diverge."""
+    from pipegcn_tpu.ops.bucket_spmm import _bucket_widths, ladder_prefix
+
+    for md in (1, 2, 5, 17, 492, 65536, 1_000_000):
+        w = _bucket_widths(md)
+        assert w == ladder_prefix(len(w))
+        assert w[-1] >= md
+        if len(w) > 1:
+            assert w[-2] < md
+        assert all(b > a for a, b in zip(w, w[1:]))
+        # padding bound: each rung at most 1.5x the previous
+        assert all(b <= max(a + 1, (a * 3) // 2) for a, b in zip(w, w[1:]))
