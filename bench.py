@@ -444,8 +444,10 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
             from pipegcn_tpu.ops.block_spmm import estimate_block_coverage
 
             w_hint = max(cfg.layer_sizes[:cfg.n_graph_layers])
+            # CLI convention: 0 means "use the break-even default"
             extras["dense_coverage"] = round(estimate_block_coverage(
-                sg, args.block_tile, w_hint, nnz_threshold=args.block_nnz
+                sg, args.block_tile, w_hint,
+                nnz_threshold=args.block_nnz or None
             ), 3)
             extras["dense_blocks"] = int(
                 next(v for k, v in trainer._block_tables.items()

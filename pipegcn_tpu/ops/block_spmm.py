@@ -52,6 +52,17 @@ from .bucket_spmm import (
     ladder_prefix,
 )
 
+# HBM budget for the per-device dense-A tensor (see
+# build_sharded_block_tables) — shared with estimate_block_coverage and
+# the multichip projection so every consumer predicts the same spill.
+DENSE_A_BYTE_BUDGET = 2 << 30
+
+
+def budget_block_cap(byte_budget: int, tile: int, bits: int = 1) -> int:
+    """Max dense A-blocks that fit `byte_budget` at `bits` per entry
+    (1 = the optimistic bit-packed encoding for 0/1 graphs)."""
+    return max(1, (int(byte_budget) * 8) // (tile * tile * bits))
+
 
 def _pad_rows(mat: np.ndarray, rows: int, fill) -> np.ndarray:
     if mat.shape[0] == rows:
@@ -102,6 +113,14 @@ def _group_by_key(keys, vals_a, vals_b, n_groups, widths, pad_a, pad_b):
     order = np.argsort(keys, kind="stable")
     va, vb = vals_a[order], vals_b[order]
     cnt = np.bincount(keys, minlength=n_groups)
+    # The fill mask truncates at each key's class width, so a ladder
+    # whose top rung is below the max per-key count would silently drop
+    # (A-block, tile) pairs. Fail loudly instead of aggregating wrong.
+    max_cnt = int(cnt.max(initial=0))
+    if max_cnt > widths[-1]:
+        raise ValueError(
+            f"width ladder {tuple(widths)} tops out below the max "
+            f"per-key pair count {max_cnt}; pairs would be dropped")
     ptr = np.zeros(n_groups + 1, np.int64)
     np.cumsum(cnt, out=ptr[1:])
     widths_arr = np.asarray(widths, dtype=np.int64)
@@ -131,7 +150,9 @@ def _group_by_key(keys, vals_a, vals_b, n_groups, widths, pad_a, pad_b):
 
 
 def estimate_block_coverage(sg, tile: int, n_feat_hint: int,
-                            nnz_threshold: Optional[int] = None) -> float:
+                            nnz_threshold: Optional[int] = None,
+                            byte_budget: Optional[int] = DENSE_A_BYTE_BUDGET,
+                            ) -> float:
     """Fraction of real edges lying in (dst-tile, src-tile) blocks dense
     enough for the MXU path (>= `nnz_threshold`, defaulting to
     BlockPlan's read-cost break-even).
@@ -143,23 +164,47 @@ def estimate_block_coverage(sg, tile: int, n_feat_hint: int,
     community edges into dense tiles. Counting goes through np.unique
     on the occupied block ids (O(E) memory) — a dense bincount over the
     n_dst_tiles x n_src_tiles id space would be tens of GB at
-    10M-node-shard scale."""
-    thr = nnz_threshold if nnz_threshold else max(
+    10M-node-shard scale.
+
+    `byte_budget` mirrors build_sharded_block_tables' HBM cap: without
+    it the estimate counts dense blocks the real plan would spill, and
+    `auto` could pick the block kernel at a realized coverage far below
+    the threshold. The cap tracks the builder's A encoding: 1-bit
+    packing when the graph is simple (no duplicate edges) and
+    tile % 8 == 0, else the int8 cap (8x fewer blocks) — the bf16/f32
+    ratchets (multiplicity > 127) are rare enough to leave optimistic."""
+    thr = nnz_threshold if nnz_threshold is not None else max(
         1, (tile * tile) // max(n_feat_hint, 1))
-    n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
+    n_src_rows = sg.n_max + sg.halo_size
+    n_src_tiles = -(-n_src_rows // tile)
+    cap = None
+    if byte_budget is not None:
+        bits = 1 if tile % 8 == 0 else 8
+        if bits == 1:
+            for r in range(sg.num_parts):
+                e = int(sg.edge_count[r])
+                key = (sg.edge_dst[r][:e].astype(np.int64) * n_src_rows
+                       + sg.edge_src[r][:e].astype(np.int64))
+                if np.unique(key).shape[0] < key.shape[0]:
+                    bits = 8  # duplicate edges -> builder can't bit-pack
+                    break
+        cap = budget_block_cap(byte_budget, tile, bits)
     dense = tot = 0
     for r in range(sg.num_parts):
-        cov, _, d, t = _part_block_stats(sg, r, tile, n_src_tiles, thr)
+        cov, _, d, t = _part_block_stats(sg, r, tile, n_src_tiles, thr,
+                                         max_blocks=cap)
         dense += d
         tot += t
     return dense / max(tot, 1)
 
 
-def _part_block_stats(sg, r: int, tile: int, n_src_tiles: int, thr: int):
+def _part_block_stats(sg, r: int, tile: int, n_src_tiles: int, thr: int,
+                      max_blocks: Optional[int] = None):
     """(coverage, dense_block_count, dense_edges, real_edges) of one
     device's shard at the given tile/threshold — the single definition
     of the dense/remainder split shared by estimate_block_coverage and
-    the multichip projection tool."""
+    the multichip projection tool. `max_blocks` keeps only the densest
+    blocks, matching BlockPlan's budget cutoff."""
     e = int(sg.edge_count[r])
     src = sg.edge_src[r][:e].astype(np.int64)
     dst = sg.edge_dst[r][:e].astype(np.int64)
@@ -168,9 +213,13 @@ def _part_block_stats(sg, r: int, tile: int, n_src_tiles: int, thr: int):
     _, counts = np.unique((dst // tile) * n_src_tiles + (src // tile),
                           return_counts=True)
     sel = counts >= thr
-    dense = int(counts[sel].sum())
+    if max_blocks is not None and int(sel.sum()) > max_blocks:
+        kept = np.sort(counts[sel])[-max_blocks:]
+        dense, n_dense = int(kept.sum()), int(kept.shape[0])
+    else:
+        dense, n_dense = int(counts[sel].sum()), int(sel.sum())
     tot = int(src.shape[0])
-    return dense / max(tot, 1), int(sel.sum()), dense, tot
+    return dense / max(tot, 1), n_dense, dense, tot
 
 
 class BlockPlan:
@@ -466,7 +515,7 @@ def plan_to_arrays(p: BlockPlan) -> Dict[str, np.ndarray]:
 
 def build_sharded_block_tables(sg, tile: int = 256,
                                n_feat_hint: int = 256,
-                               byte_budget: int = 2 << 30,
+                               byte_budget: int = DENSE_A_BYTE_BUDGET,
                                nnz_threshold: Optional[int] = None,
                                ) -> Tuple[Dict[str, np.ndarray], int]:
     """Stacked per-device hybrid plans (leading device axis), padded to
@@ -485,7 +534,7 @@ def build_sharded_block_tables(sg, tile: int = 256,
     # force a wider dtype, plans rebuild under the correspondingly
     # smaller cap.
     def cap_for(bits: int) -> int:
-        return max(1, (int(byte_budget) * 8) // (tile * tile * bits))
+        return budget_block_cap(byte_budget, tile, bits)
 
     # narrowest exact encoding for the A counts: 1-bit packing (counts
     # <= 1) buys 8x the dense coverage of int8 (<= 127) per HBM byte,

@@ -68,32 +68,45 @@ def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
                 pass
 
 
-def load_pytree(path: str, template: Any) -> Any:
-    """Load arrays saved by save_pytree into template's structure."""
-    data = np.load(path)
+def load_pytree(path: str, template: Any, *, with_extras: bool = False):
+    """Load arrays saved by save_pytree into template's structure.
+
+    With with_extras=True returns (tree, extras) where extras holds the
+    non-leaf keys (the `extra=` dict passed to save_pytree), so callers
+    needing both never reopen the archive."""
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
-    for p, tmpl in paths:
-        key = _path_str(p)
-        if _BF16_TAG + key in data:
-            arr = data[_BF16_TAG + key].view(_BF16)
-        elif key in data:
-            arr = data[key]
-        else:
-            raise KeyError(f"checkpoint {path} missing leaf {key}")
-        if tuple(arr.shape) != tuple(np.shape(tmpl)):
-            raise ValueError(
-                f"checkpoint leaf {key}: shape {arr.shape} != template "
-                f"{np.shape(tmpl)}"
-            )
-        tdt = np.asarray(tmpl).dtype
-        if arr.dtype != tdt:
-            # e.g. resuming an f32-run checkpoint under --dtype bfloat16:
-            # convert to the template's dtype so the restored state matches
-            # the step's compiled avals
-            arr = arr.astype(tdt)
-        leaves.append(arr)
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    leaf_keys = set()
+    extras = {}
+    with np.load(path) as data:
+        for p, tmpl in paths:
+            key = _path_str(p)
+            if _BF16_TAG + key in data:
+                arr = data[_BF16_TAG + key].view(_BF16)
+                leaf_keys.add(_BF16_TAG + key)
+            elif key in data:
+                arr = data[key]
+                leaf_keys.add(key)
+            else:
+                raise KeyError(f"checkpoint {path} missing leaf {key}")
+            if tuple(arr.shape) != tuple(np.shape(tmpl)):
+                raise ValueError(
+                    f"checkpoint leaf {key}: shape {arr.shape} != template "
+                    f"{np.shape(tmpl)}"
+                )
+            tdt = np.asarray(tmpl).dtype
+            if arr.dtype != tdt:
+                # e.g. resuming an f32-run checkpoint under --dtype
+                # bfloat16: convert to the template's dtype so the restored
+                # state matches the step's compiled avals
+                arr = arr.astype(tdt)
+            leaves.append(arr)
+        if with_extras:
+            for key in data.files:
+                if key not in leaf_keys:
+                    extras[key] = data[key]
+    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    return (tree, extras) if with_extras else tree
 
 
 def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int) -> None:
@@ -109,10 +122,10 @@ def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int) -> None:
 
 def load_checkpoint(directory: str, template: Dict[str, Any]):
     """Returns (state, next_epoch) restored from save_checkpoint."""
-    state = load_pytree(os.path.join(directory, "state.npz"), template)
-    data = np.load(os.path.join(directory, "state.npz"))
-    if "__epoch__" in data.files:
-        epoch = int(data["__epoch__"])
+    state, extras = load_pytree(os.path.join(directory, "state.npz"),
+                                template, with_extras=True)
+    if "__epoch__" in extras:
+        epoch = int(extras["__epoch__"])
     else:  # checkpoints from before the epoch moved into the npz
         with open(os.path.join(directory, "epoch.txt")) as f:
             epoch = int(f.read().strip())

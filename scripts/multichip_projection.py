@@ -48,7 +48,9 @@ def main():
         f"--xla_force_host_platform_device_count={args.parts}")
 
     from pipegcn_tpu.graph import load_data
-    from pipegcn_tpu.ops.block_spmm import _part_block_stats
+    from pipegcn_tpu.ops.block_spmm import (DENSE_A_BYTE_BUDGET,
+                                            _part_block_stats,
+                                            budget_block_cap)
     from pipegcn_tpu.partition import (ShardedGraph, locality_clusters,
                                        partition_graph)
 
@@ -92,7 +94,12 @@ def main():
     tile = 256
     thr = max(1, (tile * tile) // 602)
     n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
-    stats = [_part_block_stats(sg, r, tile, n_src_tiles, thr)
+    # cap at the HBM byte budget exactly as the real plan builder does —
+    # uncapped counts would project dense capacity the budgeted plan
+    # spills to the remainder
+    cap = budget_block_cap(DENSE_A_BYTE_BUDGET, tile)
+    stats = [_part_block_stats(sg, r, tile, n_src_tiles, thr,
+                               max_blocks=cap)
              for r in range(P)]
     cov = np.array([st[0] for st in stats])
     dense_blocks = np.array([st[1] for st in stats])
