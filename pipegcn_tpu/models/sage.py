@@ -83,12 +83,13 @@ class ModelConfig:
             if self.n_heads < 1:
                 raise ValueError(f"n_heads must be >= 1, got "
                                  f"{self.n_heads}")
-            if self.spmm_impl not in ("xla", "auto"):
-                # attention weights are per-edge: the precomputed
-                # unweighted kernel tables cannot express them
+            if self.spmm_impl not in ("xla", "auto", "bucket"):
+                # per-edge attention weights need the attention-bucket
+                # kernel (ops/gat_bucket.py); the pallas/block tables
+                # are unweighted and cannot express them
                 raise ValueError(
                     f"spmm_impl={self.spmm_impl!r} does not apply to "
-                    f"gat (per-edge attention weights); use 'xla'/'auto'")
+                    f"gat; use 'xla', 'bucket' or 'auto'")
             for i in range(self.n_layers - self.n_linear):
                 if i < self.n_layers - 1 \
                         and self.layer_sizes[i + 1] % self.n_heads:
@@ -253,19 +254,21 @@ def _sync_batch_norm_eval(h, scale, bias, state, eps=1e-5):
 
 
 def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
-               is_last, out_dtype, chunk=None):
-    """Multi-head edge-softmax attention aggregation over the raw edge
-    list (halo sources included; pad edges carry dst == n_dst and fall
-    into a discarded sentinel segment).
+               is_last, out_dtype, chunk=None, gat_fn=None):
+    """Multi-head edge-softmax attention aggregation.
 
-    fbuf: [R, d_in] source rows. Returns [n_dst, d_out] — heads
-    concatenated on hidden layers, averaged on a final (logits) layer.
-    Attention statistics and all segment accumulations run in f32
-    regardless of the compute dtype; a final (logits) layer accumulates
-    its matmul in f32 like dense() does. `chunk` (cfg.spmm_chunk)
-    bounds the per-pass edge intermediates the way spmm_mean's chunking
-    does — without it the [E, H, dh] message tensor is materialized
-    whole."""
+    fbuf: [R, d_in] source rows (halo included). Returns [n_dst, d_out]
+    — heads concatenated on hidden layers, averaged on a final (logits)
+    layer. Attention statistics and all segment accumulations run in
+    f32 regardless of the compute dtype; a final (logits) layer
+    accumulates its matmul in f32 like dense() does.
+
+    With `gat_fn` (the scatter-free attention-bucket kernel closure,
+    ops/gat_bucket.make_device_gat_fn) the aggregation runs through
+    precomputed bucket tables; otherwise over the raw edge list (pad
+    edges carry dst == n_dst and fall into a discarded sentinel
+    segment). `chunk` (cfg.spmm_chunk) bounds the raw path's per-pass
+    edge intermediates the way spmm_mean's chunking does."""
     h_ = n_heads
     z = jnp.matmul(fbuf, lp["w"].astype(fbuf.dtype),
                    preferred_element_type=jnp.float32 if is_last
@@ -275,6 +278,13 @@ def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
     zf = z.astype(jnp.float32)
     el = (zf * lp["a_src"]).sum(-1)                    # [R, H]
     er = (zf[:n_dst] * lp["a_dst"]).sum(-1)            # [n_dst, H]
+
+    if gat_fn is not None:
+        out = gat_fn(z, el, er)                        # [n_dst, H, dh]
+        out = out.mean(axis=1) if is_last \
+            else out.reshape(n_dst, h_ * dh)
+        return out.astype(out_dtype) + lp["b"].astype(out_dtype)
+
     er = jnp.concatenate([er, jnp.zeros((1, h_), jnp.float32)])
     n_seg = n_dst + 1
     e_cnt = edge_src.shape[0]
@@ -356,6 +366,7 @@ def forward(
     eval_pp_agg: bool = False,
     row_mask: Optional[jax.Array] = None,
     spmm_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
+    gat_fn: Optional[Callable[..., jax.Array]] = None,
     halo_eval: bool = False,
 ) -> Tuple[jax.Array, List[dict]]:
     """Run the GraphSAGE stack; returns (logits [n_dst, n_class],
@@ -430,7 +441,7 @@ def forward(
                     h = _gat_layer(h, lp, edge_src, edge_dst, n_dst,
                                    cfg.n_heads, cfg.leaky_slope,
                                    i == cfg.n_layers - 1, out_dt,
-                                   chunk=cfg.spmm_chunk)
+                                   chunk=cfg.spmm_chunk, gat_fn=gat_fn)
                 else:
                     # spmm_fn (e.g. the Pallas VMEM-resident kernel)
                     # returns the mean directly when injected

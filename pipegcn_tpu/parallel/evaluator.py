@@ -95,11 +95,16 @@ class ShardedEvaluator:
             spmm = trainer.make_device_spmm_closure(
                 d, n_max=n_max, n_src_rows=n_max + sg.halo_size,
             ) if use_tables else None
+            # GAT aggregates through the attention-bucket closure (its
+            # tables ride in the data exactly like the mean kernels')
+            gat = trainer.make_device_gat_closure(
+                d, n_max=n_max, n_src_rows=n_max + sg.halo_size,
+            ) if use_tables else None
             logits, _ = forward(
                 params, self._cfg, d["feat"], d["edge_src"],
                 d["edge_dst"], d["in_deg"], n_max,
                 training=False, halo_eval=True, comm_update=comm_update,
-                norm_state=norm, spmm_fn=spmm,
+                norm_state=norm, spmm_fn=spmm, gat_fn=gat,
             )
             if multilabel:
                 pred = logits > 0
@@ -176,11 +181,17 @@ class ShardedEvaluator:
         if trainer._edges_trimmed:
             # the training step aggregates through kernel tables, so
             # repeated evals of this foreign graph deserve the same:
-            # build bucket tables for ITS shards (the general-purpose
-            # kernel)
-            from ..ops.bucket_spmm import build_sharded_bucket_tables
+            # build tables for ITS shards — the attention-bucket tables
+            # for GAT (forward() ignores spmm_fn there), else the
+            # general-purpose mean bucket tables
+            if trainer.cfg.model == "gat":
+                from ..ops.gat_bucket import build_sharded_gat_tables
 
-            arrs.update(build_sharded_bucket_tables(sg))
+                arrs.update(build_sharded_gat_tables(sg))
+            else:
+                from ..ops.bucket_spmm import build_sharded_bucket_tables
+
+                arrs.update(build_sharded_bucket_tables(sg))
             use_tables = True
             # the pp precompute also aggregates through the tables, so
             # the raw edge arrays never need to reach the device

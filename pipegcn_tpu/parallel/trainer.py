@@ -106,7 +106,8 @@ class Trainer:
         # untraced when spmm_fn is set, so a token shape suffices)
         self._edges_trimmed = (self._pallas_tables is not None
                                or self._bucket_tables is not None
-                               or self._block_tables is not None)
+                               or self._block_tables is not None
+                               or self._gat_tables is not None)
         # bucket/block tables can also serve the pp precompute, so the
         # raw edges never reach the device at all; the pallas kernel's
         # VMEM gate covered the layer widths only, so a pallas trainer
@@ -238,12 +239,21 @@ class Trainer:
         self._bucket_tables = None
         self._block_tables = None
         self._block_tile = 0
+        self._gat_tables = None
         if impl not in ("xla", "pallas", "auto", "bucket", "block"):
             raise ValueError(f"unknown spmm_impl: {impl}")
         if self.cfg.model == "gat":
-            # attention weights are per-edge: the unweighted kernel
-            # tables (pallas/bucket/block) do not apply — GAT always
-            # aggregates over the raw edge list
+            # per-edge attention weights run through the attention-bucket
+            # kernel (ops/gat_bucket.py) — same scatter-free structure as
+            # the mean path, plus per-bucket row-id tables for the
+            # softmax stats. 'auto' always picks it: the raw-edge
+            # segment path it replaces is the measured 19.8 s/epoch-class
+            # regime (docs/PERF_NOTES.md).
+            if impl in ("auto", "bucket"):
+                from ..ops.gat_bucket import build_sharded_gat_tables
+
+                self._gat_tables = self._cached_tables(
+                    "gat", lambda: build_sharded_gat_tables(self.sg))
             return
         if impl == "xla":
             return
@@ -349,6 +359,8 @@ class Trainer:
             arrs.update(self._bucket_tables)
         if self._block_tables is not None:
             arrs.update(self._block_tables)
+        if self._gat_tables is not None:
+            arrs.update(self._gat_tables)
         return {
             k: jax.device_put(jnp.asarray(v), self._shard)
             for k, v in arrs.items()
@@ -486,6 +498,25 @@ class Trainer:
             )
         return None
 
+    def make_device_gat_closure(self, d: Dict[str, jax.Array],
+                                n_max: Optional[int] = None,
+                                n_src_rows: Optional[int] = None):
+        """Per-device attention-aggregation closure (ops/gat_bucket.py)
+        over the stripped table arrays in `d` — or None when `d`
+        carries no attention-bucket tables (raw-edge GAT path)."""
+        if "gat_fwd_inv" not in d:
+            return None
+        from ..ops.gat_bucket import make_device_gat_fn
+
+        cfg = self.cfg
+        n_max = self.sg.n_max if n_max is None else n_max
+        if n_src_rows is None:
+            n_src_rows = n_max + self.sg.halo_size
+        return make_device_gat_fn(
+            d, n_max, n_src_rows, cfg.n_heads, cfg.leaky_slope,
+            chunk_edges=cfg.spmm_chunk,
+        )
+
     def _build_step(self):
         sg, cfg, tcfg, P = self.sg, self.cfg, self.tcfg, self.P
         n_max, b_max, H = sg.n_max, sg.b_max, sg.halo_size
@@ -550,6 +581,7 @@ class Trainer:
                     )
 
             spmm_fn = self.make_device_spmm_closure(d)
+            gat_fn = self.make_device_gat_closure(d)
 
             def loss_fn(params, probes_arg):
                 nonlocal probes_in
@@ -559,6 +591,7 @@ class Trainer:
                     d["in_deg"], n_max, training=True, rng=rng,
                     comm_update=comm_update, norm_state=norm, psum=psum,
                     row_mask=d["row_mask"], spmm_fn=spmm_fn,
+                    gat_fn=gat_fn,
                 )
                 if multilabel:
                     loss = bce_logits_sum(logits, d["label"], d["train_mask"])
@@ -872,6 +905,17 @@ class Trainer:
                     # the step, so we report the collectives' own cost)
                     comm_cost = self.measure_comm()
                     comm_measured = True
+                    if reference_logs:
+                        # semantics differ from the reference: its Comm(s)
+                        # is per-epoch EXPOSED wait around blocking
+                        # transfers (helper/timer/comm_timer.py); SPMD
+                        # overlaps those inside the jitted step, so the
+                        # fields below are the collectives' standalone
+                        # cost. Annotate the stream so reference-format
+                        # consumers don't compare unlike quantities.
+                        log_fn("# note: Comm(s)/Reduce(s) = standalone "
+                               "collective cost (not exposed wait; SPMD "
+                               "overlaps comm inside the step)")
 
                 if reference_logs and (epoch + 1) % 10 == 0:
                     # reference log line format (train.py:369-371); rank is
