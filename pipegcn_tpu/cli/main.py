@@ -125,8 +125,13 @@ def prepare(args):
             cluster = None
             if args.local_reorder == "cluster":
                 cluster = locality_clusters(pg, seed=seed)
-            sg = ShardedGraph.build(pg, parts, n_parts=args.n_partitions,
-                                    cluster=cluster)
+            # papers100M-class edge lists: the RAM-bounded chunked build
+            # (bit-identical output) keeps the O(E) int64 scratch of the
+            # plain build from crowding host memory
+            build = (ShardedGraph.build_chunked
+                     if pg.num_edges > 200_000_000 else ShardedGraph.build)
+            sg = build(pg, parts, n_parts=args.n_partitions,
+                       cluster=cluster)
             os.makedirs(args.partition_dir, exist_ok=True)
             sg.save(part_path)
             # first runs cache their derived kernel tables too

@@ -47,6 +47,12 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m if m > 0 else x
 
 
+# host-side edge-pass chunk: bounds O(E) int64 temporaries during
+# checksums and the chunked build (6-7 per-edge int64 scratch arrays at
+# a time -> ~0.9 GB per 16M-edge chunk instead of all-E at once)
+_EDGE_CHUNK = 16 * 1024 * 1024
+
+
 def _stable_argsort(keys: np.ndarray) -> np.ndarray:
     """Stable argsort of non-negative int64 fused keys — the difference
     between seconds and minutes at 114M edges. Thin alias of
@@ -110,13 +116,113 @@ class ShardedGraph:
         # splitmix64-mix each fused (src, dst) pair BEFORE the order-free
         # sum: a plain sum of src*N + dst is linear (N*Σsrc + Σdst) and
         # collides for any re-pairing of the same endpoints — exactly the
-        # rewired-graph case the checksum must detect
-        x = np.multiply(g.src.astype(np.uint64),
-                        np.uint64(g.num_nodes)) + g.dst.astype(np.uint64)
-        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-        x ^= x >> np.uint64(31)
-        return int(x.sum(dtype=np.uint64))
+        # rewired-graph case the checksum must detect. Chunked so the
+        # uint64 temporaries stay bounded at papers100M scale (the sum
+        # is order-free, so chunking cannot change the result).
+        total = np.uint64(0)
+        nn = np.uint64(g.num_nodes)
+        for i0 in range(0, g.num_edges, _EDGE_CHUNK):
+            sl = slice(i0, min(i0 + _EDGE_CHUNK, g.num_edges))
+            x = g.src[sl].astype(np.uint64) * nn \
+                + g.dst[sl].astype(np.uint64)
+            x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            x ^= x >> np.uint64(31)
+            total += x.sum(dtype=np.uint64)
+        return int(total)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _send_structures(pair_fused: np.ndarray, parts: np.ndarray,
+                         local_id: np.ndarray, num_parts: int, n: int,
+                         pad_to: int) -> Dict[str, np.ndarray]:
+        """Send lists + halo-slot lookup from the sorted unique
+        (node, dest part) fused-pair array — the shared core of build()
+        and build_chunked().
+
+        Returns send_counts/b_max/send_idx/send_mask plus the pair->slot
+        lookup pieces (`fused_sorted` = pair_fused itself, `dist`,
+        `rank_in_group`, `order` = inverse of the send-list sort) used
+        to localize cross-edge sources."""
+        p_node = pair_fused // num_parts
+        p_dest = (pair_fused % num_parts).astype(np.int32)
+        p_owner = parts[p_node]
+        # sort by (owner, dest, local id) -> grouped send lists in order
+        skey = _stable_argsort(
+            (p_owner.astype(np.int64) * num_parts + p_dest) * n
+            + local_id[p_node]
+        )
+        p_node, p_dest, p_owner = p_node[skey], p_dest[skey], p_owner[skey]
+
+        # group starts for each (owner, dest) combination
+        combo = p_owner.astype(np.int64) * num_parts + p_dest
+        send_counts = np.bincount(
+            combo, minlength=num_parts * num_parts
+        ).reshape(num_parts, num_parts)
+        assert np.all(np.diag(send_counts) == 0)
+        b_max = _round_up(int(send_counts.max()), pad_to) \
+            if num_parts > 1 else 0
+
+        combo_starts = np.zeros(num_parts * num_parts + 1, dtype=np.int64)
+        np.cumsum(send_counts.reshape(-1), out=combo_starts[1:])
+        rank_in_group = np.arange(p_node.shape[0]) - combo_starts[combo]
+
+        # send_idx[r, d-1, k] = local id of k-th node r sends to (r+d)%P
+        # (empty index arrays make these assignments no-ops, so the exact
+        # shape works for P == 1 and b_max == 0 too)
+        send_idx = np.zeros((num_parts, num_parts - 1, b_max),
+                            dtype=np.int32)
+        send_mask = np.zeros_like(send_idx, dtype=bool)
+        dist = (p_dest - p_owner) % num_parts  # ring distance in 1..P-1
+        send_idx[p_owner, dist - 1, rank_in_group] = \
+            local_id[p_node].astype(np.int32)
+        send_mask[p_owner, dist - 1, rank_in_group] = True
+
+        # pair -> slot lookup via a dict-free merge: pair_fused is
+        # already sorted by (node, dest) and p_* are its skey-
+        # permutation, so the sorted key array IS pair_fused and the
+        # sort order is skey's inverse — no third large sort needed
+        fused_sorted_order = np.empty_like(skey)
+        fused_sorted_order[skey] = np.arange(skey.size)
+        return {
+            "send_counts": send_counts,
+            "b_max": b_max,
+            "send_idx": send_idx,
+            "send_mask": send_mask,
+            "fused_sorted": pair_fused,
+            # rank/dist in pair_fused order (hoisted out of the per-
+            # chunk edge localization)
+            "rank_by_pair": rank_in_group[fused_sorted_order],
+            "dist_by_pair": dist[fused_sorted_order],
+        }
+
+    @staticmethod
+    def _localize_edges(src: np.ndarray, dst: np.ndarray,
+                        parts: np.ndarray, local_id: np.ndarray,
+                        ss: Dict[str, np.ndarray], num_parts: int,
+                        n_max: int, b_max: int):
+        """(src_local, dst_local) int64 for a slice of global edges: an
+        inner source maps to its local id, a cross source to its halo
+        slot n_max + (dist-1)*b_max + rank in the owner's send list."""
+        fused_sorted = ss["fused_sorted"]
+        dst_local = local_id[dst].astype(np.int64)
+        src_inner = parts[src] == parts[dst]
+        edge_fused = src.astype(np.int64) * num_parts + parts[dst]
+        loc = np.searchsorted(fused_sorted, edge_fused)
+        # (only valid where cross; guard indices)
+        loc = np.clip(loc, 0, max(fused_sorted.size - 1, 0))
+        if fused_sorted.size:
+            halo_rank = ss["rank_by_pair"][loc]
+            halo_dist = ss["dist_by_pair"][loc]
+        else:
+            halo_rank = np.zeros_like(edge_fused)
+            halo_dist = np.ones_like(edge_fused)
+        src_local = np.where(
+            src_inner,
+            local_id[src],
+            n_max + (halo_dist - 1) * b_max + halo_rank,
+        ).astype(np.int64)
+        return src_local, dst_local
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -182,75 +288,18 @@ class ShardedGraph:
         pair_fused = np.unique(
             cs.astype(np.int64) * num_parts + parts[cd]
         )  # sorted by (node, dest part), same order as the row unique
-        p_node = pair_fused // num_parts
-        p_dest = (pair_fused % num_parts).astype(np.int32)
-        p_owner = parts[p_node]
-        # sort by (owner, dest, local id) -> grouped send lists in order
-        skey = _stable_argsort(
-            (p_owner.astype(np.int64) * num_parts + p_dest) * n
-            + local_id[p_node]
-        )
-        p_node, p_dest, p_owner = p_node[skey], p_dest[skey], p_owner[skey]
-
-        # group starts for each (owner, dest) combination
-        combo = p_owner.astype(np.int64) * num_parts + p_dest
-        send_counts = np.bincount(
-            combo, minlength=num_parts * num_parts
-        ).reshape(num_parts, num_parts)
-        assert np.all(np.diag(send_counts) == 0)
-        b_max = _round_up(int(send_counts.max()), pad_to) if num_parts > 1 else 0
-
-        combo_starts = np.zeros(num_parts * num_parts + 1, dtype=np.int64)
-        np.cumsum(send_counts.reshape(-1), out=combo_starts[1:])
-        rank_in_group = np.arange(p_node.shape[0]) - combo_starts[combo]
-
-        # send_idx[r, d-1, k] = local id of k-th node r sends to (r+d)%P
-        # (empty index arrays make these assignments no-ops, so the exact
-        # shape works for P == 1 and b_max == 0 too)
-        send_idx = np.zeros((num_parts, num_parts - 1, b_max), dtype=np.int32)
-        send_mask = np.zeros_like(send_idx, dtype=bool)
-        dist = (p_dest - p_owner) % num_parts  # ring distance in 1..P-1
-        send_idx[p_owner, dist - 1, rank_in_group] = local_id[p_node].astype(
-            np.int32
-        )
-        send_mask[p_owner, dist - 1, rank_in_group] = True
-
-        # ---- halo slot lookup for cross-edge sources ------------------
-        # For an edge (u, v) on device r=part(v): slot index of u is
-        # n_max + (dist-1)*b_max + rank of (u, r) in u's-owner send list.
-        # Build a lookup from pair -> rank via a dict-free merge: the pair
-        # array is sorted by (owner, dest, local id); edges can be matched
-        # with searchsorted over a fused key.
-        # pair_fused is already sorted by (node, dest) and p_* are its
-        # skey-permutation, so the sorted key array IS pair_fused and the
-        # sort order is skey's inverse — no third large sort needed
-        fused_sorted = pair_fused
-        fused_sorted_order = np.empty_like(skey)
-        fused_sorted_order[skey] = np.arange(skey.size)
+        ss = ShardedGraph._send_structures(pair_fused, parts, local_id,
+                                           num_parts, n, pad_to)
+        send_counts, b_max = ss["send_counts"], ss["b_max"]
+        send_idx, send_mask = ss["send_idx"], ss["send_mask"]
 
         # ---- per-device edges ----------------------------------------
         edge_owner = parts[g.dst]  # device that owns each edge
         e_sizes = np.bincount(edge_owner, minlength=num_parts)
         e_max = _round_up(int(e_sizes.max()), 128)
 
-        dst_local_all = local_id[g.dst].astype(np.int64)
-        src_inner = parts[g.src] == parts[g.dst]
-        # inner source -> local id; halo source -> slot
-        edge_fused = g.src.astype(np.int64) * num_parts + parts[g.dst]
-        loc = np.searchsorted(fused_sorted, edge_fused)
-        # (only valid where cross; guard indices)
-        loc = np.clip(loc, 0, max(fused_sorted.size - 1, 0))
-        if fused_sorted.size:
-            halo_rank = rank_in_group[fused_sorted_order][loc]
-            halo_dist = dist[fused_sorted_order][loc]
-        else:
-            halo_rank = np.zeros_like(edge_fused)
-            halo_dist = np.ones_like(edge_fused)
-        src_local_all = np.where(
-            src_inner,
-            local_id[g.src],
-            n_max + (halo_dist - 1) * b_max + halo_rank,
-        ).astype(np.int64)
+        src_local_all, dst_local_all = ShardedGraph._localize_edges(
+            g.src, g.dst, parts, local_id, ss, num_parts, n_max, b_max)
 
         # scatter edges into per-device padded arrays, sorted by local dst
         # within each device (CSR order — lets kernels rely on contiguous
@@ -267,15 +316,41 @@ class ShardedGraph:
         edge_src[edge_owner[e_order], pos_in_dev] = src_local_all[e_order]
         edge_dst[edge_owner[e_order], pos_in_dev] = dst_local_all[e_order]
 
-        # ---- per-device node data ------------------------------------
+        return ShardedGraph._assemble(
+            g, parts, local_id, num_parts, n_max, b_max, e_max,
+            e_sizes, inner_count, train_count, send_counts,
+            edge_src, edge_dst, send_idx, send_mask,
+        )
+
+    @staticmethod
+    def _assemble(g, parts, local_id, num_parts, n_max, b_max, e_max,
+                  e_sizes, inner_count, train_count, send_counts,
+                  edge_src, edge_dst, send_idx, send_mask,
+                  node_chunk: Optional[int] = None) -> "ShardedGraph":
+        """Per-device node-data scatter + dataclass construction — shared
+        tail of build() and build_chunked(). `node_chunk` streams the
+        feature scatter in row slices so a memmapped g.ndata['feat'] is
+        never materialized whole."""
+        n = g.num_nodes
+        train_mask = np.asarray(g.ndata["train_mask"])
+
         def scatter_nodes(x: np.ndarray, fill) -> np.ndarray:
             shape = (num_parts, n_max) + x.shape[1:]
             out = np.full(shape, fill, dtype=x.dtype)
             out[parts, local_id] = x
             return out
 
-        feat = scatter_nodes(g.ndata["feat"].astype(np.float32), 0.0)
-        label_arr = g.ndata["label"]
+        fsrc = g.ndata["feat"]
+        if node_chunk:
+            feat = np.zeros((num_parts, n_max) + fsrc.shape[1:],
+                            np.float32)
+            for i0 in range(0, n, node_chunk):
+                sl = slice(i0, min(i0 + node_chunk, n))
+                feat[parts[sl], local_id[sl]] = \
+                    np.asarray(fsrc[sl], dtype=np.float32)
+        else:
+            feat = scatter_nodes(np.asarray(fsrc, np.float32), 0.0)
+        label_arr = np.asarray(g.ndata["label"])
         multilabel = label_arr.ndim == 2
         if multilabel:
             label = scatter_nodes(label_arr.astype(np.float32), 0.0)
@@ -285,10 +360,12 @@ class ShardedGraph:
             n_class = int(label_arr.max()) + 1
         tm = scatter_nodes(train_mask.astype(bool), False)
         vm = scatter_nodes(
-            g.ndata.get("val_mask", np.zeros(n, bool)).astype(bool), False
+            np.asarray(g.ndata.get("val_mask", np.zeros(n, bool)),
+                       bool), False
         )
         sm = scatter_nodes(
-            g.ndata.get("test_mask", np.zeros(n, bool)).astype(bool), False
+            np.asarray(g.ndata.get("test_mask", np.zeros(n, bool)),
+                       bool), False
         )
         # degrees of the graph being partitioned (reference utils.py:142);
         # finalize()/node_subgraph keep ndata['in_deg'] consistent with the
@@ -296,7 +373,7 @@ class ShardedGraph:
         deg = g.ndata.get("in_deg")
         if deg is None:
             deg = g.in_degrees()
-        in_deg = scatter_nodes(deg.astype(np.float32), 1.0)
+        in_deg = scatter_nodes(np.asarray(deg, np.float32), 1.0)
         in_deg[in_deg == 0] = 1.0
         gnid = scatter_nodes(np.arange(n, dtype=np.int64), -1)
 
@@ -329,6 +406,116 @@ class ShardedGraph:
             in_deg=in_deg,
             global_nid=gnid,
             source_edge_checksum=ShardedGraph.edge_checksum(g),
+        )
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def build_chunked(
+        g: Graph,
+        parts: np.ndarray,
+        n_parts: Optional[int] = None,
+        pad_to: int = 8,
+        cluster: Optional[np.ndarray] = None,
+        edge_chunk: int = _EDGE_CHUNK,
+        node_chunk: int = 1 << 20,
+    ) -> "ShardedGraph":
+        """RAM-bounded build for papers100M-class graphs: bit-identical
+        output to build(), with every O(E) pass chunked.
+
+        build() materializes ~7 per-edge int64 scratch arrays at once
+        (~180 GB at papers100M's 3.2B post-mirror edges — the regime the
+        reference handles with a >=120 GB-RAM host, reference
+        README.md:29-30); here the peak transient is O(edge_chunk) for
+        the edge passes + one per-device argsort (E/P), so the resident
+        set is dominated by the artifact itself. g.src/g.dst/g.ndata may
+        be memmaps — every access is sliced.
+
+        Equality with build() holds exactly: chunks preserve arrival
+        order per device, and the final per-device stable dst sort
+        reproduces build()'s global stable (owner, dst) order.
+        """
+        n = g.num_nodes
+        parts = parts.astype(np.int32)
+        num_parts = int(n_parts) if n_parts is not None \
+            else int(parts.max()) + 1
+        if num_parts < int(parts.max()) + 1:
+            raise ValueError(
+                f"n_parts={num_parts} smaller than max partition id "
+                f"{int(parts.max())}"
+            )
+        train_mask = np.asarray(g.ndata["train_mask"])
+
+        # ---- local ids (O(N), same as build) --------------------------
+        sort_keys = [np.arange(n), ~train_mask, parts]
+        if cluster is not None:
+            sort_keys.insert(1, cluster.astype(np.int64))
+        order = np.lexsort(tuple(sort_keys))
+        part_sizes = np.bincount(parts, minlength=num_parts)
+        part_starts = np.zeros(num_parts + 1, dtype=np.int64)
+        np.cumsum(part_sizes, out=part_starts[1:])
+        local_id = np.empty(n, dtype=np.int64)
+        local_id[order] = np.arange(n) - part_starts[parts[order]]
+        inner_count = part_sizes.astype(np.int32)
+        train_count = np.bincount(
+            parts[train_mask], minlength=num_parts
+        ).astype(np.int32)
+        n_max = _round_up(int(part_sizes.max()), pad_to)
+
+        # ---- pass 1 (chunked): owner counts + cross-pair uniques ------
+        E = g.num_edges
+        e_sizes = np.zeros(num_parts, np.int64)
+        pair_chunks = []
+        for i0 in range(0, E, edge_chunk):
+            sl = slice(i0, min(i0 + edge_chunk, E))
+            s = np.asarray(g.src[sl])
+            d = np.asarray(g.dst[sl])
+            pd = parts[d]
+            e_sizes += np.bincount(pd, minlength=num_parts)
+            cross = parts[s] != pd
+            pair_chunks.append(np.unique(
+                s[cross].astype(np.int64) * num_parts + pd[cross]))
+        pair_fused = np.unique(np.concatenate(pair_chunks)) \
+            if pair_chunks else np.zeros(0, np.int64)
+        ss = ShardedGraph._send_structures(pair_fused, parts, local_id,
+                                           num_parts, n, pad_to)
+        send_counts, b_max = ss["send_counts"], ss["b_max"]
+        e_max = _round_up(int(e_sizes.max()), 128)
+
+        # ---- pass 2 (chunked): localize + scatter in arrival order ----
+        edge_src = np.zeros((num_parts, e_max), dtype=np.int32)
+        edge_dst = np.full((num_parts, e_max), n_max, dtype=np.int32)
+        cursor = np.zeros(num_parts, np.int64)
+        for i0 in range(0, E, edge_chunk):
+            sl = slice(i0, min(i0 + edge_chunk, E))
+            s = np.asarray(g.src[sl])
+            d = np.asarray(g.dst[sl])
+            src_l, dst_l = ShardedGraph._localize_edges(
+                s, d, parts, local_id, ss, num_parts, n_max, b_max)
+            owner = parts[d]
+            o = _stable_argsort(owner.astype(np.int64))
+            ow = owner[o]
+            cnt = np.bincount(ow, minlength=num_parts)
+            starts = np.zeros(num_parts + 1, np.int64)
+            np.cumsum(cnt, out=starts[1:])
+            pos = cursor[ow] + (np.arange(ow.size) - starts[ow])
+            edge_src[ow, pos] = src_l[o]
+            edge_dst[ow, pos] = dst_l[o]
+            cursor += cnt
+
+        # ---- per-device CSR sort (stable by local dst) ----------------
+        for r in range(num_parts):
+            e_r = int(e_sizes[r])
+            if not e_r:
+                continue
+            o = _stable_argsort(edge_dst[r, :e_r].astype(np.int64))
+            edge_src[r, :e_r] = edge_src[r, :e_r][o]
+            edge_dst[r, :e_r] = edge_dst[r, :e_r][o]
+
+        return ShardedGraph._assemble(
+            g, parts, local_id, num_parts, n_max, b_max, e_max,
+            e_sizes, inner_count, train_count, send_counts,
+            edge_src, edge_dst, ss["send_idx"], ss["send_mask"],
+            node_chunk=node_chunk,
         )
 
     # ------------------------------------------------------------------

@@ -208,7 +208,7 @@ def test_load_ogb_missing_arrays_raises(tmp_path):
     raw.mkdir(parents=True)
     (tmp_path / "ogbn_products" / "split" / "sales_ranking").mkdir(
         parents=True)
-    with pytest.raises(FileNotFoundError, match="edge"):
+    with pytest.raises(FileNotFoundError, match="missing"):
         load_data("ogbn-products", str(tmp_path))
 
 
@@ -261,3 +261,42 @@ def test_yelp_overlapping_roles_rejected(yelp_root):
         json.dump(role, f)
     with pytest.raises(AssertionError):
         load_data("yelp", yelp_root)
+
+
+@pytest.mark.parametrize("products_root", ["npy"], indirect=True)
+def test_load_ogb_mmap_matches_plain_products(products_root):
+    """The RAM-bounded finalized-edge cache path must load a graph
+    equivalent to the in-RAM path: same edge multiset (checksum), same
+    degrees, same node data — with memmapped src/dst/feat."""
+    from pipegcn_tpu.graph.datasets import load_ogb
+    from pipegcn_tpu.partition.halo import ShardedGraph
+
+    ref = load_ogb("ogbn-products", products_root, mmap=False)
+    mm = load_ogb("ogbn-products", products_root, mmap=True)
+    assert isinstance(mm.src, np.memmap)
+    assert isinstance(mm.ndata["feat"], np.memmap)
+    assert mm.num_nodes == ref.num_nodes
+    assert mm.num_edges == ref.num_edges
+    assert ShardedGraph.edge_checksum(mm) == ShardedGraph.edge_checksum(ref)
+    np.testing.assert_array_equal(mm.ndata["in_deg"], ref.ndata["in_deg"])
+    np.testing.assert_array_equal(np.asarray(mm.ndata["feat"]),
+                                  ref.ndata["feat"])
+    np.testing.assert_array_equal(mm.ndata["label"], ref.ndata["label"])
+    # second load hits the ready cache (meta.json short-circuit)
+    mm2 = load_ogb("ogbn-products", products_root, mmap=True)
+    assert mm2.num_edges == mm.num_edges
+
+
+def test_load_ogb_mmap_matches_plain_papers(papers_root):
+    from pipegcn_tpu.graph.datasets import load_ogb
+    from pipegcn_tpu.partition.halo import ShardedGraph
+
+    # load_data lowercases before dispatching to load_ogb
+    ref = load_ogb("ogbn-papers100m", papers_root, mmap=False)
+    mm = load_ogb("ogbn-papers100m", papers_root, mmap=True)
+    assert isinstance(mm.src, np.memmap)
+    assert mm.ndata["feat"].dtype == np.float32
+    assert ShardedGraph.edge_checksum(mm) == ShardedGraph.edge_checksum(ref)
+    np.testing.assert_array_equal(mm.ndata["in_deg"], ref.ndata["in_deg"])
+    np.testing.assert_allclose(np.asarray(mm.ndata["feat"]),
+                               ref.ndata["feat"])
