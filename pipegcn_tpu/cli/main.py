@@ -274,7 +274,13 @@ def run(args) -> dict:
     if args.eval and fit_res["best_params"] is not None:
         os.makedirs(args.model_dir, exist_ok=True)
         model_path = os.path.join(args.model_dir, f"{graph_name}_final.npz")
-        save_pytree(model_path, fit_res["best_params"])
+        # multi-host: identical replicated params on every process;
+        # process 0 writes (save_pytree's pid-temp makes a stray
+        # concurrent writer harmless, but N copies are pure waste)
+        import jax
+
+        if jax.process_index() == 0:
+            save_pytree(model_path, fit_res["best_params"])
         print("model saved")
         print("Validation accuracy {:.2%}".format(fit_res["best_val"]))
         print("Test Result | Accuracy {:.2%}".format(fit_res["test_acc"]))

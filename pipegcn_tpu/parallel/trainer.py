@@ -945,7 +945,12 @@ class Trainer:
                                    f"{np.mean(durs or [dur]):.4f} | Loss "
                                    f"{loss:.4f}")
 
-                if checkpoint_dir and (epoch + 1) % checkpoint_every == 0:
+                if checkpoint_dir and (epoch + 1) % checkpoint_every == 0 \
+                        and jax.process_index() == 0:
+                    # multi-host: every process holds identical state
+                    # (SPMD + replicated params); only process 0 writes
+                    # (reference semantics, and N-1 fewer multi-GB
+                    # writes to the shared filesystem)
                     save_checkpoint(checkpoint_dir,
                                     jax.device_get(self.state), epoch + 1)
                 epoch += 1
@@ -959,7 +964,7 @@ class Trainer:
             # dispatch, device_get below raises and the save is
             # skipped — the previous periodic checkpoint survives
             # (saves are atomic).
-            if checkpoint_dir:
+            if checkpoint_dir and jax.process_index() == 0:
                 try:
                     done = int(getattr(self, "last_epoch",
                                        start_epoch))

@@ -54,9 +54,12 @@ def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
             arrays[key] = arr
     # temp + atomic rename: an interrupted save (disk full, SIGTERM,
     # crash-handler save racing a second failure) must never destroy
-    # the previous good checkpoint at `path`
+    # the previous good checkpoint at `path`. The pid in the temp name
+    # keeps multi-host SPMD processes — which all save the same state
+    # to the same shared-filesystem path — from renaming each other's
+    # half-written temp away (observed as FileNotFoundError on rank 1).
     # (np.savez appends ".npz" unless the name already ends with it)
-    tmp = path + ".tmp.npz"
+    tmp = f"{path}.{os.getpid()}.tmp.npz"
     try:
         np.savez_compressed(tmp, **arrays)
         os.replace(tmp, path)
@@ -116,8 +119,26 @@ def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int) -> None:
     crash between writes can never pair a new state with an old epoch
     number — which would double-step the optimizer on resume."""
     os.makedirs(directory, exist_ok=True)
+    _sweep_stale_tmps(directory)
     save_pytree(os.path.join(directory, "state.npz"), state,
                 extra={"__epoch__": np.asarray(epoch, np.int64)})
+
+
+def _sweep_stale_tmps(directory: str, min_age_s: float = 3600.0) -> None:
+    """Remove orphaned pid-named *.tmp.npz left by a hard kill
+    mid-save. Age-gated so a live peer process's in-flight temp (the
+    multi-host concurrent-save case the pid naming exists for) is never
+    touched."""
+    import glob
+    import time
+
+    now = time.time()
+    for tmp in glob.glob(os.path.join(directory, "*.tmp.npz")):
+        try:
+            if now - os.path.getmtime(tmp) > min_age_s:
+                os.remove(tmp)
+        except OSError:
+            pass
 
 
 def load_checkpoint(directory: str, template: Dict[str, Any]):

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""GAT epoch time at Reddit scale — attention-bucket kernel vs raw.
+
+The GAT family used to run only on the raw-edge segment path (the
+19.8 s/epoch-class regime, docs/PERF_NOTES.md); this measures the
+scatter-free attention-bucket kernel (ops/gat_bucket.py) on the real
+chip against the SAGE headline. Reuses the bench partition artifact
+(and its cached tables after the first run).
+
+Timing forces a device->host scalar read per dispatch (through the
+axon tunnel block_until_ready does not synchronize); dispatches are
+sized under the tunnel's observed ~80 s execute-crash threshold.
+
+Usage: python scripts/gat_bench.py [--part partitions/bench-reddit-1-c2]
+       [--impl bucket|xla] [--epochs 4] [--heads 4]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--part", default="partitions/bench-reddit-1-c2")
+    ap.add_argument("--impl", default="bucket",
+                    choices=["bucket", "xla"])
+    ap.add_argument("--epochs", type=int, default=4,
+                    help="timed fused-epoch block length")
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import Trainer, TrainConfig
+    from pipegcn_tpu.partition import ShardedGraph
+
+    sg = ShardedGraph.load(args.part)
+    cfg = ModelConfig(
+        # 3 graph layers like the SAGE headline (no use_pp for GAT)
+        layer_sizes=(sg.n_feat, args.hidden, args.hidden, args.hidden,
+                     sg.n_class),
+        model="gat", n_heads=args.heads, norm="layer", dropout=0.5,
+        train_size=sg.n_train_global, spmm_impl=args.impl,
+        spmm_chunk=2_097_152, dtype="bfloat16",
+    )
+    tcfg = TrainConfig(lr=0.01, n_epochs=args.epochs * (args.reps + 2),
+                       enable_pipeline=True, eval=False,
+                       fused_epochs=args.epochs)
+    t0 = time.time()
+    tr = Trainer(sg, cfg, tcfg)
+    print(f"# trainer init (tables) {time.time()-t0:.0f}s",
+          file=sys.stderr)
+
+    # train_epochs dispatches one fused scan of args.epochs epochs
+    # (train_epoch would run ONE epoch and make the division below 4x
+    # optimistic)
+    t0 = time.time()
+    losses = tr.train_epochs(0, args.epochs)
+    print(f"# first block (compile) {time.time()-t0:.0f}s "
+          f"loss={float(losses[-1]):.4f}", file=sys.stderr)
+
+    times = []
+    for r in range(args.reps):
+        start = (r + 1) * args.epochs
+        t0 = time.time()
+        losses = tr.train_epochs(start, args.epochs)
+        dt = time.time() - t0
+        times.append(dt / args.epochs)
+        print(f"# block {r}: {dt:.2f}s -> {dt/args.epochs:.3f} s/epoch "
+              f"loss={float(losses[-1]):.4f}", file=sys.stderr)
+    import json
+
+    print(json.dumps({
+        "metric": f"gat_{args.impl}_epoch_time",
+        "value": round(min(times), 4),
+        "unit": "s/epoch",
+        "heads": args.heads,
+        "hidden": args.hidden,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

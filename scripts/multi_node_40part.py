@@ -159,17 +159,22 @@ def main() -> None:
     ap.add_argument("--part-dir", default="partitions/multi40")
     args = ap.parse_args()
 
+    def flush(results):
+        # write after every leg: a later-leg failure must not discard
+        # an earlier leg's (expensive) result
+        with open(os.path.join(REPO, "MULTICHIP_40part.json"), "w") as f:
+            json.dump({"runs": results}, f, indent=1)
+
     dataset = f"synthetic:{args.nodes}:{args.degree}:602:41"
     results = [run_single(dataset, args.epochs, args.part_dir)]
     print(json.dumps(results[-1]))
+    flush(results)
     if not args.skip_multihost:
         mh_dataset = f"synthetic:{args.mh_nodes}:{args.degree}:602:41"
         results.append(run_multihost(mh_dataset, args.mh_epochs,
                                      args.part_dir + "-mh"))
         print(json.dumps(results[-1]))
-
-    with open(os.path.join(REPO, "MULTICHIP_40part.json"), "w") as f:
-        json.dump({"runs": results}, f, indent=1)
+    flush(results)
     md = [
         "# 40-partition runs (reddit_multi_node.sh shape)",
         "",

@@ -40,9 +40,24 @@ def main():
     ap.add_argument("--out", default="")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the default (TPU) backend instead of CPU")
+    # graph shape overrides: the default is the small hard-SBM config;
+    # --nodes 232965 runs the Reddit-node-count long-horizon analogue
+    # of the reference's 97.1%-with-pipelining reproduction
+    ap.add_argument("--nodes", type=int, default=6000)
+    ap.add_argument("--degree", type=int, default=5)
+    ap.add_argument("--feat", type=int, default=6)
+    ap.add_argument("--classes", type=int, default=12)
+    ap.add_argument("--homophily", type=float, default=0.45)
+    ap.add_argument("--train-frac", type=float, default=0.03)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--fused", type=int, default=25)
+    ap.add_argument("--name", default="",
+                    help="output suffix, e.g. 'reddit_scale'")
     args = ap.parse_args()
     if not args.out:
         suffix = "" if args.model == "graphsage" else f"_{args.model}"
+        if args.name:
+            suffix += f"_{args.name}"
         args.out = f"results/staleness_parity{suffix}.md"
 
     import jax
@@ -57,8 +72,10 @@ def main():
     from pipegcn_tpu.parallel import Trainer, TrainConfig
     from pipegcn_tpu.partition import ShardedGraph, partition_graph
 
-    g = synthetic_graph(num_nodes=6000, avg_degree=5, n_feat=6, n_class=12,
-                        homophily=0.45, train_frac=0.03, val_frac=0.2,
+    g = synthetic_graph(num_nodes=args.nodes, avg_degree=args.degree,
+                        n_feat=args.feat, n_class=args.classes,
+                        homophily=args.homophily,
+                        train_frac=args.train_frac, val_frac=0.2,
                         seed=0)
     parts = partition_graph(g, args.parts, seed=0)
     sg = ShardedGraph.build(g, parts, n_parts=args.parts)
@@ -75,12 +92,14 @@ def main():
     for name, kw in variants.items():
         for seed in range(1, args.seeds + 1):
             cfg = ModelConfig(
-                layer_sizes=(sg.n_feat, 64, 64, sg.n_class), norm="layer",
+                layer_sizes=(sg.n_feat, args.hidden, args.hidden,
+                             sg.n_class), norm="layer",
                 dropout=0.3, train_size=sg.n_train_global,
                 model=args.model,
             )
             tcfg = TrainConfig(seed=seed, lr=3e-3, n_epochs=args.epochs,
-                               log_every=25, fused_epochs=25, **kw)
+                               log_every=25, fused_epochs=args.fused,
+                               **kw)
             t = Trainer(sg, cfg, tcfg)
             res = t.fit(eval_graphs, log_fn=lambda *_: None,
                         sharded_eval=True)
@@ -92,10 +111,12 @@ def main():
     lines = [
         f"# Staleness accuracy parity (hard synthetic, {args.model})",
         "",
-        "SBM graph: 6000 nodes, avg degree 5, 6 feats, 12 classes, "
-        "homophily 0.45, 3% train labels;",
-        f"{args.model} 3x64, dropout 0.3, lr 3e-3, {args.epochs} epochs, "
-        f"{args.parts} partitions, {args.seeds} seeds.",
+        f"SBM graph: {args.nodes} nodes, avg degree {args.degree}, "
+        f"{args.feat} feats, {args.classes} classes, homophily "
+        f"{args.homophily}, {args.train_frac:.0%} train labels;",
+        f"{args.model} 3x{args.hidden}, dropout 0.3, lr 3e-3, "
+        f"{args.epochs} epochs, {args.parts} partitions, "
+        f"{args.seeds} seeds.",
         "",
         "| variant | best val (mean ± std) | test @ best val (mean ± std) |",
         "|---|---|---|",

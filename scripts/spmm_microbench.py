@@ -26,6 +26,9 @@ def main():
     ap.add_argument("--width", type=int, default=256)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--block-nnz", type=int, default=0)
+    ap.add_argument("--probe-traffic", action="store_true",
+                    help="table-surgery decomposition of the dense "
+                         "term: F-tile reads vs A reads vs MXU")
     args = ap.parse_args()
 
     import jax
@@ -83,10 +86,13 @@ def main():
     aux = lambda k: not (is_dense(k) or is_rem(k))
     inv_only = lambda k: k.endswith("inv") or k.endswith("ginv")
 
+    # ONE dense-keep predicate: the --probe-traffic deltas below are
+    # only meaningful against the exact same program as this baseline
+    dense_keep = lambda k: aux(k) or is_dense(k) \
+        or (is_rem(k) and inv_only(k))
+
     full = variant("full", lambda k: True)
-    dense = variant("dense-only",
-                    lambda k: aux(k) or is_dense(k)
-                    or (is_rem(k) and inv_only(k)))
+    dense = variant("dense-only", dense_keep)
     rem = variant("rem-only",
                   lambda k: aux(k) or is_rem(k)
                   or (is_dense(k) and (inv_only(k) or k in
@@ -96,6 +102,35 @@ def main():
           f"dense fwd {dense[0]*1e3:.1f}, rem fwd {rem[0]*1e3:.1f}")
     est_epoch = 3 * full[1]
     print(f"# est SpMM-only epoch: {est_epoch:.3f}s")
+
+    if args.probe_traffic:
+        # Attribute the dense-only time between F-tile reads, A reads
+        # and the MXU term by TABLE SURGERY: identical program shapes,
+        # but every group entry points at tile/block 0, collapsing that
+        # operand's distinct HBM traffic to one tile. (Numerics are
+        # wrong on purpose; only time matters.) The F-tile delta decides
+        # whether the union-gather reuse design (docs/PERF_NOTES.md
+        # "F-tile reuse headroom") is worth building.
+        def surgery(name, zero_suffix):
+            saved = {}
+            for k in list(d.keys()):
+                if k.startswith("blk_fwd_g") or k.startswith("blk_bwd_g"):
+                    if k.endswith(zero_suffix) and not k.endswith("ginv"):
+                        saved[k] = d[k]
+                        d[k] = jnp.zeros_like(d[k])
+            try:
+                return variant(name, dense_keep)
+            finally:
+                d.update(saved)
+
+        tile0 = surgery("tile0-dense", "t")   # all F-tile reads -> tile 0
+        a0 = surgery("a0-dense", "b")         # all A reads -> block 0
+        print("# dense decomposition (fwd): "
+              f"baseline {dense[0]*1e3:.1f} ms, "
+              f"F-tile-collapsed {tile0[0]*1e3:.1f} ms "
+              f"(F-read share {(dense[0]-tile0[0])*1e3:.1f} ms), "
+              f"A-collapsed {a0[0]*1e3:.1f} ms "
+              f"(A-read share {(dense[0]-a0[0])*1e3:.1f} ms)")
 
 
 if __name__ == "__main__":
