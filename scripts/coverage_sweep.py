@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Structural dense-coverage sweep: cluster granularity x nnz threshold.
+
+The block kernel's epoch splits between the dense MXU term and the
+slabbed remainder; VERDICT round 2 asks for remainder < 50% of the
+epoch. Which (locality cluster target_size, block_nnz) maximizes the
+edges captured in budget-capped dense tiles is a purely STRUCTURAL
+question — this sweep answers it host-side so scarce TPU windows only
+measure the top candidates.
+
+For each cluster granularity it rebuilds the single-part Reddit-scale
+layout (local ids sorted by cluster), then reports, per nnz threshold:
+budget-capped dense coverage, dense block count, remainder edges, and
+the v5e cost model's epoch projection (docs/PERF_NOTES.md rates).
+
+Writes results/coverage_sweep.md.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def model_epoch(dense_edges, rem_edges, dense_blocks, tile, width=256):
+    """v5e-calibrated epoch model (docs/PERF_NOTES.md): 6 SpMMs of
+    dense A+F-tile reads + MXU, remainder at the slab-gather rate,
+    with the x1.5-ladder pad factor ~1.25 applied to the remainder."""
+    GATHER_RPS, HBM_BPS, MXU = 390e6, 819e9, 0.5 * 197e12
+    isz = 2  # bf16
+    t_dense = dense_blocks * 6 * (
+        (tile * width * isz + tile * tile / 8) / HBM_BPS
+        + 2 * tile * tile * width / MXU)
+    n_slabs = max(1, (width * isz) // 256)
+    t_rem = rem_edges * 1.25 * n_slabs * 6 / GATHER_RPS
+    return t_dense + t_rem, t_dense, t_rem
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="synthetic-reddit")
+    ap.add_argument("--tile", type=int, default=256)
+    ap.add_argument("--cluster-sizes", type=int, nargs="+",
+                    default=[4096, 1024, 512])
+    ap.add_argument("--nnz", type=int, nargs="+",
+                    default=[0, 64, 108, 160])
+    ap.add_argument("--out", default="results/coverage_sweep.md")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pipegcn_tpu.graph import load_data
+    from pipegcn_tpu.ops.block_spmm import (DENSE_A_BYTE_BUDGET,
+                                            _part_block_stats,
+                                            budget_block_cap)
+    from pipegcn_tpu.partition import ShardedGraph, locality_clusters
+    from pipegcn_tpu.partition.partitioner import partition_graph
+
+    g = load_data(args.dataset)
+    parts = partition_graph(g, 1, seed=0)
+    tile = args.tile
+    cap = budget_block_cap(DENSE_A_BYTE_BUDGET, tile)
+
+    rows = []
+    for tsize in args.cluster_sizes:
+        t0 = time.time()
+        cluster = locality_clusters(g, target_size=tsize, seed=0)
+        sg = ShardedGraph.build(g, parts, n_parts=1, cluster=cluster)
+        n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
+        build_s = time.time() - t0
+        for thr0 in args.nnz:
+            thr = thr0 or max(1, (tile * tile) // 602)
+            cov, n_dense, dense_e, tot_e = _part_block_stats(
+                sg, 0, tile, n_src_tiles, thr, max_blocks=cap)
+            rem_e = tot_e - dense_e
+            t_ep, t_d, t_r = model_epoch(dense_e, rem_e, n_dense, tile)
+            rows.append((tsize, thr, cov, n_dense, rem_e, t_ep, t_d, t_r,
+                         build_s))
+            print(f"tsize={tsize} thr={thr}: cov={cov:.3f} "
+                  f"blocks={n_dense} rem={rem_e/1e6:.1f}M "
+                  f"model={t_ep:.3f}s (dense {t_d:.3f} rem {t_r:.3f})",
+                  file=sys.stderr)
+
+    lines = [
+        "# Dense-coverage structural sweep (tile=%d, budget-capped)"
+        % tile,
+        "",
+        f"Dataset {args.dataset}; 1 partition; budget cap {cap} "
+        "bit-packed blocks. Cost model rates from docs/PERF_NOTES.md "
+        "(projection only — TPU measurement picks among the top rows).",
+        "",
+        "| cluster target | nnz thr | coverage | dense blocks "
+        "| remainder edges | model epoch (s) | dense (s) | rem (s) |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for (tsize, thr, cov, n_dense, rem_e, t_ep, t_d, t_r, _) in rows:
+        lines.append(
+            f"| {tsize} | {thr} | {cov:.3f} | {n_dense} "
+            f"| {rem_e/1e6:.1f}M | {t_ep:.3f} | {t_d:.3f} | {t_r:.3f} |")
+    best = min(rows, key=lambda r: r[5])
+    lines += ["",
+              f"Model-best: cluster target {best[0]}, thr {best[1]} -> "
+              f"{best[5]:.3f} s/epoch projected (remainder share "
+              f"{best[7]/best[5]:.0%})."]
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("\n".join(lines[-3:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,12 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# per-epoch eval lines: "Epoch N | Validation Accuracy X% | ..." in
+# transductive reference format, "Epoch N | Accuracy X%" in inductive
+# reference format (trainer.py _harvest_eval); "Test Accuracy" must
+# not match
+_ACC_RE = r"\| (?:Validation )?Accuracy ([0-9.]+)%"
+
 # reddit_multi_node.sh flags, minus dataset size and node layout
 MODEL_FLAGS = [
     "--dropout", "0.5", "--lr", "0.01", "--model", "graphsage",
@@ -66,8 +72,7 @@ def run_single(dataset: str, epochs: int, part_dir: str) -> dict:
     if r.returncode != 0:
         print(out[-4000:], file=sys.stderr)
         raise SystemExit(f"single-process 40-part run failed rc={r.returncode}")
-    accs = [float(m) for m in re.findall(
-        r"Validation Accuracy ([0-9.]+)%", out)]
+    accs = [float(m) for m in re.findall(_ACC_RE, out)]
     test = re.search(r"Test Result \| Accuracy ([0-9.]+)%", out)
     times = [float(m) for m in re.findall(r"Time\(s\) ([0-9.]+)", out)]
     return {
@@ -84,6 +89,20 @@ def run_single(dataset: str, epochs: int, part_dir: str) -> dict:
 
 
 def run_multihost(dataset: str, epochs: int, part_dir: str) -> dict:
+    import shutil
+
+    # always partition fresh: with a pre-cached artifact all 4 ranks
+    # (time-sharing one core) reach their first collective execute in
+    # near-lockstep after minutes of serialized compile, and the gloo
+    # context rendezvous (hard 30s, not configurable from jax) times
+    # out; the rank-0-partitions / peers-poll stagger of a cold start
+    # reliably spreads the arrivals (and exercises the real multi-node
+    # first-run path, reference main.py:32-40)
+    # resolve against REPO like the child processes (cwd=REPO) do —
+    # an invoker-cwd-relative rmtree would miss the real artifact
+    part_dir = part_dir if os.path.isabs(part_dir) \
+        else os.path.join(REPO, part_dir)
+    shutil.rmtree(part_dir, ignore_errors=True)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -112,7 +131,14 @@ def run_multihost(dataset: str, epochs: int, part_dir: str) -> dict:
              "--master-addr", "127.0.0.1", "--port", str(port),
              "--n-epochs", str(epochs), "--partition-dir", part_dir,
              *MODEL_FLAGS,
-             "--log-every", str(max(1, epochs // 2))],
+             # no eval: with 4 processes time-sharing ONE core, the
+             # evaluator's separately-compiled program gives each rank
+             # a different arrival time at its first gloo collective
+             # and the 30s context-init rendezvous (not configurable
+             # from jax) times out. The TRAINING collectives are fine —
+             # every rank compiles the same step program back-to-back.
+             # Cross-rank agreement is asserted on the loss instead.
+             "--no-eval"],
             stdout=log, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO))
     outs = []
@@ -128,12 +154,14 @@ def run_multihost(dataset: str, epochs: int, part_dir: str) -> dict:
             print(out[-4000:], file=sys.stderr)
             raise SystemExit(f"multihost rank {rank} failed "
                              f"rc={p.returncode}")
-    accs = [float(m) for m in re.findall(
-        r"Validation Accuracy ([0-9.]+)%", outs[0])]
-    # every process must report the same final accuracy (one SPMD job)
-    finals = {re.findall(r"Validation Accuracy ([0-9.]+)%", o)[-1]
-              for o in outs if "Validation Accuracy" in o}
-    assert len(finals) == 1, f"ranks disagree: {finals}"
+    # every process must report identical losses (one SPMD job); the
+    # reference log line prints every 10 epochs under --fix-seed, so
+    # epochs must be >= 10 (enforced in main())
+    losses = [re.findall(r"Loss ([0-9.]+)", o) for o in outs]
+    missing = [r for r, ls in enumerate(losses) if not ls]
+    assert not missing, f"ranks {missing} logged no Loss lines"
+    finals = {ls[-1] for ls in losses}
+    assert len(finals) == 1, f"ranks disagree on final loss: {finals}"
     return {
         "mode": "multihost-4x10",
         "devices": 40,
@@ -141,8 +169,8 @@ def run_multihost(dataset: str, epochs: int, part_dir: str) -> dict:
         "dataset": dataset,
         "epochs": epochs,
         "wall_s": round(wall, 1),
-        "val_acc_first": accs[0] if accs else None,
-        "val_acc_last": accs[-1] if accs else None,
+        "loss_first": float(losses[0][0]),
+        "loss_last": float(losses[0][-1]),
     }
 
 
@@ -152,29 +180,47 @@ def main() -> None:
                     help="synthetic node count (40 shards of nodes/40)")
     ap.add_argument("--degree", type=int, default=16)
     ap.add_argument("--epochs", type=int, default=10)
-    ap.add_argument("--mh-nodes", type=int, default=6000,
+    ap.add_argument("--mh-nodes", type=int, default=3000,
                     help="node count for the 4-process multihost leg")
-    ap.add_argument("--mh-epochs", type=int, default=4)
+    ap.add_argument("--mh-epochs", type=int, default=10,
+                    help="must be >= 10: the multihost leg asserts on "
+                         "the reference loss line, printed every 10 "
+                         "epochs")
     ap.add_argument("--skip-multihost", action="store_true")
+    ap.add_argument("--skip-single", action="store_true",
+                    help="keep the single-process result already in "
+                         "MULTICHIP_40part.json, run only multihost")
     ap.add_argument("--part-dir", default="partitions/multi40")
     args = ap.parse_args()
+    if not args.skip_multihost and args.mh_epochs < 10:
+        ap.error("--mh-epochs must be >= 10 (loss line cadence)")
 
-    def flush(results):
-        # write after every leg: a later-leg failure must not discard
-        # an earlier leg's (expensive) result
-        with open(os.path.join(REPO, "MULTICHIP_40part.json"), "w") as f:
-            json.dump({"runs": results}, f, indent=1)
+    # merge-by-mode against the existing file so a --skip-* rerun of
+    # one leg never discards the other leg's (expensive) result
+    by_mode = {}
+    json_path = os.path.join(REPO, "MULTICHIP_40part.json")
+    if os.path.exists(json_path):
+        with open(json_path) as f:
+            by_mode = {r["mode"]: r for r in json.load(f)["runs"]}
+
+    def flush():
+        with open(json_path, "w") as f:
+            json.dump({"runs": list(by_mode.values())}, f, indent=1)
 
     dataset = f"synthetic:{args.nodes}:{args.degree}:602:41"
-    results = [run_single(dataset, args.epochs, args.part_dir)]
-    print(json.dumps(results[-1]))
-    flush(results)
+    if not args.skip_single:
+        r = run_single(dataset, args.epochs, args.part_dir)
+        by_mode[r["mode"]] = r
+        print(json.dumps(r))
+        flush()
     if not args.skip_multihost:
         mh_dataset = f"synthetic:{args.mh_nodes}:{args.degree}:602:41"
-        results.append(run_multihost(mh_dataset, args.mh_epochs,
-                                     args.part_dir + "-mh"))
-        print(json.dumps(results[-1]))
-    flush(results)
+        r = run_multihost(mh_dataset, args.mh_epochs,
+                          args.part_dir + "-mh")
+        by_mode[r["mode"]] = r
+        print(json.dumps(r))
+        flush()
+    results = list(by_mode.values())
     md = [
         "# 40-partition runs (reddit_multi_node.sh shape)",
         "",
@@ -184,14 +230,20 @@ def main() -> None:
         "Reddit-like graph at reduced node count (1-core CPU host;",
         "the SPMD program/collective structure is size-independent).",
         "",
-        "| mode | devices | graph | epochs | wall (s) | val acc first -> last |",
+        "| mode | devices | graph | epochs | wall (s) | progress |",
         "|---|---|---|---|---|---|",
     ]
     for r in results:
+        if r.get("test_acc") is not None:
+            prog = f"test acc {r['test_acc']}%"
+        elif r.get("loss_last") is not None:
+            prog = (f"loss {r['loss_first']} -> {r['loss_last']} "
+                    "(all 4 ranks identical)")
+        else:
+            prog = f"{r.get('val_acc_first')}% -> {r.get('val_acc_last')}%"
         md.append(
             f"| {r['mode']} | {r['devices']} | {r['dataset']} "
-            f"| {r['epochs']} | {r['wall_s']} "
-            f"| {r['val_acc_first']}% -> {r['val_acc_last']}% |")
+            f"| {r['epochs']} | {r['wall_s']} | {prog} |")
     md.append("")
     with open(os.path.join(REPO, "results", "multi_node_40part.md"),
               "w") as f:
