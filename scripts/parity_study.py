@@ -143,10 +143,10 @@ def main():
         )
     else:
         verdict = (
-            f"on this deliberately extreme config (3% labels, low "
-            f"homophily) staleness costs ~{spread:.3f} accuracy beyond "
-            f"seed noise (max std {noise:.3f}) for this model family; "
-            f"the EMA corrections recover part of it."
+            f"on this config ({args.train_frac:.0%} labels, homophily "
+            f"{args.homophily}) staleness costs ~{spread:.3f} accuracy "
+            f"beyond seed noise (max std {noise:.3f}) for this model "
+            f"family; the EMA corrections recover part of it."
         )
     lines += [
         "",
