@@ -183,6 +183,9 @@ def main():
                     choices=["xla", "pallas", "bucket", "block", "auto"])
     ap.add_argument("--block-tile", type=int, default=256,
                     help="dense-tile edge for the block kernel")
+    ap.add_argument("--cluster-size", type=int, default=4096,
+                    help="locality-cluster target size for the local "
+                         "renumbering (results/coverage_sweep.md)")
     ap.add_argument("--block-nnz", type=int, default=0,
                     help="dense threshold override (0 = break-even)")
     ap.add_argument("--sweep-spmm", action="store_true",
@@ -265,8 +268,15 @@ def main():
     # "-c" suffix: artifacts with cluster-reordered local ids (the same
     # format; a different, locality-aware numbering). "2": generator
     # revision (simple graph — duplicate sampled pairs deduped, matching
-    # the real Reddit's multiplicity-1 adjacency).
-    part_path = os.path.join("partitions", name + "-c2")
+    # the real Reddit's multiplicity-1 adjacency). Non-default cluster
+    # granularity gets its own suffix (results/coverage_sweep.md: 1024
+    # projects ~20% fewer epoch-seconds than the 4096 default via
+    # fewer, denser tiles).
+    from pipegcn_tpu.partition.partitioner import cluster_suffix
+
+    suf = cluster_suffix(args.cluster_size)
+    part_path = os.path.join("partitions",
+                             name + "-c2" + (f"-{suf}" if suf else ""))
     t0 = time.perf_counter()
     if ShardedGraph.exists(part_path):
         sg = ShardedGraph.load(part_path)
@@ -277,7 +287,8 @@ def main():
 
         g = load_data(dataset)
         parts = partition_graph(g, n_parts, method="metis", obj="vol", seed=0)
-        cluster = locality_clusters(g, seed=0)
+        cluster = locality_clusters(g, target_size=args.cluster_size,
+                                    seed=0)
         sg = ShardedGraph.build(g, parts, n_parts=n_parts, cluster=cluster)
         sg.save(part_path)
         sg.cache_dir = part_path  # cache derived kernel tables too

@@ -77,8 +77,13 @@ def prepare(args):
     # cluster-reordered layout and a plain one are both valid but not
     # interchangeable (--skip-partition must never silently reuse the
     # other kind), so the ordering choice gets its own cache key suffix
-    part_name = graph_name + ("-c" if args.local_reorder == "cluster"
-                              else "")
+    # non-default cluster granularity changes the layout, so it gets its
+    # own artifact identity (like the "-c" ordering suffix itself)
+    from ..partition.partitioner import cluster_suffix
+
+    csuf = "-c" + cluster_suffix(args.cluster_size) \
+        if args.local_reorder == "cluster" else ""
+    part_name = graph_name + csuf
     part_path = os.path.join(args.partition_dir, part_name)
 
     g = None
@@ -124,7 +129,8 @@ def prepare(args):
             )
             cluster = None
             if args.local_reorder == "cluster":
-                cluster = locality_clusters(pg, seed=seed)
+                cluster = locality_clusters(
+                    pg, target_size=args.cluster_size, seed=seed)
             # papers100M-class edge lists: the RAM-bounded chunked build
             # (bit-identical output) keeps the O(E) int64 scratch of the
             # plain build from crowding host memory

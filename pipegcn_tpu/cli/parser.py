@@ -134,6 +134,14 @@ def create_parser() -> argparse.ArgumentParser:
                              "the shard adjacency forms dense tiles "
                              "(feeds --spmm-impl block); 'none' keeps "
                              "global-id order")
+    from ..partition.partitioner import DEFAULT_CLUSTER_SIZE
+
+    parser.add_argument("--cluster-size", "--cluster_size", type=int,
+                        default=DEFAULT_CLUSTER_SIZE,
+                        help="locality-cluster target size for "
+                             "--local-reorder cluster; finer clusters "
+                             "(e.g. 1024) concentrate edges into fewer, "
+                             "denser tiles (results/coverage_sweep.md)")
     parser.add_argument("--dtype", choices=["float32", "bfloat16"],
                         default="float32",
                         help="compute dtype for activations/halo exchange "

@@ -33,6 +33,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# STATUS: experimental (README "TPU-native extensions"). The premise —
+# per-shard fbuf fitting VMEM at high P — lacks a measured winning
+# regime: halo rows GROW with P on real partitions (an 8-way METIS
+# Reddit split carries 2.2-5.5M halo rows/device,
+# results/multichip_projection.md), and out-of-budget shards compile
+# heavily-spilled programs (one crashed the tunneled TPU worker).
+# `auto` only selects this kernel when sharded_applicable() passes;
+# bucket/block are the production paths.
+
 ROW_BLOCK = 8           # dst rows per grid step (fp32 sublane tile)
 VMEM_BUDGET = 12 << 20  # conservative fbuf budget (bytes) of ~16MB VMEM
 

@@ -119,7 +119,7 @@ class ShardedGraph:
         # rewired-graph case the checksum must detect. Chunked so the
         # uint64 temporaries stay bounded at papers100M scale (the sum
         # is order-free, so chunking cannot change the result).
-        total = np.uint64(0)
+        total = 0
         nn = np.uint64(g.num_nodes)
         for i0 in range(0, g.num_edges, _EDGE_CHUNK):
             sl = slice(i0, min(i0 + _EDGE_CHUNK, g.num_edges))
@@ -128,8 +128,10 @@ class ShardedGraph:
             x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
             x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
             x ^= x >> np.uint64(31)
-            total += x.sum(dtype=np.uint64)
-        return int(total)
+            # explicit mod-2^64 accumulation (a np.uint64 scalar add
+            # wraps identically but emits RuntimeWarning per chunk)
+            total = (total + int(x.sum(dtype=np.uint64))) & ((1 << 64) - 1)
+        return total
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -99,9 +99,23 @@ def partition_graph(
     return parts
 
 
+# default locality-cluster granularity; artifact cache keys derive
+# from it via cluster_suffix so every consumer shares ONE definition
+# of "which layout is this"
+DEFAULT_CLUSTER_SIZE = 4096
+
+
+def cluster_suffix(target_size: int) -> str:
+    """Artifact-name fragment identifying a non-default cluster
+    layout ('' at the default): a changed default must change cache
+    identity everywhere or stale-layout tables would be reused."""
+    return "" if target_size == DEFAULT_CLUSTER_SIZE \
+        else f"s{target_size}"
+
+
 def locality_clusters(
     g: Graph,
-    target_size: int = 4096,
+    target_size: int = DEFAULT_CLUSTER_SIZE,
     seed: int = 0,
 ) -> np.ndarray:
     """Cluster labels for locality-aware LOCAL renumbering.
