@@ -183,9 +183,13 @@ def main():
                     choices=["xla", "pallas", "bucket", "block", "auto"])
     ap.add_argument("--block-tile", type=int, default=256,
                     help="dense-tile edge for the block kernel")
-    ap.add_argument("--cluster-size", type=int, default=4096,
+    from pipegcn_tpu.partition.partitioner import DEFAULT_CLUSTER_SIZE
+
+    ap.add_argument("--cluster-size", type=int,
+                    default=DEFAULT_CLUSTER_SIZE,
                     help="locality-cluster target size for the local "
-                         "renumbering (results/coverage_sweep.md)")
+                         "renumbering (docs/PERF_NOTES.md round-3 "
+                         "addendum: measured sweep)")
     ap.add_argument("--block-nnz", type=int, default=0,
                     help="dense threshold override (0 = break-even)")
     ap.add_argument("--sweep-spmm", action="store_true",
@@ -268,15 +272,13 @@ def main():
     # "-c" suffix: artifacts with cluster-reordered local ids (the same
     # format; a different, locality-aware numbering). "2": generator
     # revision (simple graph — duplicate sampled pairs deduped, matching
-    # the real Reddit's multiplicity-1 adjacency). Non-default cluster
-    # granularity gets its own suffix (results/coverage_sweep.md: 1024
-    # projects ~20% fewer epoch-seconds than the 4096 default via
-    # fewer, denser tiles).
+    # the real Reddit's multiplicity-1 adjacency). The cluster
+    # granularity is part of the artifact identity (cluster_suffix
+    # always encodes it; measured sweep in docs/PERF_NOTES.md).
     from pipegcn_tpu.partition.partitioner import cluster_suffix
 
     suf = cluster_suffix(args.cluster_size)
-    part_path = os.path.join("partitions",
-                             name + "-c2" + (f"-{suf}" if suf else ""))
+    part_path = os.path.join("partitions", f"{name}-c2-{suf}")
     t0 = time.perf_counter()
     if ShardedGraph.exists(part_path):
         sg = ShardedGraph.load(part_path)

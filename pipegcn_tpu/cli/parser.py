@@ -140,8 +140,8 @@ def create_parser() -> argparse.ArgumentParser:
                         default=DEFAULT_CLUSTER_SIZE,
                         help="locality-cluster target size for "
                              "--local-reorder cluster; finer clusters "
-                             "(e.g. 1024) concentrate edges into fewer, "
-                             "denser tiles (results/coverage_sweep.md)")
+                             "(the 1024 default) concentrate edges into "
+                             "fewer, denser tiles (docs/PERF_NOTES.md)")
     parser.add_argument("--dtype", choices=["float32", "bfloat16"],
                         default="float32",
                         help="compute dtype for activations/halo exchange "
