@@ -11,7 +11,7 @@ Timing forces a device->host scalar read per dispatch (through the
 axon tunnel block_until_ready does not synchronize); dispatches are
 sized under the tunnel's observed ~80 s execute-crash threshold.
 
-Usage: python scripts/gat_bench.py [--part partitions/bench-reddit-1-c2]
+Usage: python scripts/gat_bench.py [--part partitions/bench-reddit-1-c2-s1024]
        [--impl bucket|xla] [--epochs 4] [--heads 4]
 """
 
@@ -28,7 +28,8 @@ sys.path.insert(0, REPO)
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--part", default="partitions/bench-reddit-1-c2")
+    ap.add_argument("--part",
+                    default="partitions/bench-reddit-1-c2-s1024")
     ap.add_argument("--impl", default="bucket",
                     choices=["bucket", "xla"])
     ap.add_argument("--epochs", type=int, default=4,

@@ -14,15 +14,20 @@ Usage: python scripts/spmm_microbench.py [--part partitions/...]
 """
 
 import argparse
+import os
 import sys
 import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--part", default="partitions/bench-reddit-1-c2")
+    ap.add_argument("--part",
+                    default="partitions/bench-reddit-1-c2-s1024")
     ap.add_argument("--width", type=int, default=256)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--block-nnz", type=int, default=0)

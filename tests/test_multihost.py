@@ -138,18 +138,19 @@ def test_prepare_process0_partitions_and_saves(tmp_path, monkeypatch):
     sg, eval_graphs = prepare(args)
     assert sg.num_parts == 2
     assert eval_graphs is None  # --no-eval
-    # artifact saved for the peers to pick up ("-c": the default
-    # cluster local-reorder is part of the artifact's cache key)
+    # artifact saved for the peers to pick up ("-cs1024": the default
+    # cluster local-reorder AND its granularity are part of the
+    # artifact's cache key (cluster_suffix is self-describing)
     assert ShardedGraph.exists(
         os.path.join(args.partition_dir,
-                     "synthetic:200:6:8:4-2-metis-vol-trans-c"))
+                     "synthetic:200:6:8:4-2-metis-vol-trans-cs1024"))
 
 
 def test_prepare_nonzero_process_loads_artifact(tmp_path, monkeypatch):
     """A non-zero process must NEVER partition — it polls for process
     0's artifact."""
     art = str(tmp_path / "parts"
-              / "synthetic:200:6:8:4-2-metis-vol-trans-c")
+              / "synthetic:200:6:8:4-2-metis-vol-trans-cs1024")
     _make_artifact(art)
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(jax, "process_index", lambda: 1)
