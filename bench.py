@@ -192,6 +192,9 @@ def main():
                          "addendum: measured sweep)")
     ap.add_argument("--block-nnz", type=int, default=0,
                     help="dense threshold override (0 = break-even)")
+    ap.add_argument("--block-group", type=int, default=1,
+                    help="union-gather group size for the block "
+                         "kernel's dense path (1 = per-tile lists)")
     ap.add_argument("--sweep-spmm", action="store_true",
                     help="also time every SpMM impl and report the winner")
     ap.add_argument("--probe-tries", type=int, default=3)
@@ -323,6 +326,7 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         spmm_impl=args.spmm_impl,
         block_tile=args.block_tile,
         block_nnz=args.block_nnz or None,
+        block_group=args.block_group,
     )
     blk = max(1, args.fused)
 

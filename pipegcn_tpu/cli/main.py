@@ -222,6 +222,7 @@ def run(args) -> dict:
         spmm_impl=args.spmm_impl,
         block_tile=args.block_tile,
         block_nnz=args.block_nnz or None,
+        block_group=args.block_group,
         dtype=args.dtype,
     )
     tcfg = TrainConfig(

@@ -123,6 +123,12 @@ def create_parser() -> argparse.ArgumentParser:
                         help="minimum edges for a tile pair to go dense "
                              "in the block kernel (0 = read-cost "
                              "break-even)")
+    parser.add_argument("--block-group", "--block_group", type=int,
+                        default=1,
+                        help="union-gather group: that many consecutive "
+                             "dst tiles share one gathered source-tile "
+                             "union in the block kernel's dense path "
+                             "(1 = per-tile block lists)")
     parser.add_argument("--fused-epochs", "--fused_epochs", type=int,
                         default=1,
                         help="epochs per compiled dispatch (lax.scan); "

@@ -70,6 +70,11 @@ class ModelConfig:
     # minimum edges for a (dst, src) tile to go dense; None = the
     # read-cost break-even tile*tile/n_feat (block_spmm.BlockPlan)
     block_nnz: Optional[int] = None
+    # union-gather group size for the block kernel's dense path: that
+    # many CONSECUTIVE dst tiles share one gathered source-tile union
+    # (block_spmm._group_union; measured F-tile dedupe headroom in
+    # docs/PERF_NOTES.md). 1 = per-tile K-class layout
+    block_group: int = 1
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     def __post_init__(self):

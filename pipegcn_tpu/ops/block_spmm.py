@@ -149,6 +149,101 @@ def _group_by_key(keys, vals_a, vals_b, n_groups, widths, pad_a, pad_b):
     return mats, inv.astype(np.int32), counts
 
 
+def _group_union(keys: np.ndarray, others: np.ndarray, n_key_tiles: int,
+                 n_other_tiles: int, group: int, n_blocks_pad: int,
+                 widths: Optional[Sequence[int]] = None):
+    """Union-gather grouping: `group` CONSECUTIVE key tiles share one
+    gathered union of their blocks' other-tiles.
+
+    Consecutive (cluster-ordered) destination tiles reference heavily
+    overlapping source tiles — measured on the clustered Reddit shard,
+    grouping 2/4/8 dst tiles dedupes the dense path's F-tile reads to
+    0.56x/0.33x/0.22x (docs/PERF_NOTES.md). Here each group's union is
+    gathered ONCE and consumed directly by one batched contraction over
+    (union slot, in-tile) — the F-traffic per group drops from
+    sum(K_d) tiles to U = |union| tiles.
+
+    keys/others: [B] key-tile / other-tile id per dense block (key=dst
+    for the forward, key=src for the transpose). Returns
+    (classes, inv, counts, widths):
+      classes[w] = (a_idx [R_w, group, widths[w]] int32 into the padded
+        A tensor (pad -> n_blocks_pad, the zero block),
+        t_mat [R_w, widths[w]] int32 other-tile ids (pad ->
+        n_other_tiles, the zero tile));
+      inv [n_key_tiles] int32 -> r * group + d flat position in the
+        class-concatenated [sum R_w, group] output (key tiles whose
+        whole group has no dense block -> sum(R_w) * group, the zero
+        sentinel row);
+      counts[w] = real rows in class w. Groups are bucketed into
+      x1.5-ladder U-width classes (same padding bound as the bucket
+      kernel's degree ladder)."""
+    B = int(keys.shape[0])
+    n_groups_max = -(-n_key_tiles // group)
+    if B == 0:
+        widths = list(widths) if widths is not None else [1]
+        classes = [(np.full((0, group, w), n_blocks_pad, np.int32),
+                    np.full((0, w), n_other_tiles, np.int32))
+                   for w in widths]
+        inv = np.zeros(n_key_tiles, np.int32)
+        return classes, inv, [0] * len(widths), widths
+    gid = keys // group
+    order = np.lexsort((others, gid))
+    g_o, o_o = gid[order], others[order]
+    blk_o = np.arange(B, dtype=np.int64)[order]
+    d_o = (keys[order] % group).astype(np.int64)
+    ug, gcnt = np.unique(g_o, return_counts=True)
+    grow = np.repeat(np.arange(ug.shape[0]), gcnt)  # block -> group row
+    # union slot of each block within its group: blocks are sorted by
+    # (group, other), so a block starts a new union slot iff its
+    # (group, other) differs from the previous block's
+    new_flag = np.ones(B, bool)
+    new_flag[1:] = (g_o[1:] != g_o[:-1]) | (o_o[1:] != o_o[:-1])
+    slot = np.cumsum(new_flag) - 1
+    gstart = np.zeros(ug.shape[0], np.int64)
+    gstart[1:] = np.cumsum(gcnt)[:-1]
+    first = slot[gstart]
+    u_idx = slot - first[grow]
+    u_of_group = np.add.reduceat(new_flag, gstart).astype(np.int64)
+
+    if widths is None:
+        widths = _bucket_widths(int(u_of_group.max(initial=1)))
+    widths = list(widths)
+    widths_arr = np.asarray(widths, dtype=np.int64)
+    max_u = int(u_of_group.max(initial=0))
+    if max_u > widths[-1]:
+        raise ValueError(
+            f"union-width ladder {tuple(widths)} tops out below the max "
+            f"group union size {max_u}; blocks would be dropped")
+    wid = np.minimum(np.searchsorted(widths_arr, np.maximum(u_of_group, 1)),
+                     len(widths) - 1)
+
+    classes, counts = [], []
+    concat_row = np.full(n_groups_max, -1, np.int64)
+    offset = 0
+    for w_i, w in enumerate(widths):
+        gsel = np.nonzero(wid == w_i)[0]
+        n_w = int(gsel.shape[0])
+        a_idx = np.full((n_w, group, w), n_blocks_pad, np.int32)
+        t_mat = np.full((n_w, w), n_other_tiles, np.int32)
+        if n_w:
+            cls_row = np.full(ug.shape[0], -1, np.int64)
+            cls_row[gsel] = np.arange(n_w)
+            bsel = cls_row[grow] >= 0
+            r = cls_row[grow[bsel]]
+            a_idx[r, d_o[bsel], u_idx[bsel]] = blk_o[bsel]
+            nf = bsel & new_flag
+            t_mat[cls_row[grow[nf]], u_idx[nf]] = o_o[nf]
+            concat_row[ug[gsel]] = offset + cls_row[gsel]
+        classes.append((a_idx, t_mat))
+        counts.append(n_w)
+        offset += n_w
+    key_tiles = np.arange(n_key_tiles, dtype=np.int64)
+    gr = concat_row[key_tiles // group]
+    inv = np.where(gr >= 0, gr * group + key_tiles % group,
+                   offset * group)
+    return classes, inv.astype(np.int32), counts, widths
+
+
 def estimate_block_coverage(sg, tile: int, n_feat_hint: int,
                             nnz_threshold: Optional[int] = None,
                             byte_budget: Optional[int] = DENSE_A_BYTE_BUDGET,
@@ -247,9 +342,11 @@ class BlockPlan:
                  bwd_widths: Optional[Sequence[int]] = None,
                  fwd_k_widths: Optional[Sequence[int]] = None,
                  bwd_k_widths: Optional[Sequence[int]] = None,
-                 max_blocks: Optional[int] = None):
+                 max_blocks: Optional[int] = None,
+                 group: int = 1):
         T = S = tile
         self.tile = tile
+        self.group = max(1, int(group))
         real = edge_dst < n_out
         src = edge_src[real].astype(np.int64)
         dst = edge_dst[real].astype(np.int64)
@@ -314,18 +411,32 @@ class BlockPlan:
         bs = (dense_ids % n_src_tiles).astype(np.int64)
 
         blk_idx = np.arange(B, dtype=np.int64)
-        self.fwd_k_widths = list(
-            fwd_k_widths if fwd_k_widths is not None
-            else _bucket_widths(_max_group_count(bd, n_dst_tiles)))
-        self.bwd_k_widths = list(
-            bwd_k_widths if bwd_k_widths is not None
-            else _bucket_widths(_max_group_count(bs, n_src_tiles)))
-        self.fwd_groups, self.fwd_ginv, self.fwd_gcounts = _group_by_key(
-            bd, blk_idx, bs, n_dst_tiles, self.fwd_k_widths,
-            pad_a=B, pad_b=n_src_tiles)
-        self.bwd_groups, self.bwd_ginv, self.bwd_gcounts = _group_by_key(
-            bs, blk_idx, bd, n_src_tiles, self.bwd_k_widths,
-            pad_a=B, pad_b=n_dst_tiles)
+        if self.group > 1:
+            # union-gather layout: `group` consecutive key tiles share
+            # one gathered union of other-tiles (see _group_union)
+            (self.fwd_u_classes, self.fwd_u_inv, self.fwd_u_counts,
+             self.fwd_k_widths) = _group_union(
+                bd, bs, n_dst_tiles, n_src_tiles, self.group, B,
+                widths=fwd_k_widths)
+            (self.bwd_u_classes, self.bwd_u_inv, self.bwd_u_counts,
+             self.bwd_k_widths) = _group_union(
+                bs, bd, n_src_tiles, n_dst_tiles, self.group, B,
+                widths=bwd_k_widths)
+        else:
+            self.fwd_k_widths = list(
+                fwd_k_widths if fwd_k_widths is not None
+                else _bucket_widths(_max_group_count(bd, n_dst_tiles)))
+            self.bwd_k_widths = list(
+                bwd_k_widths if bwd_k_widths is not None
+                else _bucket_widths(_max_group_count(bs, n_src_tiles)))
+            self.fwd_groups, self.fwd_ginv, self.fwd_gcounts = \
+                _group_by_key(bd, blk_idx, bs, n_dst_tiles,
+                              self.fwd_k_widths, pad_a=B,
+                              pad_b=n_src_tiles)
+            self.bwd_groups, self.bwd_ginv, self.bwd_gcounts = \
+                _group_by_key(bs, blk_idx, bd, n_src_tiles,
+                              self.bwd_k_widths, pad_a=B,
+                              pad_b=n_dst_tiles)
 
         # ---- sparse remainder (bucket tables both directions) ----
         r_src, r_dst = src_o[~in_dense_o], dst_o[~in_dense_o]
@@ -415,6 +526,68 @@ def _dense_apply(a_pad, groups, ginv, tiles, T, out_rows, n_feat,
     return res.reshape(-1, n_feat)[:out_rows]
 
 
+def _dense_apply_grouped(a_pad, classes, inv, tiles, T, out_rows,
+                         n_feat, compute_dtype, transpose=False,
+                         packed=False):
+    """Union-gather dense apply: for every group of `group` consecutive
+    output tiles, gather the union of the group's source tiles ONCE
+    ([R, U, S, F]) and consume it directly in one batched contraction
+    against the group's gathered A blocks ([R, group, U, T, S]) — the
+    per-tile F-traffic dedupe _group_union documents.
+
+    classes: [(a_idx [R, group, U_w], t_mat [R, U_w])] per U-width
+    class; inv restores output-tile order from the class-concatenated
+    [sum R_w * group] flat tile axis. Forward contracts (u, s) -> out
+    [R, group, T, F]; transpose contracts (u, t) -> [R, group, S, F]
+    (the backward's per-source-tile sum of A^T @ g)."""
+    spec = "rduts,rutf->rdsf" if transpose else "rduts,rusf->rdtf"
+    s = a_pad.shape[-1] * 8 if packed else a_pad.shape[-1]
+    pad_blk = a_pad.shape[0] - 1
+
+    def compute(ai, ti):  # [R, group, U] + [R, U] -> [R, group, T|S, F]
+        blks = jnp.take(a_pad, ai, axis=0)        # [R, G, U, T, S(/8)]
+        blks = _unpack_bits(blks, s, compute_dtype) if packed \
+            else blks.astype(compute_dtype)
+        tls = jnp.take(tiles, ti, axis=0)         # [R, U, S|T, F]
+        return jnp.einsum(spec, blks, tls,
+                          preferred_element_type=jnp.float32)
+
+    outs = []
+    out_tile = T  # square tiles: the output's in-tile dim is T either way
+    for ai, ti in classes:
+        n_w, g, u = ai.shape
+        if n_w == 0:
+            continue
+        # bound both per-chunk transients: unpacked A [R, G, U, T, S]
+        # and the gathered union tiles [R, U, S, F] (F can exceed
+        # G*T on wide input layers)
+        rows_per_chunk = max(
+            1, _DENSE_CHUNK_ELEMS // max(1, g * u * T * s,
+                                         u * s * n_feat))
+        if n_w <= rows_per_chunk:
+            out = compute(ai, ti)
+        else:
+            n_chunks = -(-n_w // rows_per_chunk)
+            pad_rows = n_chunks * rows_per_chunk - n_w
+            ai_p = jnp.pad(ai, ((0, pad_rows), (0, 0), (0, 0)),
+                           constant_values=pad_blk)
+            ti_p = jnp.pad(ti, ((0, pad_rows), (0, 0)),
+                           constant_values=tiles.shape[0] - 1)
+
+            def body(_, idx):
+                return None, compute(*idx)
+
+            _, chunks = jax.lax.scan(
+                body, None,
+                (ai_p.reshape(n_chunks, rows_per_chunk, g, u),
+                 ti_p.reshape(n_chunks, rows_per_chunk, u)))
+            out = chunks.reshape(-1, g, out_tile, n_feat)[:n_w]
+        outs.append(out.reshape(-1, out_tile, n_feat))  # [R*G, T|S, F]
+    outs.append(jnp.zeros((1, out_tile, n_feat), jnp.float32))
+    res = jnp.take(jnp.concatenate(outs, axis=0), inv, axis=0)
+    return res.reshape(-1, n_feat)[:out_rows]
+
+
 def make_block_spmm_fn(
     plan_arrays: Dict[str, jax.Array],
     in_deg: jax.Array,
@@ -446,6 +619,13 @@ def make_block_spmm_fn(
                      and k.endswith("b"))
         return [(d[k + "b"], d[k + "t"]) for k in bs_]
 
+    def union_classes(direction):  # [(a_idx, t_mat)] in U-width order
+        bs_ = sorted(k[:-1] for k in d
+                     if k.startswith(f"blk_{direction}u_g")
+                     and k.endswith("a"))
+        return [(d[k + "a"], d[k + "t"]) for k in bs_]
+
+    grouped = "blk_fwdu_inv" in d
     packed = "blk_a_bits" in d
 
     def a_padded():
@@ -460,9 +640,16 @@ def make_block_spmm_fn(
     def f(fbuf):
         n_s_tiles = -(-n_src_rows // T)
         tiles = tiles_of(fbuf, n_s_tiles, T)
-        dense = _dense_apply(a_padded(), dense_groups("fwd"),
-                             d["blk_fwd_ginv"], tiles, T, n_out,
-                             fbuf.shape[-1], fbuf.dtype, packed=packed)
+        if grouped:
+            dense = _dense_apply_grouped(
+                a_padded(), union_classes("fwd"), d["blk_fwdu_inv"],
+                tiles, T, n_out, fbuf.shape[-1], fbuf.dtype,
+                packed=packed)
+        else:
+            dense = _dense_apply(a_padded(), dense_groups("fwd"),
+                                 d["blk_fwd_ginv"], tiles, T, n_out,
+                                 fbuf.shape[-1], fbuf.dtype,
+                                 packed=packed)
         rem = bucket_aggregate(fbuf, rem_mats("blkrem_fwd_"),
                                d["blkrem_fwd_inv"],
                                chunk_edges=chunk_edges)
@@ -476,10 +663,16 @@ def make_block_spmm_fn(
         # transpose dense: per source tile, sum A^T @ g_tile
         n_d_tiles = -(-n_out // T)
         g_tiles = tiles_of(gd, n_d_tiles, T)
-        dense = _dense_apply(a_padded(), dense_groups("bwd"),
-                             d["blk_bwd_ginv"], g_tiles, T, n_src_rows,
-                             g.shape[-1], gd.dtype, transpose=True,
-                             packed=packed)
+        if grouped:
+            dense = _dense_apply_grouped(
+                a_padded(), union_classes("bwd"), d["blk_bwdu_inv"],
+                g_tiles, T, n_src_rows, g.shape[-1], gd.dtype,
+                transpose=True, packed=packed)
+        else:
+            dense = _dense_apply(a_padded(), dense_groups("bwd"),
+                                 d["blk_bwd_ginv"], g_tiles, T,
+                                 n_src_rows, g.shape[-1], gd.dtype,
+                                 transpose=True, packed=packed)
         rem = bucket_aggregate(gd, rem_mats("blkrem_bwd_"),
                                d["blkrem_bwd_inv"],
                                chunk_edges=chunk_edges)
@@ -493,17 +686,27 @@ def plan_to_arrays(p: BlockPlan) -> Dict[str, np.ndarray]:
     """Flatten a BlockPlan into the array dict make_block_spmm_fn uses."""
     arrs = {
         "blk_a": p.a_blocks,
-        "blk_fwd_ginv": p.fwd_ginv,
-        "blk_bwd_ginv": p.bwd_ginv,
         "blkrem_fwd_inv": p.rem_fwd_inv,
         "blkrem_bwd_inv": p.rem_bwd_inv,
     }
-    for direction, groups in (("fwd", p.fwd_groups),
-                              ("bwd", p.bwd_groups)):
-        for w_i, (a_mat, b_mat) in enumerate(groups):
-            if a_mat.shape[0]:
-                arrs[f"blk_{direction}_g{w_i:02d}b"] = a_mat
-                arrs[f"blk_{direction}_g{w_i:02d}t"] = b_mat
+    if p.group > 1:
+        arrs["blk_fwdu_inv"] = p.fwd_u_inv
+        arrs["blk_bwdu_inv"] = p.bwd_u_inv
+        for direction, classes in (("fwd", p.fwd_u_classes),
+                                   ("bwd", p.bwd_u_classes)):
+            for w_i, (a_idx, t_mat) in enumerate(classes):
+                if a_idx.shape[0]:
+                    arrs[f"blk_{direction}u_g{w_i:02d}a"] = a_idx
+                    arrs[f"blk_{direction}u_g{w_i:02d}t"] = t_mat
+    else:
+        arrs["blk_fwd_ginv"] = p.fwd_ginv
+        arrs["blk_bwd_ginv"] = p.bwd_ginv
+        for direction, groups in (("fwd", p.fwd_groups),
+                                  ("bwd", p.bwd_groups)):
+            for w_i, (a_mat, b_mat) in enumerate(groups):
+                if a_mat.shape[0]:
+                    arrs[f"blk_{direction}_g{w_i:02d}b"] = a_mat
+                    arrs[f"blk_{direction}_g{w_i:02d}t"] = b_mat
     for b, m in enumerate(p.rem_fwd_mats):
         if m.shape[0]:
             arrs[f"blkrem_fwd_{b:02d}"] = m
@@ -517,6 +720,7 @@ def build_sharded_block_tables(sg, tile: int = 256,
                                n_feat_hint: int = 256,
                                byte_budget: int = DENSE_A_BYTE_BUDGET,
                                nnz_threshold: Optional[int] = None,
+                               group: int = 1,
                                ) -> Tuple[Dict[str, np.ndarray], int]:
     """Stacked per-device hybrid plans (leading device axis), padded to
     shared shapes: same B (dense block count), same K (per-tile block
@@ -552,7 +756,8 @@ def build_sharded_block_tables(sg, tile: int = 256,
                       n_src_rows, n_feat_hint, tile=tile,
                       nnz_threshold=nnz_threshold,
                       fwd_widths=fw, bwd_widths=bw,
-                      fwd_k_widths=fk, bwd_k_widths=bk, max_blocks=cap)
+                      fwd_k_widths=fk, bwd_k_widths=bk, max_blocks=cap,
+                      group=group)
             for r in range(P)
         ]
 
@@ -605,8 +810,17 @@ def build_sharded_block_tables(sg, tile: int = 256,
                 for b in range(fw_len)]
     bwd_caps = [max(p.rem_bwd_counts[b] for p in plans)
                 for b in range(bw_len)]
-    fk_caps = [max(p.fwd_gcounts[w] for p in plans) for w in range(fk_len)]
-    bk_caps = [max(p.bwd_gcounts[w] for p in plans) for w in range(bk_len)]
+
+    def dense_counts(p, direction):
+        if group > 1:
+            return (p.fwd_u_counts if direction == "fwd"
+                    else p.bwd_u_counts)
+        return p.fwd_gcounts if direction == "fwd" else p.bwd_gcounts
+
+    fk_caps = [max(dense_counts(p, "fwd")[w] for p in plans)
+               for w in range(fk_len)]
+    bk_caps = [max(dense_counts(p, "bwd")[w] for p in plans)
+               for w in range(bk_len)]
 
     def reoffset_inv(inv, counts, caps):
         inv = inv.astype(np.int64)
@@ -629,30 +843,58 @@ def build_sharded_block_tables(sg, tile: int = 256,
             ("blk_a_bits" if emit_bits == 1 else "blk_a"):
                 pack_a_blocks(a_pad) if emit_bits == 1
                 else a_pad.astype(a_dtype),
-            "blk_fwd_ginv": reoffset_inv(p.fwd_ginv, p.fwd_gcounts,
-                                         fk_caps),
-            "blk_bwd_ginv": reoffset_inv(p.bwd_ginv, p.bwd_gcounts,
-                                         bk_caps),
             "blkrem_fwd_inv": reoffset_inv(p.rem_fwd_inv,
                                            p.rem_fwd_counts, fwd_caps),
             "blkrem_bwd_inv": reoffset_inv(p.rem_bwd_inv,
                                            p.rem_bwd_counts, bwd_caps),
         }
-        for direction, groups, caps in (("fwd", p.fwd_groups, fk_caps),
-                                        ("bwd", p.bwd_groups, bk_caps)):
-            for w_i, (a_mat, b_mat) in enumerate(groups):
-                if not caps[w_i]:
-                    continue
-                # remap this device's pad-block id B to the shared
-                # zero block B_max; pad rows point at it entirely (the
-                # matching tile pad is the zero tile, already shared)
-                a_mat = np.where(a_mat == B, B_max, a_mat)
-                arrs[f"blk_{direction}_g{w_i:02d}b"] = _pad_rows(
-                    a_mat, caps[w_i], B_max).astype(np.int32)
-                arrs[f"blk_{direction}_g{w_i:02d}t"] = _pad_rows(
-                    b_mat, caps[w_i],
-                    p.n_src_tiles if direction == "fwd"
-                    else p.n_dst_tiles).astype(np.int32)
+        if group > 1:
+            # inv entries encode r * group + d; reoffset the row part
+            # to the shared per-class caps (sentinel sum(counts)*G ->
+            # sum(caps)*G falls out of reoffset_inv's default)
+            arrs["blk_fwdu_inv"] = (
+                reoffset_inv(p.fwd_u_inv // group, p.fwd_u_counts,
+                             fk_caps).astype(np.int64) * group
+                + p.fwd_u_inv % group).astype(np.int32)
+            arrs["blk_bwdu_inv"] = (
+                reoffset_inv(p.bwd_u_inv // group, p.bwd_u_counts,
+                             bk_caps).astype(np.int64) * group
+                + p.bwd_u_inv % group).astype(np.int32)
+            for direction, classes, caps in (
+                    ("fwd", p.fwd_u_classes, fk_caps),
+                    ("bwd", p.bwd_u_classes, bk_caps)):
+                for w_i, (a_idx, t_mat) in enumerate(classes):
+                    if not caps[w_i]:
+                        continue
+                    a_idx = np.where(a_idx == B, B_max, a_idx)
+                    arrs[f"blk_{direction}u_g{w_i:02d}a"] = _pad_rows(
+                        a_idx, caps[w_i], B_max).astype(np.int32)
+                    arrs[f"blk_{direction}u_g{w_i:02d}t"] = _pad_rows(
+                        t_mat, caps[w_i],
+                        p.n_src_tiles if direction == "fwd"
+                        else p.n_dst_tiles).astype(np.int32)
+        else:
+            arrs["blk_fwd_ginv"] = reoffset_inv(p.fwd_ginv,
+                                                p.fwd_gcounts, fk_caps)
+            arrs["blk_bwd_ginv"] = reoffset_inv(p.bwd_ginv,
+                                                p.bwd_gcounts, bk_caps)
+            for direction, groups, caps in (
+                    ("fwd", p.fwd_groups, fk_caps),
+                    ("bwd", p.bwd_groups, bk_caps)):
+                for w_i, (a_mat, b_mat) in enumerate(groups):
+                    if not caps[w_i]:
+                        continue
+                    # remap this device's pad-block id B to the shared
+                    # zero block B_max; pad rows point at it entirely
+                    # (the matching tile pad is the zero tile, already
+                    # shared)
+                    a_mat = np.where(a_mat == B, B_max, a_mat)
+                    arrs[f"blk_{direction}_g{w_i:02d}b"] = _pad_rows(
+                        a_mat, caps[w_i], B_max).astype(np.int32)
+                    arrs[f"blk_{direction}_g{w_i:02d}t"] = _pad_rows(
+                        b_mat, caps[w_i],
+                        p.n_src_tiles if direction == "fwd"
+                        else p.n_dst_tiles).astype(np.int32)
         for b in range(fw_len):
             if fwd_caps[b]:
                 arrs[f"blkrem_fwd_{b:02d}"] = _pad_rows(

@@ -270,11 +270,13 @@ class Trainer:
             w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
             tile = self.cfg.block_tile
             nnz = self.cfg.block_nnz
+            grp = self.cfg.block_group
             self._block_tables = self._cached_tables(
-                f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else ""),
+                f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else "")
+                + (f"_u{grp}" if grp > 1 else ""),
                 lambda: build_sharded_block_tables(
                     self.sg, tile=tile, n_feat_hint=w_hint,
-                    nnz_threshold=nnz)[0])
+                    nnz_threshold=nnz, group=grp)[0])
             self._block_tile = tile
 
         def use_large():
