@@ -336,6 +336,30 @@ class Trainer:
 
     # ---------------- data placement ----------------------------------
 
+    @classmethod
+    def prewarm_tables(cls, sg: ShardedGraph, cfg: ModelConfig) -> None:
+        """Build and disk-cache the kernel tables for (sg, cfg) WITHOUT
+        constructing the full trainer — no device uploads, no pp
+        precompute. The scarce-TPU workflow: the O(E) host builds run
+        while the chip is unavailable, so the next real run only loads
+        npz (docs/PERF_NOTES.md tunnel notes)."""
+        if getattr(sg, "cache_dir", None) is None:
+            raise ValueError(
+                "prewarm_tables needs a disk-backed artifact "
+                "(sg.cache_dir unset — load the ShardedGraph from disk "
+                "or set cache_dir); the build would be discarded")
+        cacheable = cfg.spmm_impl in ("bucket", "block") or (
+            cfg.model == "gat" and cfg.spmm_impl in ("auto", "bucket"))
+        if not cacheable:
+            raise ValueError(
+                f"spmm_impl={cfg.spmm_impl!r} does not disk-cache "
+                "tables (only bucket/block — and the gat kernel — do); "
+                "nothing to prewarm")
+        self = cls.__new__(cls)
+        self.sg = sg
+        self.cfg = dataclasses.replace(cfg, sorted_edges=True)
+        self._setup_pallas_spmm()
+
     def _put_data(self, skip_edges: bool = False) -> Dict[str, jax.Array]:
         sg = self.sg
         edge_dummy = np.zeros((self.P, 8), np.int32)

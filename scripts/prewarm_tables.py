@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Pre-build + disk-cache kernel tables for a partition artifact.
+
+Host-side only (no device work): run while the TPU tunnel is down so
+the next bench/microbench on the real chip skips the minutes-long O(E)
+table builds (docs/PERF_NOTES.md tunnel notes). One invocation per
+kernel configuration; the cache key (Trainer._cached_tables) encodes
+(impl, tile, width, nnz, group).
+
+Usage: python scripts/prewarm_tables.py --impl block --group 4
+       [--part partitions/bench-reddit-1-c2-s1024] [--block-nnz N]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--part",
+                    default="partitions/bench-reddit-1-c2-s1024")
+    ap.add_argument("--impl", default="block",
+                    choices=["block", "bucket", "gat"])
+    ap.add_argument("--group", type=int, default=1)
+    ap.add_argument("--block-nnz", type=int, default=0)
+    ap.add_argument("--hidden", type=int, default=256)
+    args = ap.parse_args()
+
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import Trainer
+    from pipegcn_tpu.partition import ShardedGraph
+
+    sg = ShardedGraph.load(args.part)
+    cfg = ModelConfig(
+        model="gat" if args.impl == "gat" else "graphsage",
+        layer_sizes=(sg.n_feat,) + (args.hidden,) * 3 + (sg.n_class,),
+        use_pp=args.impl != "gat", norm="layer",
+        train_size=sg.n_train_global,
+        spmm_impl="bucket" if args.impl == "gat" else args.impl,
+        block_nnz=args.block_nnz or None,
+        block_group=args.group, dtype="bfloat16",
+    )
+    t0 = time.perf_counter()
+    Trainer.prewarm_tables(sg, cfg)
+    print(f"warmed {args.impl} tables (group={args.group}, "
+          f"nnz={args.block_nnz or 'auto'}) "
+          f"in {time.perf_counter() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()
