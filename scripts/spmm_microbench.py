@@ -31,6 +31,9 @@ def main():
     ap.add_argument("--width", type=int, default=256)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--block-nnz", type=int, default=0)
+    ap.add_argument("--group", type=int, default=1,
+                    help="union-gather group size (block_group); the "
+                         "prewarmed u4/u8 table caches make this cheap")
     ap.add_argument("--probe-traffic", action="store_true",
                     help="table-surgery decomposition of the dense "
                          "term: F-tile reads vs A reads vs MXU")
@@ -50,6 +53,7 @@ def main():
         train_size=sg.n_train_global, spmm_chunk=2_097_152,
         dtype="bfloat16", spmm_impl="block",
         block_nnz=args.block_nnz or None,
+        block_group=args.group,
     )
     tr = Trainer(sg, cfg, TrainConfig(lr=0.01, n_epochs=1, eval=False))
     d = {k: v[0] for k, v in tr.data.items()}
@@ -116,10 +120,13 @@ def main():
         # wrong on purpose; only time matters.) The F-tile delta decides
         # whether the union-gather reuse design (docs/PERF_NOTES.md
         # "F-tile reuse headroom") is worth building.
+        prefixes = ("blk_fwd_g", "blk_bwd_g",
+                    "blk_fwdu_g", "blk_bwdu_g")  # per-tile + grouped
+
         def surgery(name, zero_suffix):
             saved = {}
             for k in list(d.keys()):
-                if k.startswith("blk_fwd_g") or k.startswith("blk_bwd_g"):
+                if k.startswith(prefixes):
                     if k.endswith(zero_suffix) and not k.endswith("ginv"):
                         saved[k] = d[k]
                         d[k] = jnp.zeros_like(d[k])
@@ -129,7 +136,9 @@ def main():
                 d.update(saved)
 
         tile0 = surgery("tile0-dense", "t")   # all F-tile reads -> tile 0
-        a0 = surgery("a0-dense", "b")         # all A reads -> block 0
+        # A-index matrices end with "b" in the per-tile layout, "a" in
+        # the grouped one
+        a0 = surgery("a0-dense", "a" if args.group > 1 else "b")
         print("# dense decomposition (fwd): "
               f"baseline {dense[0]*1e3:.1f} ms, "
               f"F-tile-collapsed {tile0[0]*1e3:.1f} ms "

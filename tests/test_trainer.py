@@ -2,6 +2,7 @@
 (the analogue of the reference's localhost-gloo multiprocess testing,
 SURVEY.md §4)."""
 
+import dataclasses
 import numpy as np
 import jax
 import pytest
@@ -231,3 +232,42 @@ def test_fit_with_fused_epochs(graph):
                 log_fn=lambda m: None)
     assert res["best_val"] > 0.75
     assert len(res["history"]) == 2  # evals still at log_every boundaries
+
+
+def test_prewarm_tables_guards_and_caches(tmp_path):
+    """Host-side cache prewarm: refuses configs whose build would be
+    discarded (no disk artifact / non-caching impl), writes the same
+    npz a real Trainer would then load."""
+    import os
+
+    g = synthetic_graph(num_nodes=200, avg_degree=5, n_feat=8, n_class=3,
+                        seed=0)
+    sg = ShardedGraph.build(g, partition_graph(g, 2, seed=0), n_parts=2)
+    cfg = ModelConfig(layer_sizes=(8, 16, 3), train_size=sg.n_train_global,
+                      spmm_impl="bucket")
+    with pytest.raises(ValueError, match="cache_dir"):
+        Trainer.prewarm_tables(sg, cfg)  # in-memory artifact
+
+    path = str(tmp_path / "art")
+    sg.save(path)
+    sg2 = ShardedGraph.load(path)
+    with pytest.raises(ValueError, match="prewarm"):
+        Trainer.prewarm_tables(
+            sg2, dataclasses.replace(cfg, spmm_impl="xla"))
+
+    Trainer.prewarm_tables(sg2, cfg)
+    assert os.path.exists(os.path.join(path, "bucket_tables.npz"))
+    # the real trainer must LOAD the warmed cache, not rebuild: poison
+    # the builder and construct
+    import pipegcn_tpu.ops.bucket_spmm as bs
+
+    orig = bs.build_sharded_bucket_tables
+    try:
+        def boom(*a, **k):
+            raise AssertionError("cache miss: prewarmed tables not used")
+
+        bs.build_sharded_bucket_tables = boom
+        t = Trainer(sg2, cfg, TrainConfig(n_epochs=1, eval=False))
+        assert t._bucket_tables is not None
+    finally:
+        bs.build_sharded_bucket_tables = orig
