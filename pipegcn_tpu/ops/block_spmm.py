@@ -463,6 +463,52 @@ class BlockPlan:
 _DENSE_CHUNK_ELEMS = 32 * 1024 * 1024
 
 
+def _apply_classes(classes, compute, per_row_elems, pads, inv, out_tile,
+                   n_feat, out_rows):
+    """Shared scaffold of the dense applies (per-tile and grouped): run
+    `compute` over each class's index mats — chunked via a lax.scan
+    over padded row blocks whenever the per-chunk transient would
+    exceed _DENSE_CHUNK_ELEMS — then concatenate every class's output
+    tiles (plus one zero sentinel row), restore output-tile order with
+    `inv`, and flatten tiles to rows.
+
+    classes: list of index-mat tuples (leading axis = class rows);
+    compute(*mats) -> [rows, ..., out_tile, n_feat] f32 (extra middle
+    axes are flattened into the tile axis); per_row_elems(mats) ->
+    transient elements per row (the chunk divisor); pads: per-mat pad
+    constants for the scan's padded tail (must point at zero
+    blocks/tiles so pad rows compute zeros that get sliced away)."""
+    outs = []
+    for mats in classes:
+        n_w = mats[0].shape[0]
+        if n_w == 0:
+            continue
+        rpc = max(1, _DENSE_CHUNK_ELEMS // max(1, per_row_elems(mats)))
+        if n_w <= rpc:
+            out = compute(*mats)
+        else:
+            n_chunks = -(-n_w // rpc)
+            pad_rows = n_chunks * rpc - n_w
+            padded = tuple(
+                jnp.pad(m, ((0, pad_rows),) + ((0, 0),) * (m.ndim - 1),
+                        constant_values=p)
+                for m, p in zip(mats, pads))
+
+            def body(_, idx):
+                return None, compute(*idx)
+
+            _, chunks = jax.lax.scan(
+                body, None,
+                tuple(m.reshape((n_chunks, rpc) + m.shape[1:])
+                      for m in padded))
+            out = chunks.reshape((n_chunks * rpc,)
+                                 + chunks.shape[2:])[:n_w]
+        outs.append(out.reshape(-1, out_tile, n_feat))
+    outs.append(jnp.zeros((1, out_tile, n_feat), jnp.float32))
+    res = jnp.take(jnp.concatenate(outs, axis=0), inv, axis=0)
+    return res.reshape(-1, n_feat)[:out_rows]
+
+
 def _dense_apply(a_pad, groups, ginv, tiles, T, out_rows, n_feat,
                  compute_dtype, transpose=False, packed=False):
     """For every output tile i: sum_k A[blk(i,k)] (@ or transposed-@)
@@ -480,10 +526,9 @@ def _dense_apply(a_pad, groups, ginv, tiles, T, out_rows, n_feat,
 
     Each class runs as one batched contraction ([R, T, K*S] @
     [R, K*S, F] after XLA canonicalization — MXU-shaped), chunked over
-    rows so the unpacked A transient stays bounded."""
+    rows so the unpacked A transient stays bounded (_apply_classes)."""
     spec = "rkts,rktf->rsf" if transpose else "rkts,rksf->rtf"
     s = a_pad.shape[-1] * 8 if packed else a_pad.shape[-1]
-    pad_blk = a_pad.shape[0] - 1
 
     def compute(bi, ti):  # [R, K] x2 -> [R, T, F] f32
         blks = jnp.take(a_pad, bi, axis=0)
@@ -493,37 +538,12 @@ def _dense_apply(a_pad, groups, ginv, tiles, T, out_rows, n_feat,
         return jnp.einsum(spec, blks, tls,
                           preferred_element_type=jnp.float32)
 
-    outs = []
-    for bi, ti in groups:
-        n_w, k = bi.shape
-        if n_w == 0:
-            continue
-        # bound both transients: unpacked A [R,K,T,S] and the gathered
-        # feature tiles [R,K,S,F]
-        rows_per_chunk = max(
-            1, _DENSE_CHUNK_ELEMS // max(1, k * s * max(T, n_feat)))
-        if n_w <= rows_per_chunk:
-            out = compute(bi, ti)
-        else:
-            n_chunks = -(-n_w // rows_per_chunk)
-            pad_rows = n_chunks * rows_per_chunk - n_w
-            bi_p = jnp.pad(bi, ((0, pad_rows), (0, 0)),
-                           constant_values=pad_blk)
-            ti_p = jnp.pad(ti, ((0, pad_rows), (0, 0)),
-                           constant_values=tiles.shape[0] - 1)
-            shape = (n_chunks, rows_per_chunk, k)
-
-            def body(_, idx):
-                return None, compute(*idx)
-
-            _, chunks = jax.lax.scan(
-                body, None,
-                (bi_p.reshape(shape), ti_p.reshape(shape)))
-            out = chunks.reshape(-1, T, n_feat)[:n_w]
-        outs.append(out)
-    outs.append(jnp.zeros((1, T, n_feat), jnp.float32))  # zero sentinel
-    res = jnp.take(jnp.concatenate(outs, axis=0), ginv, axis=0)
-    return res.reshape(-1, n_feat)[:out_rows]
+    # transients: unpacked A [R, K, T, S] + gathered tiles [R, K, S, F]
+    return _apply_classes(
+        groups, compute,
+        lambda mats: mats[0].shape[1] * s * max(T, n_feat),
+        (a_pad.shape[0] - 1, tiles.shape[0] - 1),
+        ginv, T, n_feat, out_rows)
 
 
 def _dense_apply_grouped(a_pad, classes, inv, tiles, T, out_rows,
@@ -542,7 +562,6 @@ def _dense_apply_grouped(a_pad, classes, inv, tiles, T, out_rows,
     (the backward's per-source-tile sum of A^T @ g)."""
     spec = "rduts,rutf->rdsf" if transpose else "rduts,rusf->rdtf"
     s = a_pad.shape[-1] * 8 if packed else a_pad.shape[-1]
-    pad_blk = a_pad.shape[0] - 1
 
     def compute(ai, ti):  # [R, group, U] + [R, U] -> [R, group, T|S, F]
         blks = jnp.take(a_pad, ai, axis=0)        # [R, G, U, T, S(/8)]
@@ -552,40 +571,15 @@ def _dense_apply_grouped(a_pad, classes, inv, tiles, T, out_rows,
         return jnp.einsum(spec, blks, tls,
                           preferred_element_type=jnp.float32)
 
-    outs = []
-    out_tile = T  # square tiles: the output's in-tile dim is T either way
-    for ai, ti in classes:
-        n_w, g, u = ai.shape
-        if n_w == 0:
-            continue
-        # bound both per-chunk transients: unpacked A [R, G, U, T, S]
-        # and the gathered union tiles [R, U, S, F] (F can exceed
-        # G*T on wide input layers)
-        rows_per_chunk = max(
-            1, _DENSE_CHUNK_ELEMS // max(1, g * u * T * s,
-                                         u * s * n_feat))
-        if n_w <= rows_per_chunk:
-            out = compute(ai, ti)
-        else:
-            n_chunks = -(-n_w // rows_per_chunk)
-            pad_rows = n_chunks * rows_per_chunk - n_w
-            ai_p = jnp.pad(ai, ((0, pad_rows), (0, 0), (0, 0)),
-                           constant_values=pad_blk)
-            ti_p = jnp.pad(ti, ((0, pad_rows), (0, 0)),
-                           constant_values=tiles.shape[0] - 1)
-
-            def body(_, idx):
-                return None, compute(*idx)
-
-            _, chunks = jax.lax.scan(
-                body, None,
-                (ai_p.reshape(n_chunks, rows_per_chunk, g, u),
-                 ti_p.reshape(n_chunks, rows_per_chunk, u)))
-            out = chunks.reshape(-1, g, out_tile, n_feat)[:n_w]
-        outs.append(out.reshape(-1, out_tile, n_feat))  # [R*G, T|S, F]
-    outs.append(jnp.zeros((1, out_tile, n_feat), jnp.float32))
-    res = jnp.take(jnp.concatenate(outs, axis=0), inv, axis=0)
-    return res.reshape(-1, n_feat)[:out_rows]
+    # transients: unpacked A [R, G, U, T, S] + gathered union tiles
+    # [R, U, S, F] (F can exceed G*T on wide input layers); square
+    # tiles, so the output's in-tile dim is T in both directions
+    return _apply_classes(
+        classes, compute,
+        lambda mats: max(mats[0].shape[1] * mats[0].shape[2] * T * s,
+                         mats[0].shape[2] * s * n_feat),
+        (a_pad.shape[0] - 1, tiles.shape[0] - 1),
+        inv, T, n_feat, out_rows)
 
 
 def make_block_spmm_fn(

@@ -1006,6 +1006,14 @@ class Trainer:
             # harvest the final in-flight evaluation
             _harvest_eval(pending)
             pending = None
+        if (tcfg.eval and eval_graphs and "val" in eval_graphs
+                and n_epochs > start_epoch
+                and n_epochs % tcfg.log_every != 0):
+            # the run's final epochs lie past the last log boundary, so
+            # the FINAL state was never scored (with log_every >
+            # n_epochs, no eval happened at all and the summary would
+            # be silently empty); always evaluate it before reporting
+            _harvest_eval(_dispatch_eval(epoch - 1, loss, dur))
 
         if profiling:
             # run ended inside the trace window; finalize the trace

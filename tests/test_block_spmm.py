@@ -348,3 +348,34 @@ def test_trainer_block_grouped_matches_xla():
         if impl == "block":
             assert any(k.startswith("blk_fwdu_g") for k in t._block_tables)
     np.testing.assert_allclose(losses["xla"], losses["block"], rtol=2e-4)
+
+
+@pytest.mark.parametrize("group", [1, 4])
+def test_chunked_scan_path_matches(edges, group, monkeypatch):
+    """Force _apply_classes' lax.scan chunking (tiny element budget) —
+    the padded-tail/reshape/slice logic must not change results in
+    either dense layout."""
+    import pipegcn_tpu.ops.block_spmm as bsp
+
+    src, dst, n_out, n_src = edges
+    rng = np.random.default_rng(5)
+    fbuf = jnp.asarray(rng.standard_normal((n_src, 8)).astype(np.float32))
+    deg = jnp.asarray(
+        np.maximum(np.bincount(dst, minlength=n_out), 1).astype(np.float32)
+    )
+    plan = BlockPlan(src, dst, n_out, n_src, n_feat=8, tile=16,
+                     nnz_threshold=4, group=group)
+    arrs = {k: jnp.asarray(v) for k, v in plan_to_arrays(plan).items()}
+    fn = make_block_spmm_fn(arrs, deg, n_out, n_src, 16)
+    # reference values (fwd AND grad) must trace BEFORE the patch:
+    # fn is unjitted, so a later jax.grad(fn) would re-trace through
+    # the patched chunk budget and compare the scan path to itself
+    ref = np.asarray(fn(fbuf))
+    g_ref = jax.grad(lambda f: (fn(f) ** 2).sum())(fbuf)
+    monkeypatch.setattr(bsp, "_DENSE_CHUNK_ELEMS", 2048)
+    fn_c = make_block_spmm_fn(arrs, deg, n_out, n_src, 16)
+    np.testing.assert_allclose(np.asarray(fn_c(fbuf)), ref,
+                               rtol=1e-6, atol=1e-6)
+    g_c = jax.grad(lambda f: (fn_c(f) ** 2).sum())(fbuf)
+    np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)

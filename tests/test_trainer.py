@@ -271,3 +271,16 @@ def test_prewarm_tables_guards_and_caches(tmp_path):
         assert t._bucket_tables is not None
     finally:
         bs.build_sharded_bucket_tables = orig
+
+
+def test_fit_final_state_always_evaluated(graph):
+    """log_every past n_epochs (or a final partial period) must not end
+    the run unscored: fit always evaluates the final state."""
+    t = _setup(graph, 2, seed=3, dropout=0.1, n_epochs=8, log_every=50,
+               hidden=32)
+    res = t.fit(eval_graphs={"val": (graph, "val_mask"),
+                             "test": (graph, "test_mask")},
+                log_fn=lambda m: None)
+    assert res["best_params"] is not None
+    assert res["best_epoch"] == 8
+    assert res["best_val"] > 0.0
