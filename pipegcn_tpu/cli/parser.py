@@ -129,6 +129,14 @@ def create_parser() -> argparse.ArgumentParser:
                              "dst tiles share one gathered source-tile "
                              "union in the block kernel's dense path "
                              "(1 = per-tile block lists)")
+    parser.add_argument("--rem-dtype", "--rem_dtype",
+                        choices=["none", "bfloat16", "float8"],
+                        default="none",
+                        help="gather-transport dtype for the bucket "
+                             "kernel / block remainder: float8 packs "
+                             "256 features into one 256-byte gather "
+                             "row (e4m3 activations, e5m2 cotangents, "
+                             "f32 accumulation)")
     parser.add_argument("--fused-epochs", "--fused_epochs", type=int,
                         default=1,
                         help="epochs per compiled dispatch (lax.scan); "

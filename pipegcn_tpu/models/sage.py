@@ -75,11 +75,28 @@ class ModelConfig:
     # (block_spmm._group_union; measured F-tile dedupe headroom in
     # docs/PERF_NOTES.md). 1 = per-tile K-class layout
     block_group: int = 1
+    # gather-transport dtype for the bucket kernel / block remainder
+    # (bucket_spmm.transport_dtypes): None = activation dtype;
+    # 'float8' = e4m3 activations / e5m2 cotangents — halves gathered
+    # rows at F=256 (the gather path is request-rate-bound at 256-byte
+    # rows); accumulation stays f32. Casts SATURATE at the fp8 finite
+    # max (transport_cast), so raw layer-0 features beyond +-448
+    # (use_pp=False / gcn) clamp instead of going NaN; the one-shot
+    # metric-bearing paths (pp precompute, sharded eval) are exempt.
+    rem_dtype: Optional[str] = None
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     def __post_init__(self):
         if self.model not in ("graphsage", "gcn", "gat"):
             raise ValueError(f"unknown model: {self.model}")
+        if self.rem_dtype in ("", "none"):
+            # ONE sentinel: every consumer sees None for "no transport
+            # narrowing" (CLI/bench pass their 'none' strings through)
+            object.__setattr__(self, "rem_dtype", None)
+        if self.rem_dtype not in (None, "float8", "bfloat16"):
+            raise ValueError(
+                f"unknown rem_dtype: {self.rem_dtype!r} "
+                "(none | bfloat16 | float8)")
         if self.model in ("gcn", "gat") and self.use_pp:
             # the pp precompute caches SAGE's mean-neighbor concat;
             # gcn/gat first layers aggregate like every other layer

@@ -589,14 +589,20 @@ def make_block_spmm_fn(
     n_src_rows: int,
     tile: int,
     chunk_edges: Optional[int] = None,
+    rem_dtype: Optional[str] = None,
 ):
     """Differentiable hybrid mean-aggregation closure f(fbuf [R, F]) ->
     f32 [n_out, F]. `plan_arrays` holds the BlockPlan tensors (see
     sharded_block_tables for keys), already stripped to per-device blocks
-    when used inside shard_map."""
+    when used inside shard_map. `rem_dtype` narrows the REMAINDER's
+    gather transport only (bucket_spmm.transport_dtypes) — the dense
+    MXU path keeps the activation dtype."""
+    from .bucket_spmm import transport_cast, transport_dtypes
+
     d = plan_arrays
     deg_col = in_deg[:, None]
     T = tile
+    rem_fwd_dt, rem_bwd_dt = transport_dtypes(rem_dtype)
 
     def tiles_of(x, n_tiles, S):
         rpad = n_tiles * S - x.shape[0]
@@ -644,16 +650,18 @@ def make_block_spmm_fn(
                                  d["blk_fwd_ginv"], tiles, T, n_out,
                                  fbuf.shape[-1], fbuf.dtype,
                                  packed=packed)
-        rem = bucket_aggregate(fbuf, rem_mats("blkrem_fwd_"),
-                               d["blkrem_fwd_inv"],
-                               chunk_edges=chunk_edges)
+        rem = bucket_aggregate(
+            transport_cast(fbuf, rem_fwd_dt),
+            rem_mats("blkrem_fwd_"), d["blkrem_fwd_inv"],
+            chunk_edges=chunk_edges)
         return (dense + rem) / deg_col
 
     def fwd(fbuf):
         return f(fbuf), jnp.zeros((0,), fbuf.dtype)
 
     def bwd(proto, g):
-        gd = (g.astype(jnp.float32) / deg_col).astype(proto.dtype)
+        gd32 = g.astype(jnp.float32) / deg_col
+        gd = gd32.astype(proto.dtype)
         # transpose dense: per source tile, sum A^T @ g_tile
         n_d_tiles = -(-n_out // T)
         g_tiles = tiles_of(gd, n_d_tiles, T)
@@ -667,9 +675,14 @@ def make_block_spmm_fn(
                                  d["blk_bwd_ginv"], g_tiles, T,
                                  n_src_rows, g.shape[-1], gd.dtype,
                                  transpose=True, packed=packed)
-        rem = bucket_aggregate(gd, rem_mats("blkrem_bwd_"),
-                               d["blkrem_bwd_inv"],
-                               chunk_edges=chunk_edges)
+        # the remainder's transport cast comes straight from the f32
+        # cotangent — not through the proto.dtype rounding above
+        # (matching bucket_spmm's single-rounding path)
+        rem = bucket_aggregate(
+            transport_cast(gd32, rem_bwd_dt)
+            if rem_bwd_dt is not None else gd,
+            rem_mats("blkrem_bwd_"), d["blkrem_bwd_inv"],
+            chunk_edges=chunk_edges)
         return ((dense + rem).astype(proto.dtype),)
 
     f.defvjp(fwd, bwd)
@@ -904,10 +917,11 @@ def build_sharded_block_tables(sg, tile: int = 256,
 
 def make_device_block_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
                               n_out: int, n_src_rows: int, tile: int,
-                              chunk_edges: Optional[int] = None):
+                              chunk_edges: Optional[int] = None,
+                              rem_dtype: Optional[str] = None):
     """Bind per-device blocks of build_sharded_block_tables (inside
     shard_map, leading device axis stripped)."""
     plan_arrays = {k: v for k, v in d.items()
                    if k.startswith(("blk_", "blkrem_"))}
     return make_block_spmm_fn(plan_arrays, in_deg, n_out, n_src_rows,
-                              tile, chunk_edges)
+                              tile, chunk_edges, rem_dtype)

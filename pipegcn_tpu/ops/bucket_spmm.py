@@ -251,6 +251,43 @@ class BucketPlan:
                                    n_src_rows, n_out, self.bwd_widths)
 
 
+def transport_dtypes(rem_dtype: Optional[str]):
+    """(forward, backward) gather-transport dtypes for a remainder/
+    bucket transport spec. The gather path is request-rate-bound at
+    256-byte rows (SLAB_BYTES note), so BYTES PER FEATURE set the
+    row count: fp8 packs 256 features into one 256 B slab — half the
+    gathered rows of bf16 at F=256. Activations travel e4m3 (range
+    +-448 suits post-norm activations), cotangents e5m2 (gradient
+    dynamic range needs exponent bits); accumulation stays f32 either
+    way. None = no cast (the activation dtype)."""
+    if rem_dtype in (None, "", "none"):
+        return None, None
+    if rem_dtype == "float8":
+        return jnp.float8_e4m3fn, jnp.float8_e5m2
+    if rem_dtype == "bfloat16":
+        return jnp.bfloat16, jnp.bfloat16
+    raise ValueError(f"unknown transport dtype: {rem_dtype!r}")
+
+
+# finite maxima of the fp8 transport dtypes: they have NO inf, so an
+# overflowing astype produces NaN — transport_cast saturates instead
+# (the standard fp8 convention). Raw layer-0 features beyond the range
+# (use_pp=False / gcn) thus degrade gracefully rather than poisoning
+# the epoch with NaN.
+_F8_MAX = {jnp.float8_e4m3fn: 448.0, jnp.float8_e5m2: 57344.0}
+
+
+def transport_cast(x: jax.Array, dt) -> jax.Array:
+    """Saturating cast to a transport dtype (identity when dt is
+    None); fp8 targets clamp to their finite max first."""
+    if dt is None:
+        return x
+    m = _F8_MAX.get(dt)
+    if m is not None:
+        x = jnp.clip(x.astype(jnp.float32), -m, m)
+    return x.astype(dt)
+
+
 def make_bucket_spmm_fn(
     fwd_mats: Sequence[jax.Array],
     fwd_inv: jax.Array,
@@ -260,26 +297,35 @@ def make_bucket_spmm_fn(
     n_src_rows: int,
     chunk_elems: int = DEFAULT_CHUNK_ELEMS,
     chunk_edges: Optional[int] = None,
+    rem_dtype: Optional[str] = None,
 ):
     """Differentiable mean-aggregation closure: f(fbuf [R, F]) ->
     f32 [n_out, F]; backward is the transpose bucket aggregation, f32
-    accumulation, cotangent cast back to fbuf's dtype."""
+    accumulation, cotangent cast back to fbuf's dtype. `rem_dtype`
+    optionally narrows the GATHER TRANSPORT (see transport_dtypes) —
+    the one cast before aggregation halves gathered rows at F=256."""
     deg_col = in_deg[:, None]
+    fwd_dt, bwd_dt = transport_dtypes(rem_dtype)
 
     @jax.custom_vjp
     def f(fbuf):
-        return bucket_aggregate(fbuf, fwd_mats, fwd_inv,
-                                chunk_elems, chunk_edges) / deg_col
+        return bucket_aggregate(transport_cast(fbuf, fwd_dt), fwd_mats,
+                                fwd_inv, chunk_elems,
+                                chunk_edges) / deg_col
 
     def fwd(fbuf):
         return f(fbuf), jnp.zeros((0,), fbuf.dtype)
 
     def bwd(proto, g):
-        # transpose aggregation; cotangents travel in the activation
-        # dtype (half the gather traffic and double the slab width in
-        # bf16 — same transport precision as the halo exchange), while
-        # bucket_aggregate still accumulates in f32
-        gd = (g.astype(jnp.float32) / deg_col).astype(proto.dtype)
+        # transpose aggregation; cotangents travel in the transport
+        # dtype (default: the activation dtype — half the gather
+        # traffic and double the slab width vs f32, same precision as
+        # the halo exchange), while bucket_aggregate still accumulates
+        # in f32. The transport cast comes straight from the f32
+        # value — never through an intermediate rounding.
+        gd32 = g.astype(jnp.float32) / deg_col
+        gd = transport_cast(gd32, bwd_dt) if bwd_dt is not None \
+            else gd32.astype(proto.dtype)
         d_fbuf = bucket_aggregate(gd, bwd_mats, bwd_inv, chunk_elems,
                                   chunk_edges)
         return (d_fbuf[:n_src_rows].astype(proto.dtype),)
@@ -372,7 +418,8 @@ def build_sharded_bucket_tables(sg, chunk_elems: int = DEFAULT_CHUNK_ELEMS
 def make_device_bucket_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
                                n_src_rows: int,
                                chunk_elems: int = DEFAULT_CHUNK_ELEMS,
-                               chunk_edges: Optional[int] = None):
+                               chunk_edges: Optional[int] = None,
+                               rem_dtype: Optional[str] = None):
     """Bind the per-device blocks of build_sharded_bucket_tables (call
     inside shard_map, after stripping the leading device axis) into the
     differentiable closure."""
@@ -382,5 +429,5 @@ def make_device_bucket_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
                 and not k.endswith("inv")]
     return make_bucket_spmm_fn(
         fwd_mats, d["bkt_fwd_inv"], bwd_mats, d["bkt_bwd_inv"],
-        in_deg, n_src_rows, chunk_elems, chunk_edges,
+        in_deg, n_src_rows, chunk_elems, chunk_edges, rem_dtype,
     )

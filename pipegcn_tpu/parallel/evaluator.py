@@ -92,8 +92,13 @@ class ShardedEvaluator:
             # for a foreign (inductive) eval graph — both beat the
             # raw-edge gather path. Shapes come from THIS sg, which may
             # be sharded differently from the training graph.
+            # transport=False: evaluation is one-shot and metric-
+            # bearing — it must not inherit the narrowed per-epoch
+            # gather transport (rem_dtype), and with use_pp=False its
+            # first layer aggregates RAW features
             spmm = trainer.make_device_spmm_closure(
                 d, n_max=n_max, n_src_rows=n_max + sg.halo_size,
+                transport=False,
             ) if use_tables else None
             # GAT aggregates through the attention-bucket closure (its
             # tables ride in the data exactly like the mean kernels')

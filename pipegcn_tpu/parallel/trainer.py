@@ -459,7 +459,8 @@ class Trainer:
                                  PARTS_AXIS, self.P)
             if use_tables:
                 spmm = self.make_device_spmm_closure(
-                    d, n_max=n_max, n_src_rows=n_max + sg.halo_size)
+                    d, n_max=n_max, n_src_rows=n_max + sg.halo_size,
+                    transport=False)
                 ah = spmm(fbuf)
             else:
                 ah = spmm_mean(fbuf, d["edge_src"], d["edge_dst"],
@@ -489,7 +490,8 @@ class Trainer:
 
     def make_device_spmm_closure(self, d: Dict[str, jax.Array],
                                  n_max: Optional[int] = None,
-                                 n_src_rows: Optional[int] = None):
+                                 n_src_rows: Optional[int] = None,
+                                 transport: bool = True):
         """Per-device mean-aggregation closure over the stripped (no
         leading device axis) table arrays in `d` — or None when `d`
         carries no kernel tables (raw-edge XLA path). The kernel kind is
@@ -502,6 +504,11 @@ class Trainer:
         n_max = self.sg.n_max if n_max is None else n_max
         if n_src_rows is None:
             n_src_rows = n_max + self.sg.halo_size
+        # transport=False: one-shot consumers (the pp precompute of RAW
+        # features) must not inherit the narrowed per-epoch gather
+        # transport — their cost is irrelevant and raw feature ranges
+        # can exceed e4m3's +-448
+        rem_dtype = cfg.rem_dtype if transport else None
         if "spmm_esrc" in d:
             from ..ops.pallas_spmm import make_device_spmm_fn
 
@@ -514,13 +521,14 @@ class Trainer:
 
             return make_device_bucket_spmm_fn(
                 d, d["in_deg"], n_src_rows, chunk_edges=cfg.spmm_chunk,
+                rem_dtype=rem_dtype,
             )
         if "blk_a" in d or "blk_a_bits" in d:
             from ..ops.block_spmm import make_device_block_spmm_fn
 
             return make_device_block_spmm_fn(
                 d, d["in_deg"], n_max, n_src_rows, self._block_tile,
-                chunk_edges=cfg.spmm_chunk,
+                chunk_edges=cfg.spmm_chunk, rem_dtype=rem_dtype,
             )
         return None
 

@@ -284,3 +284,34 @@ def test_fit_final_state_always_evaluated(graph):
     assert res["best_params"] is not None
     assert res["best_epoch"] == 8
     assert res["best_val"] > 0.0
+
+
+def test_float8_remainder_transport_converges(graph):
+    """rem_dtype='float8' narrows only the gather transport (f32
+    accumulation): training must track the full-precision run early
+    and keep converging; the pp precompute is exempt (raw features)."""
+    parts = partition_graph(graph, 4, seed=0)
+    sg = ShardedGraph.build(graph, parts, n_parts=4)
+    losses = {}
+    for rd in (None, "float8"):
+        cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
+                          dropout=0.0, train_size=sg.n_train_global,
+                          spmm_impl="bucket", use_pp=True,
+                          rem_dtype=rd)
+        t = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True))
+        losses[rd] = [t.train_epoch(e) for e in range(20)]
+    l32, l8 = np.asarray(losses[None]), np.asarray(losses["float8"])
+    assert np.isfinite(l8).all()
+    np.testing.assert_allclose(l8[:5], l32[:5], rtol=0.08, atol=0.03)
+    assert l8[-1] < l8[0] * 0.7  # still converging
+    # pp features (raw-feature precompute) must be exempt from the
+    # narrowed transport: identical across the two configs
+    cfg8 = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
+                      dropout=0.0, train_size=sg.n_train_global,
+                      spmm_impl="bucket", use_pp=True,
+                      rem_dtype="float8")
+    t8 = Trainer(sg, cfg8, TrainConfig(seed=4))
+    t0 = Trainer(sg, dataclasses.replace(cfg8, rem_dtype=None),
+                 TrainConfig(seed=4))
+    np.testing.assert_array_equal(np.asarray(t8.data["feat"]),
+                                  np.asarray(t0.data["feat"]))
