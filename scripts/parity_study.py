@@ -53,6 +53,12 @@ def main():
     ap.add_argument("--fused", type=int, default=25)
     ap.add_argument("--name", default="",
                     help="output suffix, e.g. 'reddit_scale'")
+    ap.add_argument("--spmm-impl", default="xla",
+                    choices=["xla", "bucket", "block", "auto"])
+    ap.add_argument("--rem-dtype", default="none",
+                    choices=["none", "bfloat16", "float8"],
+                    help="gather-transport narrowing under study "
+                         "(ModelConfig.rem_dtype)")
     args = ap.parse_args()
     if not args.out:
         suffix = "" if args.model == "graphsage" else f"_{args.model}"
@@ -95,7 +101,8 @@ def main():
                 layer_sizes=(sg.n_feat, args.hidden, args.hidden,
                              sg.n_class), norm="layer",
                 dropout=0.3, train_size=sg.n_train_global,
-                model=args.model,
+                model=args.model, spmm_impl=args.spmm_impl,
+                rem_dtype=args.rem_dtype,
             )
             tcfg = TrainConfig(seed=seed, lr=3e-3, n_epochs=args.epochs,
                                log_every=25, fused_epochs=args.fused,
@@ -116,7 +123,8 @@ def main():
         f"{args.homophily}, {args.train_frac:.0%} train labels;",
         f"{args.model} 3x{args.hidden}, dropout 0.3, lr 3e-3, "
         f"{args.epochs} epochs, {args.parts} partitions, "
-        f"{args.seeds} seeds.",
+        f"{args.seeds} seeds; spmm_impl={args.spmm_impl}, "
+        f"rem_dtype={args.rem_dtype}.",
         "",
         "| variant | best val (mean ± std) | test @ best val (mean ± std) |",
         "|---|---|---|",
