@@ -27,18 +27,26 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def model_epoch(dense_edges, rem_edges, dense_blocks, tile, width=256):
-    """v5e-calibrated epoch model (docs/PERF_NOTES.md): 6 SpMMs of
-    dense A+F-tile reads + MXU, remainder at the slab-gather rate,
-    with the x1.5-ladder pad factor ~1.25 applied to the remainder."""
-    GATHER_RPS, HBM_BPS, MXU = 390e6, 819e9, 0.5 * 197e12
-    isz = 2  # bf16
+def model_epoch(dense_edges, rem_edges, dense_blocks, tile, width=256,
+                gather_rps=390e6, hbm_bps=819e9, mxu_frac=0.5,
+                rem_bytes_per_feat=2, union_dedupe=1.0, fixed_s=0.0):
+    """v5e epoch model (docs/PERF_NOTES.md): 6 SpMMs of dense A+F-tile
+    reads + MXU, remainder at the slab-gather rate, x1.5-ladder pad
+    ~1.25 on the remainder. The rates are FLAGS so the model can be
+    recalibrated against --probe-traffic decompositions (the round-3
+    session-1 projection at defaults missed the measured 1.5182 by
+    0.53 s — results/tpu_bench.md). `rem_bytes_per_feat`: 2 = bf16,
+    1 = fp8 transport (--rem-dtype float8); `union_dedupe`: F-tile
+    read factor of the union-gather layout (measured 0.33 at
+    --block-group 4); `fixed_s`: non-SpMM epoch floor."""
+    MXU = mxu_frac * 197e12
+    isz = 2  # activations bf16 (dense path)
     t_dense = dense_blocks * 6 * (
-        (tile * width * isz + tile * tile / 8) / HBM_BPS
+        (tile * width * isz * union_dedupe + tile * tile / 8) / hbm_bps
         + 2 * tile * tile * width / MXU)
-    n_slabs = max(1, (width * isz) // 256)
-    t_rem = rem_edges * 1.25 * n_slabs * 6 / GATHER_RPS
-    return t_dense + t_rem, t_dense, t_rem
+    n_slabs = max(1, (width * rem_bytes_per_feat) // 256)
+    t_rem = rem_edges * 1.25 * n_slabs * 6 / gather_rps
+    return t_dense + t_rem + fixed_s, t_dense, t_rem
 
 
 def main():
@@ -50,6 +58,17 @@ def main():
     ap.add_argument("--nnz", type=int, nargs="+",
                     default=[0, 64, 108, 160])
     ap.add_argument("--out", default="results/coverage_sweep.md")
+    ap.add_argument("--gather-rps", type=float, default=390e6)
+    ap.add_argument("--hbm-bps", type=float, default=819e9)
+    ap.add_argument("--mxu-frac", type=float, default=0.5)
+    ap.add_argument("--rem-bytes-per-feat", type=int, default=2,
+                    help="2 = bf16 transport, 1 = fp8 (--rem-dtype)")
+    ap.add_argument("--union-dedupe", type=float, default=1.0,
+                    help="F-tile factor of the union-gather layout "
+                         "(0.33 measured at --block-group 4)")
+    ap.add_argument("--fixed-s", type=float, default=0.0,
+                    help="non-SpMM epoch floor (recalibrate from the "
+                         "probe-traffic decomposition)")
     args = ap.parse_args()
 
     import jax
@@ -80,7 +99,12 @@ def main():
             cov, n_dense, dense_e, tot_e = _part_block_stats(
                 sg, 0, tile, n_src_tiles, thr, max_blocks=cap)
             rem_e = tot_e - dense_e
-            t_ep, t_d, t_r = model_epoch(dense_e, rem_e, n_dense, tile)
+            t_ep, t_d, t_r = model_epoch(
+                dense_e, rem_e, n_dense, tile,
+                gather_rps=args.gather_rps, hbm_bps=args.hbm_bps,
+                mxu_frac=args.mxu_frac,
+                rem_bytes_per_feat=args.rem_bytes_per_feat,
+                union_dedupe=args.union_dedupe, fixed_s=args.fixed_s)
             rows.append((tsize, thr, cov, n_dense, rem_e, t_ep, t_d, t_r,
                          build_s))
             print(f"tsize={tsize} thr={thr}: cov={cov:.3f} "

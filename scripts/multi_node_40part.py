@@ -47,7 +47,8 @@ MODEL_FLAGS = [
 ]
 
 
-def run_single(dataset: str, epochs: int, part_dir: str) -> dict:
+def run_single(dataset: str, epochs: int, part_dir: str,
+               production_kernel: bool = False) -> dict:
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
@@ -64,6 +65,13 @@ def run_single(dataset: str, epochs: int, part_dir: str) -> dict:
            # argparse keeps the last occurrence: make sure at least two
            # eval lines land inside the run, whatever the epoch count
            "--log-every", str(max(1, epochs // 2))]
+    if production_kernel:
+        # the benchmark-headline kernel stack at the multi-node shape:
+        # hybrid block kernel in the union-gather layout with fp8
+        # remainder transport (a low nnz threshold gives the small
+        # per-shard graphs real dense tiles, like the dryrun gate)
+        cmd += ["--spmm-impl", "block", "--block-group", "4",
+                "--rem-dtype", "float8", "--block-nnz", "4"]
     t0 = time.time()
     r = subprocess.run(cmd, capture_output=True, text=True, env=env,
                        cwd=REPO)
@@ -76,7 +84,8 @@ def run_single(dataset: str, epochs: int, part_dir: str) -> dict:
     test = re.search(r"Test Result \| Accuracy ([0-9.]+)%", out)
     times = [float(m) for m in re.findall(r"Time\(s\) ([0-9.]+)", out)]
     return {
-        "mode": "single-process",
+        "mode": ("single-process-production-kernel" if production_kernel
+                 else "single-process"),
         "devices": 40,
         "dataset": dataset,
         "epochs": epochs,
@@ -190,6 +199,10 @@ def main() -> None:
     ap.add_argument("--skip-single", action="store_true",
                     help="keep the single-process result already in "
                          "MULTICHIP_40part.json, run only multihost")
+    ap.add_argument("--production-kernel", action="store_true",
+                    help="run the single-process leg with the headline "
+                         "kernel stack: block + union-gather group 4 + "
+                         "fp8 remainder transport")
     ap.add_argument("--part-dir", default="partitions/multi40")
     args = ap.parse_args()
     if not args.skip_multihost and args.mh_epochs < 10:
@@ -209,7 +222,8 @@ def main() -> None:
 
     dataset = f"synthetic:{args.nodes}:{args.degree}:602:41"
     if not args.skip_single:
-        r = run_single(dataset, args.epochs, args.part_dir)
+        r = run_single(dataset, args.epochs, args.part_dir,
+                       production_kernel=args.production_kernel)
         by_mode[r["mode"]] = r
         print(json.dumps(r))
         flush()
