@@ -497,7 +497,26 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         # ---- optional SpMM implementation sweep -----------------------
         if args.sweep_spmm:
             sweep = {}
-            for impl in ("xla", "bucket", "block", "pallas"):
+            # (label, config overrides): the block kernel sweeps its
+            # dense layouts and the fp8 remainder transport too —
+            # sharing one artifact + warmed table caches, so each extra
+            # entry costs one trainer build, not a rebuild of the world
+            entries = [  # every knob EXPLICIT: entries must not
+                # inherit the headline's --block-group/--rem-dtype
+                ("xla", dict(spmm_impl="xla", block_group=1,
+                             rem_dtype=None)),
+                ("bucket", dict(spmm_impl="bucket", block_group=1,
+                                rem_dtype=None)),
+                ("block", dict(spmm_impl="block", block_group=1,
+                               rem_dtype=None)),
+                ("block-u4", dict(spmm_impl="block", block_group=4,
+                                  rem_dtype=None)),
+                ("block-u4-f8", dict(spmm_impl="block", block_group=4,
+                                     rem_dtype="float8")),
+                ("pallas", dict(spmm_impl="pallas", block_group=1,
+                                rem_dtype=None)),
+            ]
+            for impl, overrides in entries:
                 try:
                     if impl == "pallas":
                         # forcing the VMEM-resident kernel on a shard
@@ -518,7 +537,7 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                             continue
                     t0 = time.perf_counter()
                     tr = Trainer(sg,
-                        dataclasses.replace(cfg, spmm_impl=impl),
+                        dataclasses.replace(cfg, **overrides),
                         TrainConfig(lr=0.01, n_epochs=blk * 4,
                                     enable_pipeline=headline_pipeline,
                                     seed=0, eval=False, fused_epochs=blk))
