@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Unattended TPU-window harvester.
+
+The axon tunnel is up only sporadically (observed: one ~45-minute
+window in >12 h — docs/PERF_NOTES.md). This script polls the backend
+in throwaway subprocesses and, the moment a window opens, runs the
+staged measurement queue in priority order, logging everything to
+results/tpu_window/. Each step is its own subprocess with a timeout;
+the tunnel is re-probed between steps so a mid-queue outage stops the
+run cleanly instead of hanging it.
+
+Usage: nohup python scripts/tpu_window.py [--poll-s 300] &
+       python scripts/tpu_window.py --once   # single probe+queue pass
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LOG_DIR = os.path.join(REPO, "results", "tpu_window")
+
+# (name, argv, timeout_s) — priority order: most load-bearing first.
+# bench.py self-degrades on crashes; the microbench/gat steps are
+# best-effort.
+QUEUE = [
+    ("probe_traffic",
+     [sys.executable, "scripts/spmm_microbench.py", "--probe-traffic"],
+     2400),
+    ("microbench_u4",
+     [sys.executable, "scripts/spmm_microbench.py", "--group", "4"],
+     2400),
+    ("bench_u4_f8",
+     [sys.executable, "bench.py", "--block-group", "4",
+      "--rem-dtype", "float8", "--no-compare"],
+     3600),
+    ("bench_u4",
+     [sys.executable, "bench.py", "--block-group", "4", "--no-compare"],
+     3600),
+    ("gat_bench",
+     [sys.executable, "scripts/gat_bench.py"],
+     3600),
+    ("bench_default",
+     [sys.executable, "bench.py"],
+     3600),
+]
+
+
+def probe(timeout_s: float = 60.0) -> bool:
+    """Backend probe in a throwaway subprocess (an in-process failure
+    poisons jax for the process's life — bench.py's pattern)."""
+    code = ("import jax; d = jax.devices(); "
+            "import sys; sys.exit(0 if d and d[0].platform != 'cpu' "
+            "else 1)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_queue(skip: set) -> None:
+    os.makedirs(LOG_DIR, exist_ok=True)
+    for name, argv, tmo in QUEUE:
+        if name in skip:
+            continue
+        if not probe():
+            print(f"# tunnel died before {name}; stopping queue",
+                  flush=True)
+            return
+        log = os.path.join(LOG_DIR, f"{name}.log")
+        t0 = time.time()
+        print(f"# {name}: starting (timeout {tmo}s)", flush=True)
+        try:
+            with open(log, "w") as f:
+                r = subprocess.run(argv, cwd=REPO, stdout=f,
+                                   stderr=subprocess.STDOUT, timeout=tmo)
+            status = f"rc={r.returncode}"
+            if r.returncode == 0:
+                skip.add(name)
+        except subprocess.TimeoutExpired:
+            status = "timeout"
+        print(f"# {name}: {status} ({time.time() - t0:.0f}s) -> {log}",
+              flush=True)
+        with open(os.path.join(LOG_DIR, "status.json"), "w") as f:
+            json.dump({"done": sorted(skip), "ts": time.time()}, f)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--poll-s", type=float, default=300.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+    done: set = set()
+    status = os.path.join(LOG_DIR, "status.json")
+    if os.path.exists(status):
+        with open(status) as f:
+            done = set(json.load(f).get("done", []))
+    while True:
+        if probe():
+            print("# tunnel UP — running measurement queue", flush=True)
+            run_queue(done)
+            if all(name in done for name, _, _ in QUEUE):
+                print("# queue complete", flush=True)
+                return
+        elif args.once:
+            print("# tunnel down", flush=True)
+            return
+        if args.once:
+            return
+        time.sleep(args.poll_s)
+
+
+if __name__ == "__main__":
+    main()
