@@ -75,7 +75,8 @@ class ModelConfig:
     # (block_spmm._group_union; measured F-tile dedupe headroom in
     # docs/PERF_NOTES.md). 1 = per-tile K-class layout
     block_group: int = 1
-    # gather-transport dtype for the bucket kernel / block remainder
+    # gather-transport dtype for the bucket kernel / block remainder /
+    # GAT attention kernel's wide value+cotangent gathers
     # (bucket_spmm.transport_dtypes): None = activation dtype;
     # 'float8' = e4m3 activations / e5m2 cotangents — halves gathered
     # rows at F=256 (the gather path is request-rate-bound at 256-byte

@@ -302,6 +302,7 @@ def make_device_gat_fn(
     slope: float,
     chunk_elems: int = DEFAULT_CHUNK_ELEMS,
     chunk_edges: Optional[int] = None,
+    rem_dtype: Optional[str] = None,
 ):
     """Bind one device's tables (leading axis stripped) into a
     differentiable closure gat(z, el, er) -> [n_dst, H, dh] f32:
@@ -311,7 +312,17 @@ def make_device_gat_fn(
     z [R, H, dh] (any float dtype), el [R, H] f32, er [n_dst, H] f32.
     The VJP returns (dz, del, der); everything around the aggregation
     (W matmul, a_src/a_dst products, head merge, bias) stays standard
-    autodiff in the model."""
+    autodiff in the model.
+
+    `rem_dtype` narrows the WIDE gather transports only
+    (bucket_spmm.transport_dtypes): z values travel e4m3 through the
+    forward and both backward contractions (the same quantized values
+    everywhere, so the VJP matches the quantized forward), the
+    cotangent slabs travel e5m2; attention logits, softmax stats, and
+    every accumulation stay f32."""
+    from .bucket_spmm import transport_cast, transport_dtypes
+
+    fwd_dt, bwd_dt = transport_dtypes(rem_dtype)
     fwd_keys = sorted(k for k in d if k.startswith("gat_fwd_")
                       and "rows" not in k and not k.endswith("inv"))
     bwd_keys = sorted(k for k in d if k.startswith("gat_bwd_")
@@ -332,9 +343,10 @@ def make_device_gat_fn(
         Returns (out [n_dst,H,dh] f32 normalized, m, s [n_dst,H])."""
         H, dh = z.shape[1], z.shape[2]
         F = H * dh
-        slab, n_slabs = _slab_layout(F, dh, z.dtype.itemsize)
+        zq = transport_cast(z, fwd_dt)
+        slab, n_slabs = _slab_layout(F, dh, zq.dtype.itemsize)
         z_pad = jnp.concatenate(
-            [z.reshape(R, F), jnp.zeros((1, F), z.dtype)])
+            [zq.reshape(R, F), jnp.zeros((1, F), zq.dtype)])
         slabs = _make_slabs(z_pad, slab, n_slabs)
         el_pad = jnp.concatenate(
             [el, jnp.full((1, H), -jnp.inf, jnp.float32)])
@@ -388,9 +400,12 @@ def make_device_gat_fn(
         g = g.astype(jnp.float32)
         rho = (g * out).sum(-1)                            # [n_dst, H]
 
-        slab, n_slabs = _slab_layout(F, dh, z.dtype.itemsize)
+        zq = transport_cast(z, fwd_dt)  # the SAME quantized values the
+        # forward consumed — pass A's contractions then differentiate
+        # the quantized forward exactly
+        slab, n_slabs = _slab_layout(F, dh, zq.dtype.itemsize)
         z_pad = jnp.concatenate(
-            [z.reshape(R, F), jnp.zeros((1, F), z.dtype)])
+            [zq.reshape(R, F), jnp.zeros((1, F), zq.dtype)])
         z_slabs = _make_slabs(z_pad, slab, n_slabs)
         el_pad = jnp.concatenate(
             [el, jnp.full((1, H), -jnp.inf, jnp.float32)])
@@ -436,12 +451,18 @@ def make_device_gat_fn(
                 jnp.zeros((1, H)), jnp.full((1, H), jnp.inf),
                 jnp.ones((1, H)), jnp.zeros((1, H))], axis=1
             ).astype(jnp.float32)])
+        g_t = transport_cast(g, bwd_dt) if bwd_dt is not None \
+            else g.astype(z.dtype)
+        slab_g, n_slabs_g = _slab_layout(F, dh, g_t.dtype.itemsize)
         g_pad = jnp.concatenate(
-            [g.astype(z.dtype).reshape(n_dst, F),
-             jnp.zeros((1, F), z.dtype)])
-        g_slabs = _make_slabs(g_pad, slab, n_slabs)
-        z_pad3 = jnp.concatenate([z.astype(jnp.float32),
-                                  jnp.zeros((1, H, dh), jnp.float32)])
+            [g_t.reshape(n_dst, F), jnp.zeros((1, F), g_t.dtype)])
+        g_slabs = _make_slabs(g_pad, slab_g, n_slabs_g)
+        # rowvec z values must be the SAME quantized values the forward
+        # consumed (zq), or pass B's dl = alpha*(c - rho) mixes
+        # unquantized z against quantized-forward rho and biases d_el
+        z_pad3 = jnp.concatenate([
+            zq.astype(jnp.float32).reshape(R, H, dh),
+            jnp.zeros((1, H, dh), jnp.float32)])
 
         dzs, dels = [], []
         for mat, rows in bwd:
@@ -459,7 +480,7 @@ def make_device_gat_fn(
                 alpha = jnp.exp(_leaky(l_pre, slope) - m_g) / s_g
                 z_r = jnp.take(z_pad3, rr, axis=0)          # [r, H, dh]
                 dz_b, c = _gather_weighted_contract(
-                    g_slabs, idx, alpha, z_r, slab, dh,
+                    g_slabs, idx, alpha, z_r, slab_g, dh,
                     jnp.zeros((idx.shape[0], H, dh), jnp.float32))
                 dl = alpha * (c - rho_g)
                 del_b = (dl * _dleaky(l_pre, slope)).sum(axis=1)

@@ -104,6 +104,7 @@ class ShardedEvaluator:
             # tables ride in the data exactly like the mean kernels')
             gat = trainer.make_device_gat_closure(
                 d, n_max=n_max, n_src_rows=n_max + sg.halo_size,
+                transport=False,
             ) if use_tables else None
             logits, _ = forward(
                 params, self._cfg, d["feat"], d["edge_src"],

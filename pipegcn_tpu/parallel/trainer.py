@@ -534,10 +534,14 @@ class Trainer:
 
     def make_device_gat_closure(self, d: Dict[str, jax.Array],
                                 n_max: Optional[int] = None,
-                                n_src_rows: Optional[int] = None):
+                                n_src_rows: Optional[int] = None,
+                                transport: bool = True):
         """Per-device attention-aggregation closure (ops/gat_bucket.py)
         over the stripped table arrays in `d` — or None when `d`
-        carries no attention-bucket tables (raw-edge GAT path)."""
+        carries no attention-bucket tables (raw-edge GAT path).
+        transport=False exempts one-shot metric-bearing consumers from
+        the narrowed gather transport (same contract as
+        make_device_spmm_closure)."""
         if "gat_fwd_inv" not in d:
             return None
         from ..ops.gat_bucket import make_device_gat_fn
@@ -549,6 +553,7 @@ class Trainer:
         return make_device_gat_fn(
             d, n_max, n_src_rows, cfg.n_heads, cfg.leaky_slope,
             chunk_edges=cfg.spmm_chunk,
+            rem_dtype=cfg.rem_dtype if transport else None,
         )
 
     def _build_step(self):

@@ -37,6 +37,10 @@ def main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--rem-dtype", default="none",
+                    choices=["none", "bfloat16", "float8"],
+                    help="wide-gather transport narrowing "
+                         "(ModelConfig.rem_dtype)")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -57,6 +61,7 @@ def main():
         model="gat", n_heads=args.heads, norm="layer", dropout=0.5,
         train_size=sg.n_train_global, spmm_impl=args.impl,
         spmm_chunk=2_097_152, dtype="bfloat16",
+        rem_dtype=args.rem_dtype,
     )
     tcfg = TrainConfig(lr=0.01, n_epochs=args.epochs * (args.reps + 2),
                        enable_pipeline=True, eval=False,
@@ -86,7 +91,9 @@ def main():
     import json
 
     print(json.dumps({
-        "metric": f"gat_{args.impl}_epoch_time",
+        "metric": f"gat_{args.impl}_epoch_time"
+                  + ("" if args.rem_dtype == "none"
+                     else f"_{args.rem_dtype}"),
         "value": round(min(times), 4),
         "unit": "s/epoch",
         "heads": args.heads,

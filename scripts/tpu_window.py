@@ -45,6 +45,9 @@ QUEUE = [
     ("gat_bench",
      [sys.executable, "scripts/gat_bench.py"],
      3600),
+    ("gat_bench_f8",
+     [sys.executable, "scripts/gat_bench.py", "--rem-dtype", "float8"],
+     3600),
     ("bench_default",
      [sys.executable, "bench.py"],
      3600),
