@@ -146,6 +146,35 @@ def main():
               f"A-collapsed {a0[0]*1e3:.1f} ms "
               f"(A-read share {(dense[0]-a0[0])*1e3:.1f} ms)")
 
+        # Unpack-transient probe: the same plan with A PRE-UNPACKED to
+        # bf16 on the host — no device-side bit unpack, so the
+        # [rows, K, T, S] elementwise transient (which XLA materializes
+        # between HBM round-trips; it cannot fuse producers into a dot)
+        # disappears, at the price of 16x the A-read bytes. If packed
+        # is SLOWER than wide here, the transient dominates the A term
+        # and a fused Pallas unpack+matmul kernel is worth building
+        # (docs/PERF_NOTES.md round-3 session-2 hypothesis). Note the
+        # a0 surgery above does NOT isolate this: collapsing indices to
+        # block 0 still unpacks every slot.
+        if "blk_a_bits" in d:
+            packed_bits = d.pop("blk_a_bits")
+            # np.unpackbits is the exact inverse of pack_a_blocks
+            # (bitorder='little'); upload the narrow uint8 and cast to
+            # bf16 eagerly on device (16x less tunnel traffic than a
+            # host-widened array)
+            d["blk_a"] = jnp.asarray(np.unpackbits(
+                np.asarray(packed_bits), axis=-1, bitorder="little"
+            )).astype(jnp.bfloat16)
+            try:
+                unp = variant("wide-A-dense", dense_keep)
+            finally:
+                del d["blk_a"]
+                d["blk_a_bits"] = packed_bits
+            print("# unpack probe (fwd): packed "
+                  f"{dense[0]*1e3:.1f} ms vs pre-unpacked bf16 "
+                  f"{unp[0]*1e3:.1f} ms (transient-minus-read delta "
+                  f"{(dense[0]-unp[0])*1e3:.1f} ms)")
+
 
 if __name__ == "__main__":
     main()
