@@ -106,3 +106,28 @@ def test_gcn_bf16_tracks_f32(graph):
         losses[dt] = [t.train_epoch(e) for e in range(8)]
     np.testing.assert_allclose(losses["float32"], losses["bfloat16"],
                                rtol=0.05, atol=0.05)
+
+
+def test_gcn_float8_transport_converges(graph):
+    """GCN + rem_dtype='float8': layer 0 aggregates RAW input features
+    through the narrowed transport (no use_pp for GCN) — the
+    saturating-cast path — and training must track full precision and
+    keep converging."""
+    import dataclasses
+
+    parts = partition_graph(graph, 4, seed=0)
+    sg = ShardedGraph.build(graph, parts, n_parts=4)
+    base = ModelConfig(
+        layer_sizes=(sg.n_feat, 16, sg.n_class), model="gcn",
+        norm="layer", dropout=0.0, train_size=sg.n_train_global,
+        spmm_impl="bucket",
+    )
+    losses = {}
+    for rd in (None, "float8"):
+        cfg = dataclasses.replace(base, rem_dtype=rd)
+        t = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True))
+        losses[rd] = [t.train_epoch(e) for e in range(15)]
+    l32, l8 = np.asarray(losses[None]), np.asarray(losses["float8"])
+    assert np.isfinite(l8).all()
+    np.testing.assert_allclose(l8[:4], l32[:4], rtol=0.1, atol=0.05)
+    assert l8[-1] < l8[0] * 0.8
