@@ -207,6 +207,9 @@ def main():
                          "120/300/600s schedule")
     ap.add_argument("--cpu", action="store_true",
                     help="run on CPU without probing the TPU backend")
+    ap.add_argument("--force-candidate", action="store_true",
+                    help=argparse.SUPPRESS)  # CPU test hook for the
+    # candidate-config pass (normally TPU-gated)
     ap.add_argument(_STAGE_FLAG, type=int, default=0, dest="stage",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -494,6 +497,67 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                   f"(speedup {van_s / pipe_s:.3f}x)", file=sys.stderr)
             del other
 
+        # ---- candidate-config pass ------------------------------------
+        # The union-gather + fp8 stack (--block-group 4 --rem-dtype
+        # float8) is parity/accuracy-validated but may not yet have a
+        # chip measurement; when the headline ran at defaults on the
+        # real chip, measure it too (one extra trainer build — the
+        # kernel tables are disk-cached) and report the better of the
+        # two as the headline, with BOTH measurements recorded.
+        # Crash-isolated by the enclosing try: a failure here must
+        # never cost the in-hand default number.
+        if (((backend == "tpu" and not args.small)
+             or args.force_candidate)
+                and not extras.get("degraded")
+                and args.spmm_impl in ("auto", "block")
+                and args.block_group == 1 and args.rem_dtype == "none"):
+            try:
+                # free the headline trainer's HBM before compiling a
+                # second full-scale program (the compare path already
+                # deleted it; with --no-compare it is still resident
+                # and two programs can OOM the chip)
+                del trainer
+            except UnboundLocalError:
+                pass
+            cand_cfg = dataclasses.replace(
+                cfg, spmm_impl="block", block_group=4,
+                rem_dtype="float8")
+            t0 = time.perf_counter()
+            tr_c = Trainer(sg, cand_cfg, TrainConfig(
+                lr=0.01, n_epochs=args.blocks * blk,
+                enable_pipeline=headline_pipeline, seed=0, eval=False,
+                fused_epochs=blk))
+            cand_s, _, _ = time_trainer(tr_c, max(3, args.blocks // 2),
+                                        force_blk=used_blk)
+            print(f"# candidate block-u4-float8: {cand_s:.4f}s/epoch "
+                  f"(total {time.perf_counter()-t0:.0f}s)",
+                  file=sys.stderr)
+            extras["default_epoch_s"] = round(epoch_s, 4)
+            extras["candidate_epoch_s"] = round(cand_s, 4)
+            if cand_s < epoch_s:
+                epoch_s = cand_s
+                extras["headline_config"] = "block-u4-float8"
+                extras["spmm_impl"] = "block"
+                # the flops/bytes/mfu extras described the DEFAULT
+                # program; recompute them from the winning one (fp8
+                # transport exists precisely to change bytes moved)
+                try:
+                    ca = tr_c.step_cost_analysis()
+                    if ca:
+                        fl = ca.get("flops", 0.0) * n_parts
+                        extras["flops_per_epoch"] = round(fl)
+                        extras["est_hbm_bytes_per_epoch"] = round(
+                            ca.get("bytes accessed", 0.0) * n_parts)
+                        peak = peak_flops_for(device_kind)
+                        if peak and fl:
+                            extras["mfu_pct"] = round(
+                                100.0 * fl / (cand_s * peak * n_parts),
+                                2)
+                except Exception as exc:
+                    print(f"# candidate cost analysis unavailable: "
+                          f"{exc}", file=sys.stderr)
+            del tr_c
+
         # ---- optional SpMM implementation sweep -----------------------
         if args.sweep_spmm:
             sweep = {}
@@ -584,15 +648,23 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
             os.makedirs(os.path.dirname(last_path), exist_ok=True)
             tmp = last_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({
+                rec = {
                     "metric": metric, "value": result["value"],
                     "unit": "s/epoch",
                     "vs_baseline": result["vs_baseline"],
                     "backend": backend, "device": device_kind,
-                    "spmm_impl": args.spmm_impl, "dtype": extras["dtype"],
+                    # the config that PRODUCED the number (the
+                    # candidate pass may have taken the headline)
+                    "spmm_impl": extras["spmm_impl"],
+                    "dtype": extras["dtype"],
                     "measured_utc": datetime.datetime.now(
                         datetime.timezone.utc).isoformat(),
-                }, f)
+                }
+                if extras.get("headline_config"):
+                    rec["headline_config"] = extras["headline_config"]
+                    rec["block_group"] = 4
+                    rec["rem_dtype"] = "float8"
+                json.dump(rec, f)
             os.replace(tmp, last_path)  # atomic: a mid-write kill must
             # not destroy the previous good record
         except OSError:

@@ -94,8 +94,12 @@ def main():
         sg = ShardedGraph.build(g, parts, n_parts=1, cluster=cluster)
         n_src_tiles = -(-(sg.n_max + sg.halo_size) // tile)
         build_s = time.time() - t0
+        seen_thr = set()
         for thr0 in args.nnz:
             thr = thr0 or max(1, (tile * tile) // 602)
+            if thr in seen_thr:  # 0 resolves to the break-even, which
+                continue         # may duplicate an explicit entry
+            seen_thr.add(thr)
             cov, n_dense, dense_e, tot_e = _part_block_stats(
                 sg, 0, tile, n_src_tiles, thr, max_blocks=cap)
             rem_e = tot_e - dense_e
