@@ -527,8 +527,8 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                 lr=0.01, n_epochs=args.blocks * blk,
                 enable_pipeline=headline_pipeline, seed=0, eval=False,
                 fused_epochs=blk))
-            cand_s, _, _ = time_trainer(tr_c, max(3, args.blocks // 2),
-                                        force_blk=used_blk)
+            cand_s, cand_loss, _ = time_trainer(
+                tr_c, max(3, args.blocks // 2), force_blk=used_blk)
             print(f"# candidate block-u4-float8: {cand_s:.4f}s/epoch "
                   f"(total {time.perf_counter()-t0:.0f}s)",
                   file=sys.stderr)
@@ -538,6 +538,38 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                 epoch_s = cand_s
                 extras["headline_config"] = "block-u4-float8"
                 extras["spmm_impl"] = "block"
+                # loss and ICI bytes described the default run too —
+                # keep every published field's provenance the winner's.
+                # The candidate trains fewer blocks than the default, so
+                # record the basis alongside the loss.
+                extras["loss"] = (round(cand_loss, 4)
+                                  if np.isfinite(cand_loss) else None)
+                extras["loss_blocks"] = max(3, args.blocks // 2)
+                extras["est_ici_bytes_per_epoch"] = (
+                    tr_c.est_ici_bytes_per_epoch())
+                # coverage depends only on (sg, tile, threshold) — if
+                # the default headline already published it, the value
+                # is identical; only fill the gap when the default ran
+                # a non-block kernel
+                if (tr_c._block_tables is not None
+                        and "dense_coverage" not in extras):
+                    from pipegcn_tpu.ops.block_spmm import (
+                        estimate_block_coverage)
+                    w_hint = max(cfg.layer_sizes[:cfg.n_graph_layers])
+                    extras["dense_coverage"] = round(
+                        estimate_block_coverage(
+                            sg, args.block_tile, w_hint,
+                            nnz_threshold=args.block_nnz or None), 3)
+                    extras["dense_blocks"] = int(
+                        next(v for k, v in tr_c._block_tables.items()
+                             if k in ("blk_a", "blk_a_bits")).shape[1])
+                # the vanilla-vs-pipelined comparison (if it ran) was
+                # measured on the DEFAULT config — relabel so no one
+                # divides default vanilla time by the candidate headline
+                for k in ("vanilla_epoch_s", "pipelined_epoch_s",
+                          "pipeline_speedup"):
+                    if k in extras:
+                        extras[f"default_{k}"] = extras.pop(k)
                 # the flops/bytes/mfu extras described the DEFAULT
                 # program; recompute them from the winning one (fp8
                 # transport exists precisely to change bytes moved)

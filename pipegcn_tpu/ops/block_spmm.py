@@ -211,9 +211,11 @@ def _group_union(keys: np.ndarray, others: np.ndarray, n_key_tiles: int,
     widths_arr = np.asarray(widths, dtype=np.int64)
     max_u = int(u_of_group.max(initial=0))
     if max_u > widths[-1]:
-        raise ValueError(
-            f"union-width ladder {tuple(widths)} tops out below the max "
-            f"group union size {max_u}; blocks would be dropped")
+        # an explicitly passed ladder (e.g. reused from a group=1
+        # layout) may top out below this device's max union size;
+        # extend it rather than dropping blocks
+        widths += [w for w in _bucket_widths(max_u) if w > widths[-1]]
+        widths_arr = np.asarray(widths, dtype=np.int64)
     wid = np.minimum(np.searchsorted(widths_arr, np.maximum(u_of_group, 1)),
                      len(widths) - 1)
 

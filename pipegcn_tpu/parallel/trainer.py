@@ -348,8 +348,12 @@ class Trainer:
                 "prewarm_tables needs a disk-backed artifact "
                 "(sg.cache_dir unset — load the ShardedGraph from disk "
                 "or set cache_dir); the build would be discarded")
-        cacheable = cfg.spmm_impl in ("bucket", "block") or (
-            cfg.model == "gat" and cfg.spmm_impl in ("auto", "bucket"))
+        if cfg.model == "gat":
+            # the gat setup branch only builds tables for auto/bucket
+            # and returns early — block would silently warm nothing
+            cacheable = cfg.spmm_impl in ("auto", "bucket")
+        else:
+            cacheable = cfg.spmm_impl in ("bucket", "block")
         if not cacheable:
             raise ValueError(
                 f"spmm_impl={cfg.spmm_impl!r} does not disk-cache "

@@ -297,6 +297,27 @@ def test_auto_picks_block_on_clustered_large_shards(monkeypatch):
     assert t_uniform._bucket_tables is not None
 
 
+def test_group_union_extends_short_ladder():
+    """An explicitly passed union-width ladder that tops out below the
+    device's max union size is extended, not a hard failure — direct
+    BlockPlan callers may reuse a group=1 layout's K-class ladder."""
+    from pipegcn_tpu.ops.block_spmm import _group_union
+
+    # one group of 4 key tiles referencing 6 distinct other-tiles:
+    # union size 6 > ladder max 2
+    keys = np.array([0, 1, 2, 3, 0, 1], np.int64)
+    others = np.array([0, 1, 2, 3, 4, 5], np.int64)
+    classes, inv, counts, widths = _group_union(
+        keys, others, n_key_tiles=4, n_other_tiles=6, group=4,
+        n_blocks_pad=6, widths=[1, 2])
+    assert widths[-1] >= 6  # ladder extended to cover the union
+    total_rows = sum(c for c in counts)
+    assert total_rows == 1  # the single group landed in some class
+    # every block is placed: the widest class holds all 6 union slots
+    a_idx, t_mat = classes[-1]
+    assert (t_mat[0] != 6).sum() == 6
+
+
 @pytest.mark.parametrize("group", [2, 4])
 def test_block_grouped_union_matches_dense(edges, group):
     """Union-gather layout (block_group > 1): consecutive dst tiles

@@ -254,6 +254,11 @@ def test_prewarm_tables_guards_and_caches(tmp_path):
     with pytest.raises(ValueError, match="prewarm"):
         Trainer.prewarm_tables(
             sg2, dataclasses.replace(cfg, spmm_impl="xla"))
+    # gat's setup only builds tables for auto/bucket — block is
+    # rejected at config construction, so prewarm can never silently
+    # warm nothing for it
+    with pytest.raises(ValueError, match="gat"):
+        dataclasses.replace(cfg, model="gat", spmm_impl="block")
 
     Trainer.prewarm_tables(sg2, cfg)
     assert os.path.exists(os.path.join(path, "bucket_tables.npz"))
