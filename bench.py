@@ -195,6 +195,10 @@ def main():
     ap.add_argument("--block-group", type=int, default=1,
                     help="union-gather group size for the block "
                          "kernel's dense path (1 = per-tile lists)")
+    ap.add_argument("--block-fused", action="store_true",
+                    help="fused unpack+matmul Pallas kernel for the "
+                         "union-gather dense path (needs --block-group "
+                         "> 1)")
     ap.add_argument("--rem-dtype", default="none",
                     choices=["none", "bfloat16", "float8"],
                     help="gather-transport dtype for the remainder "
@@ -334,6 +338,7 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         block_tile=args.block_tile,
         block_nnz=args.block_nnz or None,
         block_group=args.block_group,
+        block_fused=args.block_fused,
         rem_dtype=args.rem_dtype,  # 'none' normalized by ModelConfig
     )
     blk = max(1, args.fused)

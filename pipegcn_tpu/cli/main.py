@@ -223,6 +223,7 @@ def run(args) -> dict:
         block_tile=args.block_tile,
         block_nnz=args.block_nnz or None,
         block_group=args.block_group,
+        block_fused=args.block_fused,
         rem_dtype=args.rem_dtype,  # 'none' normalized by ModelConfig
         dtype=args.dtype,
     )

@@ -129,6 +129,11 @@ def create_parser() -> argparse.ArgumentParser:
                              "dst tiles share one gathered source-tile "
                              "union in the block kernel's dense path "
                              "(1 = per-tile block lists)")
+    parser.add_argument("--block-fused", "--block_fused",
+                        action="store_true",
+                        help="fused unpack+matmul Pallas kernel for the "
+                             "union-gather dense path (needs "
+                             "--block-group > 1; experimental)")
     parser.add_argument("--rem-dtype", "--rem_dtype",
                         choices=["none", "bfloat16", "float8"],
                         default="none",

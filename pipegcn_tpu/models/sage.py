@@ -75,6 +75,12 @@ class ModelConfig:
     # (block_spmm._group_union; measured F-tile dedupe headroom in
     # docs/PERF_NOTES.md). 1 = per-tile K-class layout
     block_group: int = 1
+    # fused unpack+matmul Pallas kernel for the union-gather dense path
+    # (ops/fused_block.py): keeps the gathered A blocks and F-tile
+    # unions in VMEM instead of XLA's two HBM transients. Requires the
+    # grouped layout (block_group > 1). Experimental until a chip
+    # measurement lands (docs/PERF_NOTES.md)
+    block_fused: bool = False
     # gather-transport dtype for the bucket kernel / block remainder /
     # GAT attention kernel's wide value+cotangent gathers
     # (bucket_spmm.transport_dtypes): None = activation dtype;
@@ -98,6 +104,10 @@ class ModelConfig:
             raise ValueError(
                 f"unknown rem_dtype: {self.rem_dtype!r} "
                 "(none | bfloat16 | float8)")
+        if self.block_fused and self.block_group <= 1:
+            raise ValueError(
+                "block_fused needs the union-gather layout "
+                "(block_group > 1)")
         if self.model in ("gcn", "gat") and self.use_pp:
             # the pp precompute caches SAGE's mean-neighbor concat;
             # gcn/gat first layers aggregate like every other layer

@@ -1,0 +1,197 @@
+"""Fused unpack+matmul Pallas kernel for the union-gather dense path.
+
+The production block kernel (ops/block_spmm.py) covers ~80% of edges
+with bit-packed dense tiles contracted on the MXU — the TPU-native
+replacement for the reference's DGL SpMM (reference module/layer.py:
+47-49). Its XLA formulation pays two HBM transients per contraction
+that this kernel eliminates (docs/PERF_NOTES.md "fused unpack+matmul"
+design note, measured as the ~0.3 s/epoch A/F-collapse deltas of the
+--probe-traffic microbench):
+
+  1. the device-side bit-unpack MATERIALIZES the gathered A blocks as
+     a [rows, G, U, T, S] bf16 tensor between two HBM round-trips
+     (XLA does not fuse elementwise producers into a dot), ~264 KB
+     realized per 8 KB packed block;
+  2. the gathered F-tile unions ([rows, U, S, F]) round-trip HBM once
+     more between the gather and the einsum.
+
+Here both stay in VMEM: the grid walks (row, union-slot); each step's
+[S, F] source tile arrives through the scalar-prefetch BlockSpec
+pipeline (auto double-buffered by Pallas), the G referenced 8 KB
+packed A blocks arrive through manually double-buffered async DMAs,
+the bit-unpack runs on the VPU registers-to-registers, and the MXU
+accumulates straight into a VMEM-resident [G, T, F] f32 output block.
+Per-row HBM traffic drops to exactly the packed bytes + each union
+tile once.
+
+Layout contract: A blocks are bit-packed along the SUBLANE (row) axis
+— uint8 [B, T//8, S], bit k of packed[b, i, s] = A[b, 8i+k, s],
+little-endian — produced by repack_bits_sublane from the stored
+lane-packed tables. Sublane packing unpacks with a lane-preserving
+repeat + shift, which Mosaic lowers without relayouts (the lane-packed
+[T, S//8] layout would put a 32-wide minor axis in VMEM).
+
+STATUS: measured-gate pending (the previous Pallas kernel is demoted
+precisely for lacking a winning regime — ops/pallas_spmm.py). Reached
+only via --block-fused; `auto` never selects it until a chip
+measurement lands.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+
+
+def repack_bits_sublane(a_bits: np.ndarray,
+                        chunk: int = 2048) -> np.ndarray:
+    """Lane-packed [B, T, S//8] uint8 (pack_a_blocks) -> sublane-packed
+    [B, T//8, S] uint8, chunked so the unpacked bool transient stays
+    ~chunk * T * S bytes."""
+    B, T, S8 = a_bits.shape
+    assert T % 8 == 0, a_bits.shape
+    out = np.empty((B, T // 8, S8 * 8), np.uint8)
+    for i in range(0, max(B, 1), chunk):
+        blk = a_bits[i:i + chunk]
+        bits = np.unpackbits(blk, axis=-1, bitorder="little")
+        out[i:i + chunk] = np.packbits(
+            bits.reshape(blk.shape[0], T // 8, 8, S8 * 8),
+            axis=2, bitorder="little")[:, :, 0, :]
+    return out
+
+
+def _unpack_sublane(x: jax.Array, compute_dtype) -> jax.Array:
+    """Kernel-side inverse of repack_bits_sublane on one [T//8, S]
+    uint8 block -> [T, S] in the compute dtype. repeat(8, axis=0) puts
+    packed row t//8 at row t; the shift selects bit t%8."""
+    xi = jnp.repeat(x.astype(jnp.int32), 8, axis=0)
+    shift = jax.lax.broadcasted_iota(jnp.int32, xi.shape, 0) % 8
+    return ((xi >> shift) & 1).astype(compute_dtype)
+
+
+def _fused_kernel(a_idx, t_mat, a_hbm, tile_ref, out_ref, a_buf, sems,
+                  *, G: int, transpose: bool, compute_dtype):
+    """grid (R, U): r = union-class row, u = union slot (innermost, the
+    reduction dim — the out block stays VMEM-resident across it)."""
+    r = pl.program_id(0)
+    u = pl.program_id(1)
+    n_u = pl.num_programs(1)
+
+    def a_dma(slot, uu, g):
+        return pltpu.make_async_copy(
+            a_hbm.at[a_idx[r, g, uu]], a_buf.at[slot, g],
+            sems.at[slot, g])
+
+    @pl.when(u == 0)
+    def _():
+        for g in range(G):
+            a_dma(0, 0, g).start()
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(u + 1 < n_u)
+    def _():
+        for g in range(G):
+            a_dma((u + 1) % 2, u + 1, g).start()
+
+    slot = u % 2
+    tile = tile_ref[0]  # [S, F] fwd / [T, F] bwd, compute dtype
+    # contract over s (fwd: out[t,f] += A[t,s] F[s,f]) or over t (bwd:
+    # out[s,f] += A[t,s] g[t,f]); square tiles, so both emit [T, F]
+    dims = (((0,), (0,)), ((), ())) if transpose \
+        else (((1,), (0,)), ((), ()))
+    for g in range(G):
+        a_dma(slot, u, g).wait()
+        a = _unpack_sublane(a_buf[slot, g], compute_dtype)
+        out_ref[0, g] += jax.lax.dot_general(
+            a, tile, dims, preferred_element_type=jnp.float32)
+
+
+def fused_union_apply(a_bits_t: jax.Array, a_idx: jax.Array,
+                      t_mat: jax.Array, tiles: jax.Array, tile_size: int,
+                      transpose: bool = False,
+                      interpret: bool = False,
+                      vma: Optional[frozenset] = None) -> jax.Array:
+    """One union-width class: a_idx [R, G, U] int32 (pad -> the zero
+    block B), t_mat [R, U] int32 (pad -> the zero tile), a_bits_t
+    [B+1, T//8, S] uint8 (zero block appended), tiles
+    [n_tiles+1, S|T, F] in the compute dtype -> [R, G, T, F] f32.
+    `vma` = the enclosing shard_map's varying mesh axes (check_vma
+    needs the pallas output annotated)."""
+    R, G, U = a_idx.shape
+    T = tile_size
+    F = tiles.shape[-1]
+    f_pad = -F % _LANE
+    if f_pad:
+        tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, f_pad)))
+    fp = F + f_pad
+    compute_dtype = tiles.dtype
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R, U),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # a_bits_t: manual DMA
+            pl.BlockSpec(
+                (1, tiles.shape[1], fp),
+                lambda r, u, a_ref, t_ref: (t_ref[r, u], 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, G, T, fp), lambda r, u, a_ref, t_ref: (r, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, G, T // 8, T), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2, G)),
+        ],
+    )
+    sds = (jax.ShapeDtypeStruct((R, G, T, fp), jnp.float32, vma=vma)
+           if vma is not None
+           else jax.ShapeDtypeStruct((R, G, T, fp), jnp.float32))
+    out = pl.pallas_call(
+        functools.partial(_fused_kernel, G=G, transpose=transpose,
+                          compute_dtype=compute_dtype),
+        grid_spec=grid_spec,
+        out_shape=sds,
+        interpret=interpret,
+    )(a_idx, t_mat, a_bits_t, tiles)
+    return out[..., :F] if f_pad else out
+
+
+def fused_dense_apply_grouped(a_bits_t, classes, inv, tiles, T, out_rows,
+                              n_feat, transpose=False, interpret=False,
+                              vma=None):
+    """Drop-in fused replacement for block_spmm._dense_apply_grouped:
+    same class/inv layout, the per-class compute is the Pallas kernel.
+    Row-chunking bounds the scalar-prefetch tables (SMEM-resident
+    a_idx/t_mat), not an HBM transient — there is none."""
+    from .block_spmm import _apply_classes, _DENSE_CHUNK_ELEMS
+
+    # pad F once, OUTSIDE the per-chunk compute: inside _apply_classes's
+    # scan the pad would recopy the full tile buffer every chunk
+    f_pad = -n_feat % _LANE
+    if f_pad:
+        tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, f_pad)))
+
+    def compute(ai, ti):
+        out = fused_union_apply(a_bits_t, ai, ti, tiles, T,
+                                transpose=transpose,
+                                interpret=interpret, vma=vma)
+        return out[..., :n_feat] if f_pad else out
+
+    def per_row_elems(mats):
+        # target ~64 KB of int32 scalar-prefetch per chunk: rpc =
+        # _DENSE_CHUNK_ELEMS // per_row_elems ~= 16384 // (G * U)
+        g, u = mats[0].shape[1], mats[0].shape[2]
+        return max(1, (_DENSE_CHUNK_ELEMS * g * u) // 16384)
+
+    return _apply_classes(
+        classes, compute, per_row_elems,
+        (a_bits_t.shape[0] - 1, tiles.shape[0] - 1),
+        inv, T, n_feat, out_rows)

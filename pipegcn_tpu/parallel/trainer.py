@@ -271,13 +271,31 @@ class Trainer:
             tile = self.cfg.block_tile
             nnz = self.cfg.block_nnz
             grp = self.cfg.block_group
+            key = (f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else "")
+                   + (f"_u{grp}" if grp > 1 else ""))
             self._block_tables = self._cached_tables(
-                f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else "")
-                + (f"_u{grp}" if grp > 1 else ""),
+                key,
                 lambda: build_sharded_block_tables(
                     self.sg, tile=tile, n_feat_hint=w_hint,
                     nnz_threshold=nnz, group=grp)[0])
             self._block_tile = tile
+            if self.cfg.block_fused:
+                # the fused Pallas path contracts sublane-packed A
+                # (ops/fused_block.py layout contract); derive + cache
+                # the repack next to the base tables
+                if "blk_a_bits" not in self._block_tables:
+                    raise ValueError(
+                        "block_fused needs bit-packed A blocks (edge "
+                        "multiplicity > 1 stores A unpacked)")
+                from ..ops.fused_block import repack_bits_sublane
+
+                self._block_tables = dict(self._block_tables)
+                self._block_tables["blk_a_bits_t"] = self._cached_tables(
+                    key + "_fused",
+                    lambda: {"blk_a_bits_t": np.stack([
+                        repack_bits_sublane(b)
+                        for b in self._block_tables["blk_a_bits"]])},
+                )["blk_a_bits_t"]
 
         def use_large():
             # non-VMEM shards: the hybrid block-dense kernel wins when
@@ -486,6 +504,10 @@ class Trainer:
                 pp, mesh=self.mesh,
                 in_specs=(jax.tree_util.tree_map(lambda _: spec, d_in),),
                 out_specs=spec,
+                # fused block kernel in interpret mode: same VMA
+                # mismatch relaxation as the train step (see _make_step)
+                check_vma=not ("blk_a_bits_t" in d_in
+                               and jax.default_backend() == "cpu"),
             )
         )
         return fn(d_in)
@@ -533,6 +555,8 @@ class Trainer:
             return make_device_block_spmm_fn(
                 d, d["in_deg"], n_max, n_src_rows, self._block_tile,
                 chunk_edges=cfg.spmm_chunk, rem_dtype=rem_dtype,
+                interpret=jax.default_backend() == "cpu",
+                axis_name=PARTS_AXIS if "blk_a_bits_t" in d else None,
             )
         return None
 
@@ -713,7 +737,12 @@ class Trainer:
         }
         # pallas interpret mode (CPU testing) hits an internal VMA
         # mismatch in jax's HLO interpreter; relax the check there only
-        check_vma = not (use_pallas and pallas_interp)
+        # (same for the fused block kernel, whose interpreted
+        # dynamic_slice mixes varying and unvaried operands)
+        fused_interp = (self._block_tables is not None
+                        and "blk_a_bits_t" in self._block_tables
+                        and jax.default_backend() == "cpu")
+        check_vma = not ((use_pallas and pallas_interp) or fused_interp)
         smapped = jax.shard_map(
             step,
             mesh=self.mesh,

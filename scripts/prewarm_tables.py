@@ -28,6 +28,9 @@ def main():
                     choices=["block", "bucket", "gat"])
     ap.add_argument("--group", type=int, default=1)
     ap.add_argument("--block-nnz", type=int, default=0)
+    ap.add_argument("--fused", action="store_true",
+                    help="also warm the sublane-repacked A cache for "
+                         "the fused Pallas dense path (--block-fused)")
     ap.add_argument("--hidden", type=int, default=256)
     args = ap.parse_args()
 
@@ -43,7 +46,8 @@ def main():
         train_size=sg.n_train_global,
         spmm_impl="bucket" if args.impl == "gat" else args.impl,
         block_nnz=args.block_nnz or None,
-        block_group=args.group, dtype="bfloat16",
+        block_group=args.group, block_fused=args.fused,
+        dtype="bfloat16",
     )
     t0 = time.perf_counter()
     Trainer.prewarm_tables(sg, cfg)

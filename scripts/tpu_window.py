@@ -42,6 +42,16 @@ QUEUE = [
     ("bench_u4",
      [sys.executable, "bench.py", "--block-group", "4", "--no-compare"],
      3600),
+    # fused Pallas dense path (ops/fused_block.py) — after the
+    # known-good configs so a bad compile can't burn the headline
+    ("bench_u4_fused",
+     [sys.executable, "bench.py", "--block-group", "4", "--block-fused",
+      "--no-compare"],
+     3600),
+    ("bench_u4_fused_f8",
+     [sys.executable, "bench.py", "--block-group", "4", "--block-fused",
+      "--rem-dtype", "float8", "--no-compare"],
+     3600),
     ("gat_bench",
      [sys.executable, "scripts/gat_bench.py"],
      3600),
