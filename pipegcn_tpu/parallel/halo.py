@@ -24,6 +24,8 @@ train step can ship it to owners for the next epoch.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
@@ -47,6 +49,35 @@ def _bwd_perm(num_parts: int, d: int):
     return [(r, (r - d) % num_parts) for r in range(num_parts)]
 
 
+# Trace-time switch for the exposed-wait measurement ONLY
+# (scripts/overlap_study.py): with identity_collectives() active, the
+# ring ppermutes become identity — same shapes/dtypes/gather/concat
+# structure, zero inter-device traffic. The reference's Comm(s) metric
+# is the per-epoch wait its hooks EXPOSE (helper/timer/comm_timer.py,
+# train.py:366-371); timing a step traced with vs without the permutes
+# yields that exposed cost directly (total - hidden), which HLO def-use
+# structure alone cannot. Data semantics are wrong (each device keeps
+# its own boundary rows) — never use while training for real.
+_IDENTITY_COLLECTIVES = False
+
+
+@contextlib.contextmanager
+def identity_collectives():
+    global _IDENTITY_COLLECTIVES
+    prev = _IDENTITY_COLLECTIVES
+    _IDENTITY_COLLECTIVES = True
+    try:
+        yield
+    finally:
+        _IDENTITY_COLLECTIVES = prev
+
+
+def _ring_permute(blk: jax.Array, axis_name: str, perm) -> jax.Array:
+    if _IDENTITY_COLLECTIVES:
+        return _ensure_varying(blk, axis_name)
+    return jax.lax.ppermute(blk, axis_name, perm)
+
+
 def exchange_blocks(
     h: jax.Array,
     send_idx: jax.Array,
@@ -63,7 +94,7 @@ def exchange_blocks(
     for d in range(1, num_parts):
         blk = jnp.take(h, send_idx[d - 1], axis=0)
         blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
-        blocks.append(jax.lax.ppermute(blk, axis_name, _fwd_perm(num_parts, d)))
+        blocks.append(_ring_permute(blk, axis_name, _fwd_perm(num_parts, d)))
     if not blocks:
         # P=1: no halo, but the empty result must still be marked
         # device-varying so it types consistently as carry state (e.g.
@@ -109,7 +140,7 @@ def return_blocks(
         blk = jax.lax.dynamic_slice_in_dim(
             halo_grad, (d - 1) * b_max, b_max, axis=0
         )
-        outs.append(jax.lax.ppermute(blk, axis_name, _bwd_perm(num_parts, d)))
+        outs.append(_ring_permute(blk, axis_name, _bwd_perm(num_parts, d)))
     if not outs:
         # P=1 empty case: keep the varying type (see exchange_blocks)
         return _ensure_varying(jnp.zeros_like(halo_grad), axis_name)

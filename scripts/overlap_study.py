@@ -27,6 +27,7 @@ Usage:
 """
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -123,6 +124,7 @@ def main():
     from pipegcn_tpu.graph import synthetic_graph
     from pipegcn_tpu.models import ModelConfig
     from pipegcn_tpu.parallel import Trainer, TrainConfig
+    from pipegcn_tpu.parallel.halo import identity_collectives
     from pipegcn_tpu.partition import ShardedGraph, partition_graph
 
     g = synthetic_graph(num_nodes=args.nodes, avg_degree=args.degree,
@@ -139,7 +141,13 @@ def main():
         train_size=sg.n_train_global, dtype="bfloat16",
     )
 
-    def run(pipeline: bool):
+    def run(pipeline: bool, identity: bool = False):
+        guard = identity_collectives() if identity \
+            else contextlib.nullcontext()
+        with guard:
+            return _run_timed(pipeline, identity)
+
+    def _run_timed(pipeline: bool, identity: bool = False):
         t = Trainer(sg, cfg, TrainConfig(
             lr=1e-2, n_epochs=args.epochs, enable_pipeline=pipeline,
             seed=0, eval=False))
@@ -161,11 +169,22 @@ def main():
             jax.block_until_ready(state["params"])
             times.append(time.perf_counter() - t0)
         t.state = state
-        comm = t.measure_comm() if pipeline else None
+        # identity legs would time elided no-op collectives — skip
+        comm = t.measure_comm() if pipeline and not identity else None
         return float(np.median(times)), comm, hlo
 
     pipe_s, comm, pipe_hlo = run(True)
     van_s, _, van_hlo = run(False)
+    # exposed-wait legs: the SAME programs traced with the ring
+    # ppermutes replaced by identity (shapes intact) — the timing
+    # delta is the comm wait the scheduler failed to hide, i.e. the
+    # reference's per-epoch Comm(s) semantics (train.py:366-371)
+    pipe_id_s, _, _ = run(True, identity=True)
+    van_id_s, _, _ = run(False, identity=True)
+    exposed_pipe = max(0.0, pipe_s - pipe_id_s)
+    exposed_van = max(0.0, van_s - van_id_s)
+    overlap_pct = (100.0 * (1.0 - exposed_pipe / exposed_van)
+                   if exposed_van > 0 else float("nan"))
     pipe_dep = _collective_matmul_deps(pipe_hlo)
     van_dep = _collective_matmul_deps(van_hlo)
     coll_s = comm["comm"]
@@ -212,6 +231,32 @@ def main():
         f"| vanilla (synchronous halo) | {van_s:.4f} |",
         f"| pipelined (staleness-1) | {pipe_s:.4f} |",
         f"| halo collectives alone | {coll_s:.4f} |",
+        f"| vanilla, permutes->identity | {van_id_s:.4f} |",
+        f"| pipelined, permutes->identity | {pipe_id_s:.4f} |",
+        "",
+        "## Exposed wait (timing-derived, reference Comm(s) semantics)",
+        "",
+        "Re-tracing each program with the ring ppermutes replaced by",
+        "identity (same shapes, zero traffic) and differencing the",
+        "epoch times yields the comm wait each schedule actually",
+        "EXPOSES — the reference's per-epoch Comm(s)",
+        "(helper/timer/comm_timer.py, train.py:366-371) — rather than",
+        "the standalone collective cost measure_comm reports:",
+        "",
+        "| program | exposed comm s/epoch | % of epoch |",
+        "|---|---|---|",
+        f"| vanilla | {exposed_van:.4f} | "
+        f"{100.0 * exposed_van / van_s:.1f}% |",
+        f"| pipelined | {exposed_pipe:.4f} | "
+        f"{100.0 * exposed_pipe / pipe_s:.1f}% |",
+        "",
+        f"**Overlap: {overlap_pct:.1f}%** of the vanilla exposed wait "
+        "is hidden by the pipelined schedule (reference reports ~94% "
+        "hidden, i.e. 5.9% exposed, on 2 GPUs — README.md:93-94). "
+        "CPU-mesh caveat: collectives here are intra-process copies, "
+        "so both exposures are small and noisy; the same two identity "
+        "legs run unchanged on a real multi-chip mesh (--tpu), where "
+        "this becomes the headline overlap metric.",
         "",
         f"On XLA:CPU the collectives are intra-process copies "
         f"({100.0 * coll_s / van_s:.1f}% of the vanilla epoch), far "

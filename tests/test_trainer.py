@@ -320,3 +320,24 @@ def test_float8_remainder_transport_converges(graph):
                  TrainConfig(seed=4))
     np.testing.assert_array_equal(np.asarray(t8.data["feat"]),
                                   np.asarray(t0.data["feat"]))
+
+
+def test_identity_collectives_switch(graph):
+    """The exposed-wait measurement's trace-time switch
+    (halo.identity_collectives): the step still compiles and runs with
+    ring ppermutes replaced by identity (same shapes), the P>1 losses
+    DIFFER from the real program's (the permutes were actually
+    elided), and the flag restores on exit."""
+    import pipegcn_tpu.parallel.halo as halo
+
+    t_real = _setup(graph, 4, seed=3, enable_pipeline=True)
+    real = [t_real.train_epoch(e) for e in range(3)]
+    with halo.identity_collectives():
+        assert halo._IDENTITY_COLLECTIVES
+        t_id = _setup(graph, 4, seed=3, enable_pipeline=True)
+        ident = [t_id.train_epoch(e) for e in range(3)]
+    assert not halo._IDENTITY_COLLECTIVES
+    assert np.isfinite(ident).all()
+    # with each device keeping its own boundary rows, training history
+    # must diverge from the true exchange
+    assert not np.allclose(real, ident, rtol=1e-6)
