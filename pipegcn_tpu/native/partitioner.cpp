@@ -36,19 +36,46 @@
 
 namespace {
 
+// Non-owning CSR view: the finest level aliases the CALLER's arrays
+// (no 12.8 GB indices copy at papers100M scale) with IMPLICIT unit
+// edge/node weights (null pointers — no 25.6 GB all-ones ewgt).
+// Coarse levels own int32 weights (a merged weight is bounded by the
+// fine edges merged into it, far below 2^31 in practice; saturated on
+// overflow in coarsen()).
+struct CsrView {
+  int64_t n = 0;
+  const int64_t* indptr = nullptr;   // [n+1]
+  const int32_t* indices = nullptr;  // [m]
+  const int32_t* ewgt = nullptr;     // [m]; null => all edges weight 1
+  const int32_t* nwgt = nullptr;     // [n]; null => all nodes weight 1
+  int64_t m() const { return indptr[n]; }
+};
+
+inline int64_t ew(const CsrView& g, int64_t e) {
+  return g.ewgt ? (int64_t)g.ewgt[e] : 1;
+}
+inline int64_t nw(const CsrView& g, int64_t u) {
+  return g.nwgt ? (int64_t)g.nwgt[u] : 1;
+}
+
 struct Csr {
   int64_t n = 0;
   std::vector<int64_t> indptr;   // [n+1]
   std::vector<int32_t> indices;  // [m] neighbor ids
-  std::vector<int64_t> ewgt;     // [m] edge weights
-  std::vector<int64_t> nwgt;     // [n] node weights
+  std::vector<int32_t> ewgt;     // [m] edge weights
+  std::vector<int32_t> nwgt;     // [n] node weights
+
+  CsrView view() const {
+    return {n, indptr.data(), indices.data(), ewgt.data(), nwgt.data()};
+  }
 };
 
 // ---------------------------------------------------------------------
 // Coarsening: randomized heavy-edge matching.
 
 // Returns coarse graph + mapping fine node -> coarse node.
-Csr coarsen(const Csr& g, std::mt19937_64& rng, std::vector<int32_t>& map) {
+Csr coarsen(const CsrView& g, std::mt19937_64& rng,
+            std::vector<int32_t>& map) {
   const int64_t n = g.n;
   map.assign(n, -1);
   std::vector<int32_t> order(n);
@@ -67,7 +94,7 @@ Csr coarsen(const Csr& g, std::mt19937_64& rng, std::vector<int32_t>& map) {
     for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
       int32_t v = g.indices[e];
       if (v == u || match[v] != -1) continue;
-      if (g.ewgt[e] > best_w) { best_w = g.ewgt[e]; best = v; }
+      if (ew(g, e) > best_w) { best_w = ew(g, e); best = v; }
     }
     match[u] = (best == -1) ? u : best;
     if (best != -1) match[best] = u;
@@ -80,7 +107,10 @@ Csr coarsen(const Csr& g, std::mt19937_64& rng, std::vector<int32_t>& map) {
   Csr c;
   c.n = nc;
   c.nwgt.assign(nc, 0);
-  for (int64_t u = 0; u < n; ++u) c.nwgt[map[u]] += g.nwgt[u];
+  for (int64_t u = 0; u < n; ++u) {
+    int64_t w = (int64_t)c.nwgt[map[u]] + nw(g, u);
+    c.nwgt[map[u]] = (int32_t)std::min<int64_t>(w, INT32_MAX);
+  }
 
   // count then fill, merging duplicates with a per-node scratch table
   std::vector<int64_t> scratch_w(nc, 0);
@@ -108,7 +138,7 @@ Csr coarsen(const Csr& g, std::mt19937_64& rng, std::vector<int32_t>& map) {
         int32_t cv = map[g.indices[e]];
         if (cv == cu) continue;
         if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
-        scratch_w[cv] += g.ewgt[e];
+        scratch_w[cv] += ew(g, e);
       }
     }
     c.indptr[cu + 1] = c.indptr[cu] + (int64_t)scratch_nbr.size();
@@ -125,13 +155,14 @@ Csr coarsen(const Csr& g, std::mt19937_64& rng, std::vector<int32_t>& map) {
         int32_t cv = map[g.indices[e]];
         if (cv == cu) continue;
         if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
-        scratch_w[cv] += g.ewgt[e];
+        scratch_w[cv] += ew(g, e);
       }
     }
     int64_t pos = c.indptr[cu];
     for (int32_t cv : scratch_nbr) {
       c.indices[pos] = cv;
-      c.ewgt[pos] = scratch_w[cv];
+      c.ewgt[pos] =
+          (int32_t)std::min<int64_t>(scratch_w[cv], INT32_MAX);
       scratch_w[cv] = 0;
       ++pos;
     }
@@ -143,7 +174,7 @@ Csr coarsen(const Csr& g, std::mt19937_64& rng, std::vector<int32_t>& map) {
 // Initial partition on the coarsest graph: BFS order, contiguous blocks
 // balanced by node weight.
 
-void initial_partition(const Csr& g, int32_t k, std::mt19937_64& rng,
+void initial_partition(const CsrView& g, int32_t k, std::mt19937_64& rng,
                        std::vector<int32_t>& parts) {
   const int64_t n = g.n;
   parts.assign(n, 0);
@@ -175,14 +206,14 @@ void initial_partition(const Csr& g, int32_t k, std::mt19937_64& rng,
     }
   }
   int64_t total_w = 0;
-  for (int64_t u = 0; u < n; ++u) total_w += g.nwgt[u];
+  for (int64_t u = 0; u < n; ++u) total_w += nw(g, u);
   // walk the BFS order filling part 0, then 1, ... by weight quota
   int64_t acc = 0;
   for (int64_t i = 0; i < n; ++i) {
     int32_t p = (int32_t)std::min<int64_t>((acc * k) / std::max<int64_t>(total_w, 1),
                                            k - 1);
     parts[order[i]] = p;
-    acc += g.nwgt[order[i]];
+    acc += nw(g, order[i]);
   }
 }
 
@@ -199,9 +230,9 @@ void initial_partition(const Csr& g, int32_t k, std::mt19937_64& rng,
 // One definition of the balance cap and the per-move gain, shared by
 // the greedy and FM phases — two copies would let them silently
 // enforce different caps/objectives in the same refinement loop.
-int64_t balance_cap(const Csr& g, int32_t k, double imbalance) {
+int64_t balance_cap(const CsrView& g, int32_t k, double imbalance) {
   int64_t total_w = 0;
-  for (int64_t u = 0; u < g.n; ++u) total_w += g.nwgt[u];
+  for (int64_t u = 0; u < g.n; ++u) total_w += nw(g, u);
   return (int64_t)(imbalance * (double)((total_w + k - 1) / k)) + 1;
 }
 
@@ -212,14 +243,14 @@ inline int64_t move_gain(int64_t conn_p, int64_t conn_own, int objective) {
   return gain;
 }
 
-void refine(const Csr& g, int32_t k, int objective, int iters,
+void refine(const CsrView& g, int32_t k, int objective, int iters,
             double imbalance, std::vector<int32_t>& parts,
             std::mt19937_64& rng) {
   const int64_t n = g.n;
   const int64_t cap = balance_cap(g, k, imbalance);
 
   std::vector<int64_t> psize(k, 0);
-  for (int64_t u = 0; u < n; ++u) psize[parts[u]] += g.nwgt[u];
+  for (int64_t u = 0; u < n; ++u) psize[parts[u]] += nw(g, u);
 
   std::vector<int64_t> conn(k, 0);  // edge weight to each part, per node
   std::vector<int32_t> touched;
@@ -233,13 +264,13 @@ void refine(const Csr& g, int32_t k, int objective, int iters,
     for (int64_t i = 0; i < n; ++i) {
       int32_t u = order[i];
       int32_t pu = parts[u];
-      if (psize[pu] - g.nwgt[u] <= 0) continue;  // never drain a part
+      if (psize[pu] - nw(g, u) <= 0) continue;  // never drain a part
       touched.clear();
       bool boundary = false;
       for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
         int32_t pv = parts[g.indices[e]];
         if (conn[pv] == 0) touched.push_back(pv);
-        conn[pv] += g.ewgt[e];
+        conn[pv] += ew(g, e);
         if (pv != pu) boundary = true;
       }
       if (boundary) {
@@ -247,7 +278,7 @@ void refine(const Csr& g, int32_t k, int objective, int iters,
         int64_t best_gain = 0;
         int32_t best_p = -1;
         for (int32_t p : touched) {
-          if (p == pu || psize[p] + g.nwgt[u] > cap) continue;
+          if (p == pu || psize[p] + nw(g, u) > cap) continue;
           int64_t gain = move_gain(conn[p], own, objective);
           if (gain > best_gain ||
               (gain == best_gain && best_p != -1 && psize[p] < psize[best_p])) {
@@ -256,8 +287,8 @@ void refine(const Csr& g, int32_t k, int objective, int iters,
           }
         }
         if (best_p != -1 && best_gain > 0) {
-          psize[pu] -= g.nwgt[u];
-          psize[best_p] += g.nwgt[u];
+          psize[pu] -= nw(g, u);
+          psize[best_p] += nw(g, u);
           parts[u] = best_p;
           ++moved;
         }
@@ -271,7 +302,7 @@ void refine(const Csr& g, int32_t k, int objective, int iters,
 // True objective value of a partition: 'cut' counts each crossing edge
 // twice (symmetric CSR) — consistent for comparisons; 'vol' counts
 // distinct (node, foreign-part) halo pairs.
-int64_t eval_objective(const Csr& g, int32_t k, int objective,
+int64_t eval_objective(const CsrView& g, int32_t k, int objective,
                        const std::vector<int32_t>& parts) {
   int64_t obj = 0;
   std::vector<char> seen(k, 0);
@@ -283,7 +314,7 @@ int64_t eval_objective(const Csr& g, int32_t k, int objective,
       int32_t pv = parts[g.indices[e]];
       if (pv == pu) continue;
       if (objective == 0) {
-        obj += g.ewgt[e];
+        obj += ew(g, e);
       } else if (!seen[pv]) {
         seen[pv] = 1;
         touched.push_back(pv);
@@ -306,7 +337,7 @@ int64_t eval_objective(const Csr& g, int32_t k, int objective,
 // Lazy max-heap with per-node version stamps; moved nodes lock for the
 // pass. Returns true if the pass improved the objective.
 
-bool fm_pass(const Csr& g, int32_t k, int objective, int64_t cap,
+bool fm_pass(const CsrView& g, int32_t k, int objective, int64_t cap,
              std::vector<int64_t>& psize, std::vector<int32_t>& parts,
              bool eager) {
   const int64_t n = g.n;
@@ -321,18 +352,18 @@ bool fm_pass(const Csr& g, int32_t k, int objective, int64_t cap,
   // best (gain, target) for u under the balance cap; target -1 if none
   auto best_move = [&](int32_t u, int64_t& gain_out) -> int32_t {
     int32_t pu = parts[u];
-    if (psize[pu] - g.nwgt[u] <= 0) return -1;
+    if (psize[pu] - nw(g, u) <= 0) return -1;
     touched.clear();
     for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
       int32_t pv = parts[g.indices[e]];
       if (conn[pv] == 0) touched.push_back(pv);
-      conn[pv] += g.ewgt[e];
+      conn[pv] += ew(g, e);
     }
     int64_t own = conn[pu];
     int64_t best_gain = INT64_MIN;
     int32_t best_p = -1;
     for (int32_t p : touched) {
-      if (p == pu || psize[p] + g.nwgt[u] > cap) continue;
+      if (p == pu || psize[p] + nw(g, u) > cap) continue;
       int64_t gain = move_gain(conn[p], own, objective);
       if (gain > best_gain) {
         best_gain = gain;
@@ -391,8 +422,8 @@ bool fm_pass(const Csr& g, int32_t k, int objective, int64_t cap,
       continue;
     }
     int32_t pu = parts[u];
-    psize[pu] -= g.nwgt[u];
-    psize[p] += g.nwgt[u];
+    psize[pu] -= nw(g, u);
+    psize[p] += nw(g, u);
     parts[u] = p;
     locked[u] = 1;
     moves.emplace_back(u, pu);
@@ -434,21 +465,21 @@ bool fm_pass(const Csr& g, int32_t k, int objective, int64_t cap,
   // roll back everything after the best prefix
   for (size_t i = moves.size(); i > best_len; --i) {
     auto [u, from] = moves[i - 1];
-    psize[parts[u]] -= g.nwgt[u];
-    psize[from] += g.nwgt[u];
+    psize[parts[u]] -= nw(g, u);
+    psize[from] += nw(g, u);
     parts[u] = from;
   }
   return best_cum > 0;
 }
 
-void fm_refine(const Csr& g, int32_t k, int objective, double imbalance,
+void fm_refine(const CsrView& g, int32_t k, int objective, double imbalance,
                std::vector<int32_t>& parts, int max_passes = 8) {
   // Cost/quality ladder by level size: exact (eager) neighbor gains on
   // small graphs, lazy cached gains in the mid range, and no FM at all
   // on billion-edge levels — there the greedy passes carry refinement
   // and the quality-critical decisions were already made on the
   // coarser levels (where FM did run).
-  const int64_t m = (int64_t)g.indices.size();
+  const int64_t m = g.m();
   const int64_t eager_edge_cap = 1'000'000;
   const int64_t fm_edge_cap = 200'000'000;
   if (m > fm_edge_cap) return;
@@ -458,12 +489,12 @@ void fm_refine(const Csr& g, int32_t k, int objective, double imbalance,
   const bool eager = m <= eager_edge_cap && m <= 16 * g.n;
   const int64_t cap = balance_cap(g, k, imbalance);
   std::vector<int64_t> psize(k, 0);
-  for (int64_t u = 0; u < g.n; ++u) psize[parts[u]] += g.nwgt[u];
+  for (int64_t u = 0; u < g.n; ++u) psize[parts[u]] += nw(g, u);
   for (int pass = 0; pass < max_passes; ++pass)
     if (!fm_pass(g, k, objective, cap, psize, parts, eager)) break;
 }
 
-void ensure_nonempty(const Csr& g, int32_t k, std::vector<int32_t>& parts) {
+void ensure_nonempty(const CsrView& g, int32_t k, std::vector<int32_t>& parts) {
   std::vector<int64_t> count(k, 0);
   for (int64_t u = 0; u < g.n; ++u) count[parts[u]]++;
   for (int32_t p = 0; p < k; ++p) {
@@ -498,23 +529,26 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
   }
   std::mt19937_64 rng(seed);
 
-  // levels[i] may be relocated by push_back — never hold references into it
-  std::vector<Csr> levels(1);
-  levels[0].n = n;
-  levels[0].indptr.assign(indptr, indptr + n + 1);
-  levels[0].indices.assign(indices, indices + indptr[n]);
-  levels[0].ewgt.assign(indptr[n], 1);
-  levels[0].nwgt.assign(n, 1);
+  // the FINEST level is a zero-copy view of the caller's arrays with
+  // implicit unit weights — at papers100M scale the old copy +
+  // materialized all-ones int64 weights cost ~40 GB by themselves.
+  // coarse[i] owns level i+1; view_of(lvl) hides the asymmetry.
+  const CsrView fine_view{n, indptr, indices, nullptr, nullptr};
+  std::vector<Csr> coarse;
+  auto view_of = [&](int64_t lvl) -> CsrView {
+    return lvl == 0 ? fine_view : coarse[lvl - 1].view();
+  };
 
   // coarsen until small or stalled
   std::vector<std::vector<int32_t>> maps;
   const int64_t target = std::max<int64_t>((int64_t)n_parts * 16, 512);
-  while (levels.back().n > target) {
+  while (view_of((int64_t)maps.size()).n > target) {
     std::vector<int32_t> map;
-    Csr c = coarsen(levels.back(), rng, map);
-    if (c.n > (int64_t)(0.95 * (double)levels.back().n)) break;  // stalled
+    Csr c = coarsen(view_of((int64_t)maps.size()), rng, map);
+    if (c.n > (int64_t)(0.95 * (double)view_of((int64_t)maps.size()).n))
+      break;  // stalled
     maps.push_back(std::move(map));
-    levels.push_back(std::move(c));
+    coarse.push_back(std::move(c));
   }
 
   // initial partition at the coarsest level: the coarse graph is tiny,
@@ -522,15 +556,16 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
   // multi-start) and keep the best refined one by the true objective
   std::vector<int32_t> parts;
   {
+    const CsrView coarsest = view_of((int64_t)maps.size());
     const int tries = 8;
     int64_t best_obj = INT64_MAX;
     std::vector<int32_t> cand;
     for (int t = 0; t < tries; ++t) {
-      initial_partition(levels.back(), n_parts, rng, cand);
-      refine(levels.back(), n_parts, objective, refine_iters, imbalance,
+      initial_partition(coarsest, n_parts, rng, cand);
+      refine(coarsest, n_parts, objective, refine_iters, imbalance,
              cand, rng);
-      fm_refine(levels.back(), n_parts, objective, imbalance, cand);
-      int64_t obj = eval_objective(levels.back(), n_parts, objective, cand);
+      fm_refine(coarsest, n_parts, objective, imbalance, cand);
+      int64_t obj = eval_objective(coarsest, n_parts, objective, cand);
       if (obj < best_obj) {
         best_obj = obj;
         parts = cand;
@@ -543,15 +578,22 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
   // the greedy local minimum
   for (int64_t lvl = (int64_t)maps.size() - 1; lvl >= 0; --lvl) {
     const std::vector<int32_t>& map = maps[lvl];
-    std::vector<int32_t> fine(levels[lvl].n);
-    for (int64_t u = 0; u < levels[lvl].n; ++u) fine[u] = parts[map[u]];
+    const CsrView gv = view_of(lvl);
+    std::vector<int32_t> fine(gv.n);
+    for (int64_t u = 0; u < gv.n; ++u) fine[u] = parts[map[u]];
     parts = std::move(fine);
-    refine(levels[lvl], n_parts, objective, refine_iters, imbalance, parts,
-           rng);
-    fm_refine(levels[lvl], n_parts, objective, imbalance, parts);
+    refine(gv, n_parts, objective, refine_iters, imbalance, parts, rng);
+    fm_refine(gv, n_parts, objective, imbalance, parts);
+    // the level just consumed is never needed again — free it before
+    // refining finer (bigger) levels so peak RSS is one level's graph,
+    // not the whole hierarchy
+    if (lvl > 0) {
+      coarse[lvl - 1] = Csr();
+      maps[lvl] = std::vector<int32_t>();
+    }
   }
 
-  ensure_nonempty(levels[0], n_parts, parts);
+  ensure_nonempty(fine_view, n_parts, parts);
   std::memcpy(out_parts, parts.data(), sizeof(int32_t) * (size_t)n);
   return 0;
 }
