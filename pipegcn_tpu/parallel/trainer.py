@@ -75,6 +75,16 @@ class TrainConfig:
     # run up to this many epochs per dispatch (lax.scan inside the jitted
     # step); 1 = one program per epoch (reference-like granularity)
     fused_epochs: int = 1
+    # Run the P-part SPMD program on ONE device: the identical
+    # per-device step is wrapped in jax.vmap(axis_name='parts') instead
+    # of shard_map — vmap implements psum/ppermute/axis_index
+    # semantically, so staleness/convergence studies at P>1 run on a
+    # single TPU chip (the environment has exactly one) at chip speed
+    # with bit-matching SPMD semantics. Params/opt/norm are stored
+    # stacked [P, ...] (identical across parts after the psum'd
+    # update). Not for production scaling — collectives become
+    # in-device data movement.
+    emulate_parts: bool = False
 
 
 class Trainer:
@@ -93,9 +103,17 @@ class Trainer:
         self._eval_cfg = dataclasses.replace(cfg, sorted_edges=True)
         self.tcfg = tcfg
         self.P = sg.num_parts
-        self.mesh = make_mesh(self.P, devices)
-        self._shard = NamedSharding(self.mesh, PartitionSpec(PARTS_AXIS))
-        self._repl = NamedSharding(self.mesh, PartitionSpec())
+        self.emulated = tcfg.emulate_parts
+        if self.emulated:
+            # one device carries every part; the [P, ...] arrays live
+            # whole on it and the parts axis is a vmap batch axis
+            self.mesh = make_mesh(1, devices)
+            self._shard = NamedSharding(self.mesh, PartitionSpec())
+            self._repl = self._shard
+        else:
+            self.mesh = make_mesh(self.P, devices)
+            self._shard = NamedSharding(self.mesh, PartitionSpec(PARTS_AXIS))
+            self._repl = NamedSharding(self.mesh, PartitionSpec())
 
         self._setup_pallas_spmm()
         # with kernel tables active, the step (and the sharded
@@ -133,10 +151,21 @@ class Trainer:
 
         rng = jax.random.PRNGKey(tcfg.seed)
         params = init_params(rng, cfg)
+        if self.emulated:
+            # replicated-by-construction: stacked copies stand in for
+            # shard_map's replicated spec (the psum'd update keeps every
+            # part's copy identical)
+            stack = lambda t: jax.tree_util.tree_map(
+                lambda v: jnp.stack([v] * self.P), t)
+            params, opt, norm = (stack(params), stack(adam_init(params)),
+                                 stack(init_norm_state(cfg)))
+        else:
+            opt = adam_init(params)
+            norm = init_norm_state(cfg)
         self.state = {
             "params": jax.device_put(params, self._repl),
-            "opt": jax.device_put(adam_init(params), self._repl),
-            "norm": jax.device_put(init_norm_state(cfg), self._repl),
+            "opt": jax.device_put(opt, self._repl),
+            "norm": jax.device_put(norm, self._repl),
             "comm": jax.device_put(self._init_comm(), self._shard),
         }
         self._step = self._build_step()
@@ -499,6 +528,16 @@ class Trainer:
         else:
             keys += ["edge_src", "edge_dst"]
         d_in = {k: data[k] for k in keys}
+        if self.emulated:
+            # single-device parts emulation: same pp body under
+            # vmap(axis_name) — see _build_step
+            tm = jax.tree_util.tree_map
+
+            def vpp(d):
+                return pp(tm(lambda v: v[None], d))[0]
+
+            fn = jax.jit(jax.vmap(vpp, axis_name=PARTS_AXIS))
+            return fn(d_in)
         fn = jax.jit(
             jax.shard_map(
                 pp, mesh=self.mesh,
@@ -717,6 +756,38 @@ class Trainer:
                 "comm": new_comm,
             }
             return new_state, loss_out
+
+        if self.emulated:
+            # vmap(axis_name) in place of shard_map: identical step
+            # function, parts as a batch axis on one device. The step
+            # strips a leading size-1 device axis from data/comm and
+            # re-adds it to new comm, so the wrapper reintroduces it
+            # around the vmapped slice.
+            tm = jax.tree_util.tree_map
+
+            def vstep(state, data, rng):
+                st = dict(state)
+                st["comm"] = tm(lambda v: v[None], state["comm"])
+                d1 = tm(lambda v: v[None], data)
+                ns, loss = step(st, d1, rng)
+                ns["comm"] = tm(lambda v: v[0], ns["comm"])
+                return ns, loss
+
+            vm = jax.vmap(vstep, in_axes=(0, 0, None), out_axes=0,
+                          axis_name=PARTS_AXIS)
+
+            def emu(state, data, rng):
+                ns, loss = vm(state, data, rng)
+                return ns, loss[0]  # psum'd: identical across parts
+
+            def emu_multi(state, data, rngs):
+                def body(st, rng):
+                    return emu(st, data, rng)
+
+                return jax.lax.scan(body, state, rngs)
+
+            self._multi_step = jax.jit(emu_multi, donate_argnums=(0,))
+            return jax.jit(emu, donate_argnums=(0,))
 
         data_spec = jax.tree_util.tree_map(
             lambda _: PartitionSpec(PARTS_AXIS), self.data
@@ -1140,6 +1211,10 @@ class Trainer:
         train.py:359-361). In pipelined mode the real step overlaps these
         with compute, so this measures the collective cost, not exposed
         wait time."""
+        if self.emulated:
+            raise RuntimeError(
+                "measure_comm is meaningless under emulate_parts: the "
+                "collectives are in-device data movement")
         P = self.P
         spec = PartitionSpec(PARTS_AXIS)
 
@@ -1218,7 +1293,17 @@ class Trainer:
             params = self.state["params"]
         if norm is None:
             norm = self.state["norm"]
+        if self.emulated:
+            # emulate-mode params/norm are ALWAYS the stacked [P, ...]
+            # replicas (state, fit snapshots); take one copy for the
+            # single-device eval
+            params = jax.tree_util.tree_map(lambda v: v[0], params)
+            norm = jax.tree_util.tree_map(lambda v: v[0], norm)
         if sharded:
+            if self.emulated:
+                raise RuntimeError(
+                    "sharded eval needs the real device mesh; "
+                    "emulate_parts trainers evaluate full-graph")
             ev = self._get_sharded_evaluator(g)
             return ("sharded", ev, ev.counts(mask_key, params, norm))
         c = self._full_eval_cache(g)

@@ -61,6 +61,12 @@ QUEUE = [
     ("bench_default",
      [sys.executable, "bench.py"],
      3600),
+    # full-density convergence study (VERDICT item 3): resumable via
+    # per-leg checkpoints, so each window advances it by its budget
+    ("convergence_study",
+     [sys.executable, "scripts/convergence_study.py",
+      "--time-budget", "1500"],
+     2400),
 ]
 
 

@@ -341,3 +341,33 @@ def test_identity_collectives_switch(graph):
     # with each device keeping its own boundary rows, training history
     # must diverge from the true exchange
     assert not np.allclose(real, ident, rtol=1e-6)
+
+
+def test_emulate_parts_matches_mesh(graph):
+    """emulate_parts=True (vmap-with-axis_name on ONE device) must
+    reproduce the real shard_map mesh run to float rounding — losses
+    and eval — for vanilla AND pipelined+corrections, including use_pp
+    and dropout (per-rank rng folds through axis_index identically)."""
+    parts = partition_graph(graph, 4, seed=0)
+    sg = ShardedGraph.build(graph, parts, n_parts=4)
+    cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer", dropout=0.3,
+                      use_pp=True, train_size=sg.n_train_global)
+    for pipe, corr in ((False, False), (True, True)):
+        tc = TrainConfig(seed=4, enable_pipeline=pipe, feat_corr=corr,
+                         grad_corr=corr)
+        tm = Trainer(sg, cfg, tc)
+        te = Trainer(sg, cfg,
+                     dataclasses.replace(tc, emulate_parts=True))
+        lm = [tm.train_epoch(e) for e in range(5)]
+        le = [te.train_epoch(e) for e in range(5)]
+        np.testing.assert_allclose(lm, le, rtol=1e-5)
+        assert tm.evaluate(graph, "val_mask") == \
+            te.evaluate(graph, "val_mask")
+    # fused-epoch dispatch agrees too
+    te2 = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True,
+                                       fused_epochs=4,
+                                       emulate_parts=True))
+    lf = te2.train_epochs(0, 4)
+    tm2 = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True))
+    lr = [tm2.train_epoch(e) for e in range(4)]
+    np.testing.assert_allclose(np.asarray(lf), lr, rtol=1e-5)
