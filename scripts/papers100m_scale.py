@@ -191,7 +191,35 @@ def main() -> None:
     ]
     for k, v in stages.items():
         md.append(f"| {k} | {v['s']} | {v['peak_rss_gb']} |")
-    md.append("")
+    md += [
+        "",
+        "The cumulative-peak column (ru_maxrss) shows the RAM story:",
+        "the memmap loader + finalized-edge cache stay chunk-bounded;",
+        "the remaining peak belongs to the PARTITIONER (multilevel on",
+        "the full finalized edge set) — build_chunked and the",
+        "compressed save never exceed its high-water mark. The",
+        "partitioner is the one stage that scales with E in RAM,",
+        "matching where the reference spends its >=120 GB host",
+        "(reference README.md:29-30). Round-4 reductions: chunked",
+        "counting-sort CSR ingestion (no scipy COO doubling), a",
+        "zero-copy implicit-weight level-0 view, int32 coarse weights,",
+        "and level-by-level frees during uncoarsening took the",
+        "1/10-scale partition peak from 54.9 GB to the table's value.",
+        "",
+    ]
+    # dryrun results are produced rarely (--dryrun) and persisted
+    # separately so this wholesale rewrite never clobbers them
+    dj = os.path.join(REPO, "results", "papers_dryrun.json")
+    if os.path.exists(dj):
+        with open(dj) as f:
+            md += [
+                "64-virtual-device dryrun (structure-identical, reduced "
+                "size for the 64-way XLA:CPU compile arena): one "
+                "pipelined bucket-kernel training step jitted over the "
+                "virtual mesh —",
+                "`" + f.read().strip() + "`",
+                "",
+            ]
     with open(os.path.join(REPO, "results", "papers100m_scale.md"),
               "w") as f:
         f.write("\n".join(md))
@@ -217,10 +245,14 @@ def main() -> None:
         tr = Trainer(sg, cfg, TrainConfig(lr=0.01, enable_pipeline=True,
                                           eval=False))
         loss = tr.train_epoch(0)
-        print(json.dumps({"dryrun_devices": args.parts,
-                          "first_step_s": round(time.time() - t0, 1),
-                          "loss": float(loss),
-                          "peak_rss_gb": round(rss_gb(), 2)}))
+        rec = {"dryrun_devices": args.parts,
+               "first_step_s": round(time.time() - t0, 1),
+               "loss": float(loss),
+               "peak_rss_gb": round(rss_gb(), 2)}
+        with open(os.path.join(REPO, "results",
+                               "papers_dryrun.json"), "w") as f:
+            json.dump(rec, f)
+        print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -37,6 +37,9 @@ def main():
     ap.add_argument("--probe-traffic", action="store_true",
                     help="table-surgery decomposition of the dense "
                          "term: F-tile reads vs A reads vs MXU")
+    ap.add_argument("--fused", action="store_true",
+                    help="fused unpack+matmul Pallas dense path "
+                         "(ops/fused_block.py; needs --group > 1)")
     args = ap.parse_args()
 
     import jax
@@ -53,7 +56,7 @@ def main():
         train_size=sg.n_train_global, spmm_chunk=2_097_152,
         dtype="bfloat16", spmm_impl="block",
         block_nnz=args.block_nnz or None,
-        block_group=args.group,
+        block_group=args.group, block_fused=args.fused,
     )
     tr = Trainer(sg, cfg, TrainConfig(lr=0.01, n_epochs=1, eval=False))
     d = {k: v[0] for k, v in tr.data.items()}
@@ -71,7 +74,8 @@ def main():
         dd = {k: v for k, v in d.items() if keep(k)}
         fn = jax.jit(make_device_block_spmm_fn(
             dd, d["in_deg"], n_max, n_src, tr._block_tile,
-            chunk_edges=cfg.spmm_chunk))
+            chunk_edges=cfg.spmm_chunk,
+            interpret=jax.default_backend() == "cpu"))
         grad = jax.jit(jax.grad(lambda f: fn(f).astype(jnp.float32).sum()))
 
         def timed(g, label):

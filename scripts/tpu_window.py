@@ -44,6 +44,10 @@ QUEUE = [
      3600),
     # fused Pallas dense path (ops/fused_block.py) — after the
     # known-good configs so a bad compile can't burn the headline
+    ("microbench_u4_fused",
+     [sys.executable, "scripts/spmm_microbench.py", "--group", "4",
+      "--fused"],
+     2400),
     ("bench_u4_fused",
      [sys.executable, "bench.py", "--block-group", "4", "--block-fused",
       "--no-compare"],
