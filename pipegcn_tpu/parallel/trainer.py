@@ -1058,7 +1058,9 @@ class Trainer:
                         # consumers don't compare unlike quantities.
                         log_fn("# note: Comm(s)/Reduce(s) = standalone "
                                "collective cost (not exposed wait; SPMD "
-                               "overlaps comm inside the step)")
+                               "overlaps comm inside the step); Comm = "
+                               "forward halo ring + cotangent return "
+                               "ring (both modes move both)")
 
                 if reference_logs and (epoch + 1) % 10 == 0:
                     # reference log line format (train.py:369-371); rank is
@@ -1066,8 +1068,8 @@ class Trainer:
                     log_fn("Process {:03d} | Epoch {:05d} | Time(s) {:.4f} | "
                            "Comm(s) {:.4f} | Reduce(s) {:.4f} | Loss {:.4f}"
                            .format(0, epoch, float(np.mean(durs or [dur])),
-                                   comm_cost["comm"], comm_cost["reduce"],
-                                   loss))
+                                   comm_cost["comm"] + comm_cost["bgrad"],
+                                   comm_cost["reduce"], loss))
 
                 if (epoch + 1) % tcfg.log_every == 0:
                     do_eval = tcfg.eval and eval_graphs and "val" in eval_graphs
@@ -1238,6 +1240,28 @@ class Trainer:
             comm_fn, mesh=self.mesh, in_specs=(spec,) * 3, out_specs=spec,
         ))
 
+        def bgrad_fn(feat):
+            # the reverse ring shipping each epoch's halo cotangents
+            # back to their owners. BOTH modes move it — vanilla
+            # through halo_exchange's VJP, pipelined through the comm
+            # carry's explicit return_blocks — so it belongs in
+            # Comm(s) for both. The EMA corrections are local
+            # arithmetic — no wire traffic.
+            feat = feat[0]
+            outs = []
+            for i in self._graph_layer_range():
+                w = self._layer_width(i)
+                hg = feat[:1, :1].astype(cdt) * jnp.ones(
+                    ((P - 1) * self.sg.b_max, w), cdt)
+                outs.append(
+                    return_blocks(hg, PARTS_AXIS, P, self.sg.b_max).sum())
+            return jnp.stack(outs).sum()[None] if outs else \
+                jnp.zeros((1,), jnp.float32)
+
+        bgrad_jit = jax.jit(jax.shard_map(
+            bgrad_fn, mesh=self.mesh, in_specs=(spec,), out_specs=spec,
+        ))
+
         def reduce_fn(params):
             return jax.tree_util.tree_map(
                 lambda p: jax.lax.psum(p, PARTS_AXIS), params
@@ -1264,9 +1288,11 @@ class Trainer:
                 ts.append(time.perf_counter() - t0)
             return float(np.median(ts))
 
+        jax.block_until_ready(bgrad_jit(d["feat"]))  # compile
         return {
             "comm": _med(comm_jit, *args),
             "reduce": _med(reduce_jit, self.state["params"]),
+            "bgrad": _med(bgrad_jit, d["feat"]),
         }
 
     # ---------------- evaluation --------------------------------------

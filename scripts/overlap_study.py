@@ -187,7 +187,7 @@ def main():
                    if exposed_van > 0 else float("nan"))
     pipe_dep = _collective_matmul_deps(pipe_hlo)
     van_dep = _collective_matmul_deps(van_hlo)
-    coll_s = comm["comm"]
+    coll_s = comm["comm"] + comm["bgrad"]  # fwd ring + cotangent ring
 
     backend = jax.default_backend()
     lines = [

@@ -84,6 +84,10 @@ def test_measure_comm():
     cost = t.measure_comm(repeats=2)
     assert cost["comm"] > 0 and cost["reduce"] > 0
     assert cost["comm"] < 5 and cost["reduce"] < 5
+    # the cotangent return ring is measured for BOTH modes (vanilla
+    # ships it through halo_exchange's VJP, pipelined through the
+    # carry's return_blocks)
+    assert 0 < cost["bgrad"] < 5
 
 
 def test_checkpoint_bf16_roundtrip(tmp_path):
