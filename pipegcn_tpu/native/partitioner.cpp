@@ -27,6 +27,8 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <numeric>
 #include <queue>
@@ -73,6 +75,79 @@ struct Csr {
 // ---------------------------------------------------------------------
 // Coarsening: randomized heavy-edge matching.
 
+// Build the coarse graph induced by a fine->coarse map: aggregate
+// parallel edges, drop (coarse) self loops. Shared by incremental
+// coarsening AND the uncoarsening-time rebuild of unstored levels
+// (contract(level0, composed map) reproduces level i exactly — edge
+// weights aggregate additively along map composition).
+Csr contract(const CsrView& g, const int32_t* map, int64_t nc) {
+  const int64_t n = g.n;
+  Csr c;
+  c.n = nc;
+  c.nwgt.assign(nc, 0);
+  for (int64_t u = 0; u < n; ++u) {
+    int64_t w = (int64_t)c.nwgt[map[u]] + nw(g, u);
+    c.nwgt[map[u]] = (int32_t)std::min<int64_t>(w, INT32_MAX);
+  }
+
+  // count then fill, merging duplicates with a per-node scratch table
+  std::vector<int64_t> scratch_w(nc, 0);
+  std::vector<int32_t> scratch_nbr;
+  scratch_nbr.reserve(256);
+
+  // two passes over fine edges grouped by coarse node; build fine-node
+  // lists per coarse node first
+  std::vector<int64_t> cstart(nc + 1, 0);
+  for (int64_t u = 0; u < n; ++u) cstart[map[u] + 1]++;
+  for (int64_t i = 0; i < nc; ++i) cstart[i + 1] += cstart[i];
+  std::vector<int32_t> members(n);
+  {
+    std::vector<int64_t> cur(cstart.begin(), cstart.end() - 1);
+    for (int64_t u = 0; u < n; ++u) members[cur[map[u]]++] = (int32_t)u;
+  }
+
+  c.indptr.assign(nc + 1, 0);
+  // pass 1: count distinct coarse neighbors
+  for (int64_t cu = 0; cu < nc; ++cu) {
+    scratch_nbr.clear();
+    for (int64_t mi = cstart[cu]; mi < cstart[cu + 1]; ++mi) {
+      int32_t u = members[mi];
+      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+        int32_t cv = map[g.indices[e]];
+        if (cv == cu) continue;
+        if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
+        scratch_w[cv] += ew(g, e);
+      }
+    }
+    c.indptr[cu + 1] = c.indptr[cu] + (int64_t)scratch_nbr.size();
+    for (int32_t cv : scratch_nbr) scratch_w[cv] = 0;
+  }
+  c.indices.resize(c.indptr[nc]);
+  c.ewgt.resize(c.indptr[nc]);
+  // pass 2: fill
+  for (int64_t cu = 0; cu < nc; ++cu) {
+    scratch_nbr.clear();
+    for (int64_t mi = cstart[cu]; mi < cstart[cu + 1]; ++mi) {
+      int32_t u = members[mi];
+      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+        int32_t cv = map[g.indices[e]];
+        if (cv == cu) continue;
+        if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
+        scratch_w[cv] += ew(g, e);
+      }
+    }
+    int64_t pos = c.indptr[cu];
+    for (int32_t cv : scratch_nbr) {
+      c.indices[pos] = cv;
+      c.ewgt[pos] =
+          (int32_t)std::min<int64_t>(scratch_w[cv], INT32_MAX);
+      scratch_w[cv] = 0;
+      ++pos;
+    }
+  }
+  return c;
+}
+
 // Returns coarse graph + mapping fine node -> coarse node.
 Csr coarsen(const CsrView& g, std::mt19937_64& rng,
             std::vector<int32_t>& map) {
@@ -103,71 +178,42 @@ Csr coarsen(const CsrView& g, std::mt19937_64& rng,
     ++nc;
   }
 
-  // build coarse graph: aggregate parallel edges, drop self loops
-  Csr c;
-  c.n = nc;
-  c.nwgt.assign(nc, 0);
-  for (int64_t u = 0; u < n; ++u) {
-    int64_t w = (int64_t)c.nwgt[map[u]] + nw(g, u);
-    c.nwgt[map[u]] = (int32_t)std::min<int64_t>(w, INT32_MAX);
-  }
-
-  // count then fill, merging duplicates with a per-node scratch table
-  std::vector<int64_t> scratch_w(nc, 0);
-  std::vector<int32_t> scratch_nbr;
-  scratch_nbr.reserve(256);
-
-  // two passes over fine edges grouped by coarse node; build fine-node
-  // lists per coarse node first
-  std::vector<int64_t> cstart(nc + 1, 0);
-  for (int64_t u = 0; u < n; ++u) cstart[map[u] + 1]++;
-  for (int32_t i = 0; i < nc; ++i) cstart[i + 1] += cstart[i];
-  std::vector<int32_t> members(n);
+  // Cluster pass (HEM* — what METIS does when plain HEM stalls): on
+  // hub-heavy graphs most of a hub's neighbors are already matched by
+  // the time the sweep reaches them, leaving singleton coarse nodes
+  // and a ~0.75 shrink per level, i.e. ~2x the levels and ~2x the
+  // refinement work and hierarchy RAM. Let leftover singletons join a
+  // neighbor's coarse node (heaviest edge) up to 4 fine members, which
+  // restores ~0.5 shrink. Renumber coarse ids densely afterwards.
   {
-    std::vector<int64_t> cur(cstart.begin(), cstart.end() - 1);
-    for (int64_t u = 0; u < n; ++u) members[cur[map[u]]++] = (int32_t)u;
+    std::vector<int32_t> csize(nc, 0);
+    for (int64_t u = 0; u < n; ++u) csize[map[u]]++;
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t u = order[i];
+      if (match[u] != u || csize[map[u]] != 1) continue;  // not singleton
+      int32_t best = -1;
+      int64_t best_w = -1;
+      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
+        int32_t v = g.indices[e];
+        if (v == u || map[v] == map[u] || csize[map[v]] >= 4) continue;
+        if (ew(g, e) > best_w) { best_w = ew(g, e); best = v; }
+      }
+      if (best != -1) {
+        csize[map[u]]--;
+        map[u] = map[best];
+        csize[map[u]]++;
+      }
+    }
+    std::vector<int32_t> renum(nc, -1);
+    int32_t dense = 0;
+    for (int64_t u = 0; u < n; ++u) {
+      if (renum[map[u]] == -1) renum[map[u]] = dense++;
+      map[u] = renum[map[u]];
+    }
+    nc = dense;
   }
 
-  c.indptr.assign(nc + 1, 0);
-  // pass 1: count distinct coarse neighbors
-  for (int32_t cu = 0; cu < nc; ++cu) {
-    scratch_nbr.clear();
-    for (int64_t mi = cstart[cu]; mi < cstart[cu + 1]; ++mi) {
-      int32_t u = members[mi];
-      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
-        int32_t cv = map[g.indices[e]];
-        if (cv == cu) continue;
-        if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
-        scratch_w[cv] += ew(g, e);
-      }
-    }
-    c.indptr[cu + 1] = c.indptr[cu] + (int64_t)scratch_nbr.size();
-    for (int32_t cv : scratch_nbr) scratch_w[cv] = 0;
-  }
-  c.indices.resize(c.indptr[nc]);
-  c.ewgt.resize(c.indptr[nc]);
-  // pass 2: fill
-  for (int32_t cu = 0; cu < nc; ++cu) {
-    scratch_nbr.clear();
-    for (int64_t mi = cstart[cu]; mi < cstart[cu + 1]; ++mi) {
-      int32_t u = members[mi];
-      for (int64_t e = g.indptr[u]; e < g.indptr[u + 1]; ++e) {
-        int32_t cv = map[g.indices[e]];
-        if (cv == cu) continue;
-        if (scratch_w[cv] == 0) scratch_nbr.push_back(cv);
-        scratch_w[cv] += ew(g, e);
-      }
-    }
-    int64_t pos = c.indptr[cu];
-    for (int32_t cv : scratch_nbr) {
-      c.indices[pos] = cv;
-      c.ewgt[pos] =
-          (int32_t)std::min<int64_t>(scratch_w[cv], INT32_MAX);
-      scratch_w[cv] = 0;
-      ++pos;
-    }
-  }
-  return c;
+  return contract(g, map.data(), nc);
 }
 
 // ---------------------------------------------------------------------
@@ -532,23 +578,70 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
   // the FINEST level is a zero-copy view of the caller's arrays with
   // implicit unit weights — at papers100M scale the old copy +
   // materialized all-ones int64 weights cost ~40 GB by themselves.
-  // coarse[i] owns level i+1; view_of(lvl) hides the asymmetry.
   const CsrView fine_view{n, indptr, indices, nullptr, nullptr};
-  std::vector<Csr> coarse;
-  auto view_of = [&](int64_t lvl) -> CsrView {
-    return lvl == 0 ? fine_view : coarse[lvl - 1].view();
-  };
 
-  // coarsen until small or stalled
-  std::vector<std::vector<int32_t>> maps;
+  // The hierarchy is NOT kept in RAM wholesale: on low-locality graphs
+  // coarse edge counts barely shrink for many levels (~2.6 GB/level at
+  // 1/10-papers scale, 30+ GB total — the measured round-4 peak).
+  // Instead, only levels at or below SPILL_EDGES are stored; a larger
+  // level keeps just its composed level0->level map (n int32) and is
+  // REBUILT by contract(level0, composed map) when uncoarsening
+  // reaches it — exact reconstruction, O(E0) per rebuilt level.
+  const int64_t SPILL_EDGES = 50'000'000;
+  struct LevelInfo {
+    std::vector<int32_t> map;   // level i-1 node -> level i node
+    Csr graph;                  // owned iff stored
+    bool stored = false;
+    std::vector<int32_t> cmap;  // level 0 -> level i (iff !stored)
+    int64_t n = 0;
+  };
+  std::vector<LevelInfo> levels;  // levels[i] describes level i+1
+
   const int64_t target = std::max<int64_t>((int64_t)n_parts * 16, 512);
-  while (view_of((int64_t)maps.size()).n > target) {
+  const bool verbose = std::getenv("PIPEGCN_PART_VERBOSE") != nullptr;
+  // `current` holds the working graph ONLY while levels are unstored;
+  // once a level fits SPILL_EDGES its graph moves into the hierarchy
+  // (coarse edge counts are non-increasing, so every deeper level is
+  // stored too and the level0->level composition can stop)
+  Csr current;
+  std::vector<int32_t> cur_cmap;
+  while ((levels.empty() ? n : levels.back().n) > target) {
+    const CsrView gv =
+        levels.empty() ? fine_view
+        : (levels.back().stored ? levels.back().graph.view()
+                                : current.view());
     std::vector<int32_t> map;
-    Csr c = coarsen(view_of((int64_t)maps.size()), rng, map);
-    if (c.n > (int64_t)(0.95 * (double)view_of((int64_t)maps.size()).n))
-      break;  // stalled
-    maps.push_back(std::move(map));
-    coarse.push_back(std::move(c));
+    Csr c = coarsen(gv, rng, map);
+    if (c.n > (int64_t)(0.95 * (double)gv.n)) break;  // stalled
+    LevelInfo li;
+    li.n = c.n;
+    li.stored = c.indptr[c.n] <= SPILL_EDGES;
+    if (!li.stored) {
+      if (levels.empty()) {
+        cur_cmap = map;
+      } else {
+        for (int64_t u = 0; u < n; ++u) cur_cmap[u] = map[cur_cmap[u]];
+      }
+      li.cmap = cur_cmap;
+    } else {
+      std::vector<int32_t>().swap(cur_cmap);  // composition is done
+    }
+    li.map = std::move(map);
+    if (verbose)
+      std::fprintf(stderr,
+                   "# level %zu: n=%lld m=%lld (%.2f GB, %s)\n",
+                   levels.size() + 1, (long long)c.n,
+                   (long long)c.indptr[c.n],
+                   (double)(c.indptr[c.n] * 8 + c.n * 16) / 1e9,
+                   li.stored ? "stored" : "rebuilt on demand");
+    if (li.stored) {
+      li.graph = std::move(c);
+      current = Csr();
+      levels.push_back(std::move(li));
+    } else {
+      levels.push_back(std::move(li));
+      current = std::move(c);  // frees the previous working graph
+    }
   }
 
   // initial partition at the coarsest level: the coarse graph is tiny,
@@ -556,7 +649,10 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
   // multi-start) and keep the best refined one by the true objective
   std::vector<int32_t> parts;
   {
-    const CsrView coarsest = view_of((int64_t)maps.size());
+    const CsrView coarsest =
+        levels.empty() ? fine_view
+        : (levels.back().stored ? levels.back().graph.view()
+                                : current.view());
     const int tries = 8;
     int64_t best_obj = INT64_MAX;
     std::vector<int32_t> cand;
@@ -572,25 +668,40 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
       }
     }
   }
+  current = Csr();  // coarsest graph is done; free before uncoarsening
 
   // uncoarsen with refinement at every level: greedy positive-gain
   // passes first (cheap, bulk moves), then FM hill-climbing to escape
-  // the greedy local minimum
-  for (int64_t lvl = (int64_t)maps.size() - 1; lvl >= 0; --lvl) {
-    const std::vector<int32_t>& map = maps[lvl];
-    const CsrView gv = view_of(lvl);
-    std::vector<int32_t> fine(gv.n);
-    for (int64_t u = 0; u < gv.n; ++u) fine[u] = parts[map[u]];
-    parts = std::move(fine);
+  // the greedy local minimum. `j` is the level being refined; its
+  // graph is the fine view (j==0), the stored copy, or an on-demand
+  // exact rebuild — at most ONE big level is live at any moment.
+  for (int64_t j = (int64_t)levels.size() - 1; j >= 0; --j) {
+    {
+      const std::vector<int32_t>& map = levels[j].map;
+      std::vector<int32_t> fine((int64_t)map.size());
+      for (int64_t u = 0; u < (int64_t)map.size(); ++u)
+        fine[u] = parts[map[u]];
+      parts = std::move(fine);
+    }
+    // everything describing level j+1 is consumed: free the
+    // projection map (and its graph below) before refining the
+    // bigger, finer level
+    std::vector<int32_t>().swap(levels[j].map);
+    Csr rebuilt;
+    CsrView gv;
+    if (j == 0) {
+      gv = fine_view;
+    } else if (levels[j - 1].stored) {
+      gv = levels[j - 1].graph.view();
+    } else {
+      rebuilt = contract(fine_view, levels[j - 1].cmap.data(),
+                         levels[j - 1].n);
+      std::vector<int32_t>().swap(levels[j - 1].cmap);
+      gv = rebuilt.view();
+    }
     refine(gv, n_parts, objective, refine_iters, imbalance, parts, rng);
     fm_refine(gv, n_parts, objective, imbalance, parts);
-    // the level just consumed is never needed again — free it before
-    // refining finer (bigger) levels so peak RSS is one level's graph,
-    // not the whole hierarchy
-    if (lvl > 0) {
-      coarse[lvl - 1] = Csr();
-      maps[lvl] = std::vector<int32_t>();
-    }
+    if (j > 0) levels[j - 1].graph = Csr();  // consumed
   }
 
   ensure_nonempty(fine_view, n_parts, parts);
