@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""FULL papers100M-shape partition (VERDICT round-3 item 6, at scale).
+
+The reference needs a >=120 GB host for papers100M (reference
+README.md:29-30), where METIS partitioning dominates. This script
+drives the in-tree partitioner over the FULL shape — 111M nodes, 1.6B
+raw directed edges (3.2B after the mirror the chunked CSR builder
+applies) — and reports peak RSS + wall per stage. Edges only: the
+feature/label arrays play no role in partitioning and would exceed
+this host's free disk at full scale; the 1/10-scale run
+(scripts/papers100m_scale.py, results/papers100m_scale.md) covers the
+full load->partition->shard->save pipeline end-to-end.
+
+Same edge distribution as gen_raw_layout (power-law src skew +
+locality windows + jumps).
+
+Usage: python scripts/papers_partition_fullscale.py
+       [--nodes 111000000] [--edges 1600000000] [--parts 64]
+"""
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def gen_edges(path: str, n_nodes: int, n_edges: int,
+              chunk: int = 1 << 24) -> None:
+    rng = np.random.default_rng(0)
+    edges = np.lib.format.open_memmap(
+        path, mode="w+", dtype=np.int32, shape=(n_edges, 2))
+    for i0 in range(0, n_edges, chunk):
+        m = min(chunk, n_edges - i0)
+        src = (rng.pareto(1.5, m) * (n_nodes / 50)).astype(np.int64) \
+            % n_nodes
+        jump = rng.random(m) < 0.1
+        window = rng.integers(-500_000, 500_000, m)
+        dst = np.where(jump, rng.integers(0, n_nodes, m),
+                       (src + window) % n_nodes)
+        edges[i0:i0 + m, 0] = src.astype(np.int32)
+        edges[i0:i0 + m, 1] = dst.astype(np.int32)
+        if i0 % (chunk * 8) == 0:
+            print(f"# gen {i0 / n_edges:.0%}", flush=True)
+    edges.flush()
+    del edges
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=111_000_000)
+    ap.add_argument("--edges", type=int, default=1_600_000_000)
+    ap.add_argument("--parts", type=int, default=64)
+    ap.add_argument("--path",
+                    default=os.path.join(REPO, "partitions",
+                                         "papers_full_edges.npy"))
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "results",
+                                         "papers_full_partition.json"))
+    args = ap.parse_args()
+
+    from pipegcn_tpu.graph.csr import Graph
+    from pipegcn_tpu.partition.partitioner import partition_graph
+
+    stages = {}
+    t0 = time.time()
+    if not os.path.exists(args.path):
+        gen_edges(args.path, args.nodes, args.edges)
+    stages["gen"] = {"s": round(time.time() - t0, 1),
+                     "peak_rss_gb": round(rss_gb(), 2)}
+    print(f"# edges ready ({stages['gen']})", flush=True)
+
+    edges = np.load(args.path, mmap_mode="r")
+    g = Graph(num_nodes=args.nodes, src=edges[:, 0], dst=edges[:, 1])
+
+    t0 = time.time()
+    # symmetric=False: the chunked CSR builder applies the mirror, so
+    # the in-RAM adjacency is the full finalized ~2x raw edge count
+    parts = partition_graph(g, args.parts, method="metis", obj="vol",
+                            seed=0)
+    stages["partition"] = {"s": round(time.time() - t0, 1),
+                           "peak_rss_gb": round(rss_gb(), 2)}
+    sizes = np.bincount(parts, minlength=args.parts)
+    rec = {
+        "nodes": args.nodes,
+        "raw_edges": args.edges,
+        "mirrored_adjacency_entries": 2 * args.edges,
+        "parts": args.parts,
+        "balance": round(float(sizes.max() / sizes.mean()), 4),
+        "stages": stages,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
