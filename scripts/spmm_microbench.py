@@ -178,6 +178,39 @@ def main():
                   f"{dense[0]*1e3:.1f} ms vs pre-unpacked bf16 "
                   f"{unp[0]*1e3:.1f} ms (transient-minus-read delta "
                   f"{(dense[0]-unp[0])*1e3:.1f} ms)")
+        else:
+            unp = None
+
+        # machine-readable record so the cost-model recalibration
+        # (scripts/coverage_sweep.py --gather-rps/--fixed-s) can
+        # consume the decomposition without log scraping
+        import json
+
+        rec = {
+            "backend": jax.default_backend(),
+            "group": args.group,
+            "fused": args.fused,
+            "width": args.width,
+            "full_fwd_s": full[0], "full_fwdbwd_s": full[1],
+            "dense_fwd_s": dense[0], "dense_fwdbwd_s": dense[1],
+            "rem_fwd_s": rem[0], "rem_fwdbwd_s": rem[1],
+            "ftile_collapsed_fwd_s": tile0[0],
+            "a_collapsed_fwd_s": a0[0],
+            "est_spmm_epoch_s": est_epoch,
+        }
+        if unp is not None:
+            rec["wide_a_fwd_s"] = unp[0]
+        # keyed by backend/config so a CPU smoke run or a different
+        # group/fused probe never clobbers the real TPU calibration
+        # record
+        tag = (f"{jax.default_backend()}_g{args.group}"
+               + ("_fused" if args.fused else ""))
+        out_path = os.path.join(REPO, "results",
+                                f"probe_traffic_{tag}.json")
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        print(f"# wrote {out_path}")
 
 
 if __name__ == "__main__":
