@@ -653,7 +653,13 @@ int pgt_partition(int64_t n, const int64_t* indptr, const int32_t* indices,
         levels.empty() ? fine_view
         : (levels.back().stored ? levels.back().graph.view()
                                 : current.view());
-    const int tries = 8;
+    // multi-start assumes a TINY coarsest graph; when coarsening
+    // stalls early (low-locality graphs), each try still sweeps the
+    // full edge set through refine — scale the tries down with size
+    // so initial partitioning stays a minor phase
+    const int64_t cm = coarsest.m();
+    const int tries = cm > 1'000'000'000 ? 2
+                      : cm > 100'000'000 ? 4 : 8;
     int64_t best_obj = INT64_MAX;
     std::vector<int32_t> cand;
     for (int t = 0; t < tries; ++t) {
