@@ -155,3 +155,22 @@ def load_checkpoint(directory: str, template: Dict[str, Any]):
 
 def checkpoint_exists(directory: str) -> bool:
     return os.path.exists(os.path.join(directory, "state.npz"))
+
+
+def peek_epoch(directory: str):
+    """Epoch of the checkpoint in `directory` without a state template
+    (npz members load lazily, so only the scalar is read). Returns None
+    if no checkpoint exists. Lets callers decide completed-vs-resume
+    before paying full state construction (e.g. Trainer build at 114M
+    edges, scripts/convergence_study.py)."""
+    if not checkpoint_exists(directory):
+        return None
+    with np.load(os.path.join(directory, "state.npz")) as data:
+        if "__epoch__" in data.files:
+            return int(data["__epoch__"])
+    # pre-__epoch__ legacy layout: epoch.txt alongside. Raise (not
+    # None) on an unreadable file — load_checkpoint would raise for the
+    # same state, and a silent 0 would let callers truncate resume
+    # history they are about to need
+    with open(os.path.join(directory, "epoch.txt")) as f:
+        return int(f.read().strip())

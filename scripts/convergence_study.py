@@ -56,7 +56,7 @@ def run_leg(leg, sg, g, cfg, args, deadline):
 
     from pipegcn_tpu.parallel import Trainer
     from pipegcn_tpu.utils.checkpoint import (
-        checkpoint_exists, load_checkpoint, save_checkpoint)
+        checkpoint_exists, load_checkpoint, peek_epoch, save_checkpoint)
 
     sdir = os.path.join(args.state_dir, leg)
     hist_path = os.path.join(sdir, "history.jsonl")
@@ -65,16 +65,11 @@ def run_leg(leg, sg, g, cfg, args, deadline):
         with open(hist_path) as f:
             history = [json.loads(l) for l in f if l.strip()]
 
-    # the CHECKPOINT is the source of truth for where to resume — a
-    # kill between the history flush and the checkpoint save must not
-    # wedge the study, so newer history rows are truncated instead
-    t = Trainer(sg, cfg, leg_tcfg(leg, args))
-    if checkpoint_exists(sdir):
-        state, ck_epoch = load_checkpoint(sdir, t.state)
-        t.state = state
-        start = ck_epoch + 1
-    else:
-        start = 0
+    # completed-leg fast path and exhausted-budget bail BEFORE Trainer
+    # construction, which at full scale pays device upload + minutes of
+    # kernel-table work per call
+    ck_epoch = peek_epoch(sdir)
+    start = (ck_epoch + 1) if ck_epoch is not None else 0
     if history and history[-1]["epoch"] >= start:
         history = [r for r in history if r["epoch"] < start]
         with open(hist_path, "w") as f:
@@ -82,23 +77,48 @@ def run_leg(leg, sg, g, cfg, args, deadline):
                 f.write(json.dumps(r) + "\n")
     if start >= args.epochs:
         return True, history
+    if deadline and time.time() > deadline:
+        return False, history
+
+    # the CHECKPOINT is the source of truth for where to resume — a
+    # kill between the history flush and the checkpoint save must not
+    # wedge the study, so newer history rows are truncated instead
+    t = Trainer(sg, cfg, leg_tcfg(leg, args))
+    if checkpoint_exists(sdir):
+        state, _ = load_checkpoint(sdir, t.state)
+        t.state = state
     print(f"# [{leg}] resuming at epoch {start}", flush=True)
 
     os.makedirs(sdir, exist_ok=True)
     hist_f = open(hist_path, "a")
     e = start
     while e < args.epochs:
+        # an already-exhausted budget (e.g. the first full-scale window
+        # spent it on the artifact build) must not commit to another
+        # full eval_every chunk — the outer queue timeout would kill it
+        # mid-chunk and lose the work since the last checkpoint
+        if deadline and time.time() > deadline:
+            print(f"# [{leg}] time budget reached at epoch {e}",
+                  flush=True)
+            hist_f.close()
+            return False, history
         k = min(args.eval_every - (e % args.eval_every),
                 args.epochs - e)
         # sub-chunk the dispatches: one overlong fused Execute can
-        # crash the tunneled TPU worker
+        # crash the tunneled TPU worker. The deadline is re-checked per
+        # sub-chunk so a window never commits to more than --fused
+        # epochs past its budget — the outer queue timeout
+        # (tpu_window.py) SIGKILLs, and everything since the last
+        # checkpoint would be lost
         losses = None
         done_k = 0
         while done_k < k:
             kk = min(args.fused, k - done_k)
             losses = t.train_epochs(e + done_k, kk)
             done_k += kk
-        e += k
+            if deadline and time.time() > deadline:
+                break
+        e += done_k
         rec = {"epoch": e - 1, "loss": round(float(losses[-1]), 5)}
         if e % args.eval_every == 0 or e == args.epochs:
             rec["val"] = round(t.evaluate(g, "val_mask"), 5)
@@ -107,7 +127,10 @@ def run_leg(leg, sg, g, cfg, args, deadline):
         hist_f.write(json.dumps(rec) + "\n")
         hist_f.flush()
         save_checkpoint(sdir, t.state, e - 1)
-        if deadline and time.time() > deadline:
+        # e == args.epochs means the leg FINISHED this window — fall
+        # through to the completion return even if the deadline passed
+        # during the final chunk
+        if e < args.epochs and deadline and time.time() > deadline:
             print(f"# [{leg}] time budget reached at epoch {e}",
                   flush=True)
             hist_f.close()
@@ -126,8 +149,9 @@ def write_report(args, results, backend):
         f"(~{args.nodes * args.degree // 2} undirected edges), "
         f"{args.feat} features, {args.classes} classes, noise "
         f"{args.noise}, homophily {args.homophily}. Model: "
-        f"{args.layers}x{args.hidden} GraphSAGE + use_pp, bf16, P=4 "
-        f"(emulate_parts on {backend}). The reference's comparison "
+        f"{args.layers}x{args.hidden} GraphSAGE + use_pp, bf16, "
+        f"P={args.parts} (emulate_parts on {backend}). The reference's "
+        "comparison "
         "(README.md:91-99) at the density its prior studies lacked.",
         "",
         "| leg | final loss | best val | test @ best val | "
@@ -174,6 +198,86 @@ def write_report(args, results, backend):
     print("\n".join(lines))
 
 
+def build_or_load_artifacts(args):
+    """Generate (or load cached) full graph + ShardedGraph build.
+
+    At 8k nodes the rebuild is seconds and caching is off by default;
+    at full Reddit shape (232,965 nodes / ~114M directed edges) the
+    SBM generation + partition + halo build is tens of host-minutes,
+    so --cache-artifacts persists both (the ShardedGraph via its own
+    artifact format, the eval graph as an npz) and per-window resumes
+    only pay the disk read. ShardedGraph.load also re-arms the derived
+    kernel-table disk cache (cache_dir), so block-table builds are
+    paid once per cache too.
+    """
+    from pipegcn_tpu.graph import Graph, synthetic_graph
+    from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+    cache = os.path.join(args.state_dir, "artifacts") \
+        if args.cache_artifacts else None
+    gpath = os.path.join(cache, "eval_graph.npz") if cache else None
+    # every arg that shapes the generated graph or the build — the
+    # cache key is only the path, so an edited config must be caught
+    # here, not silently trained on the old artifacts
+    ident = {k: getattr(args, k) for k in
+             ("nodes", "degree", "feat", "classes", "noise",
+              "homophily", "parts", "cluster_size")}
+    cfg_path = os.path.join(cache, "config.json") if cache else None
+    if cache and ShardedGraph.exists(cache) and os.path.exists(gpath):
+        t0 = time.time()
+        cached_ident = None
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cached_ident = json.load(f)
+        if cached_ident != ident:
+            raise RuntimeError(
+                f"cached artifacts at {cache} were built for "
+                f"{cached_ident}, not the requested {ident}; delete "
+                "the directory to rebuild")
+        sg = ShardedGraph.load(cache)
+        with np.load(gpath) as z:
+            g = Graph(num_nodes=int(z["num_nodes"]), src=z["src"],
+                      dst=z["dst"],
+                      ndata={k[3:]: z[k] for k in z.files
+                             if k.startswith("nd_")})
+        print(f"# loaded cached artifacts ({time.time() - t0:.1f}s)",
+              flush=True)
+        return g, sg
+
+    t0 = time.time()
+    g = synthetic_graph(
+        num_nodes=args.nodes, avg_degree=args.degree, n_feat=args.feat,
+        n_class=args.classes, homophily=args.homophily,
+        noise=args.noise, train_frac=0.66, val_frac=0.1, seed=0)
+    parts = partition_graph(g, args.parts, seed=0)
+    cluster = None
+    if args.cluster_size:
+        from pipegcn_tpu.partition import locality_clusters
+
+        cluster = locality_clusters(g, target_size=args.cluster_size,
+                                    seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=args.parts,
+                            cluster=cluster)
+    print(f"# built artifacts ({time.time() - t0:.1f}s)", flush=True)
+    if cache:
+        # eval_graph.npz FIRST (atomically, tmp + rename), THEN
+        # sg.save — whose manifest.json is written last and is the
+        # existence guard. A kill anywhere in this sequence leaves
+        # either no manifest (clean rebuild next window) or a fully
+        # valid cache; never a truncated npz behind a valid manifest.
+        os.makedirs(cache, exist_ok=True)
+        tmp = gpath + ".tmp.npz"
+        np.savez(tmp, num_nodes=np.int64(g.num_nodes), src=g.src,
+                 dst=g.dst,
+                 **{f"nd_{k}": v for k, v in g.ndata.items()})
+        os.replace(tmp, gpath)
+        with open(cfg_path, "w") as f:
+            json.dump(ident, f)
+        sg.save(cache)
+        sg.cache_dir = cache
+    return g, sg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=8000)
@@ -194,6 +298,31 @@ def main():
     ap.add_argument("--eval-every", type=int, default=50)
     ap.add_argument("--time-budget", type=float, default=0,
                     help="seconds; stop cleanly (resumable) when hit")
+    ap.add_argument("--parts", type=int, default=4,
+                    help="partitions (emulated on one device); the "
+                         "reference's Reddit headline uses 2 "
+                         "(reference scripts/reddit.sh)")
+    ap.add_argument("--cluster-size", type=int, default=0,
+                    help="locality-cluster reorder target for the "
+                         "block kernel (0 = none; full-scale runs "
+                         "want the bench's 1024)")
+    ap.add_argument("--cache-artifacts", action="store_true",
+                    help="cache the graph + ShardedGraph build under "
+                         "--state-dir so per-window resumes skip the "
+                         "O(E) host rebuild (essential at full "
+                         "Reddit scale)")
+    ap.add_argument("--spmm-impl", default="xla",
+                    help="aggregation kernel (bench.py surface); the "
+                         "full-scale run needs 'auto' — the raw xla "
+                         "gather path cannot hold [57M, 602] "
+                         "activations on one chip")
+    ap.add_argument("--spmm-chunk", type=int, default=0,
+                    help="bound raw-path gathered messages to [chunk, "
+                         "F] per pass (0 = unchunked; bench.py uses "
+                         "2097152 at Reddit shape)")
+    ap.add_argument("--block-group", type=int, default=1,
+                    help="union-gather group size for the block "
+                         "kernel's dense path")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--state-dir",
                     default="results/convergence_state")
@@ -213,17 +342,10 @@ def main():
     if backend.startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")
 
-    from pipegcn_tpu.graph import synthetic_graph
     from pipegcn_tpu.models import ModelConfig
-    from pipegcn_tpu.partition import ShardedGraph, partition_graph
 
     deadline = time.time() + args.time_budget if args.time_budget else 0
-    g = synthetic_graph(
-        num_nodes=args.nodes, avg_degree=args.degree, n_feat=args.feat,
-        n_class=args.classes, homophily=args.homophily,
-        noise=args.noise, train_frac=0.66, val_frac=0.1, seed=0)
-    parts = partition_graph(g, 4, seed=0)
-    sg = ShardedGraph.build(g, parts, n_parts=4)
+    g, sg = build_or_load_artifacts(args)
     print(f"# graph: {g.num_nodes} nodes / {g.num_edges} directed "
           f"edges; halo {sg.halo_size} rows/device "
           f"({sg.halo_size / sg.n_max:.1%} of inner)", flush=True)
@@ -231,7 +353,10 @@ def main():
         layer_sizes=(sg.n_feat,) + (args.hidden,) * (args.layers - 1)
         + (sg.n_class,),
         use_pp=True, norm="layer", dropout=0.5,
-        train_size=sg.n_train_global, dtype="bfloat16")
+        train_size=sg.n_train_global, dtype="bfloat16",
+        spmm_impl=args.spmm_impl,
+        spmm_chunk=args.spmm_chunk or None,
+        block_group=args.block_group)
 
     results = {}
     all_done = True

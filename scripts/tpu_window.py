@@ -71,6 +71,22 @@ QUEUE = [
      [sys.executable, "scripts/convergence_study.py",
       "--time-budget", "1500"],
      2400),
+    # VERDICT r3 item 3, full scale: the 97.1%-claim analogue at FULL
+    # node count AND full degree (232,965 nodes x avg degree 492 =
+    # Reddit's shape, reference README.md:91-99), P=2 like the
+    # reference's scripts/reddit.sh, 3000 epochs x 3 legs. Resumable
+    # + artifact-cached: each window pass advances it by its budget.
+    ("convergence_full",
+     [sys.executable, "scripts/convergence_study.py",
+      "--nodes", "232965", "--degree", "492", "--feat", "602",
+      "--classes", "41", "--parts", "2", "--cluster-size", "1024",
+      "--spmm-impl", "auto", "--spmm-chunk", "2097152",
+      "--block-group", "4",
+      "--fused", "8", "--eval-every", "100",
+      "--cache-artifacts", "--time-budget", "3600",
+      "--state-dir", "results/convergence_state_full",
+      "--out", "results/convergence_fullscale.md"],
+     7200),
 ]
 
 

@@ -90,6 +90,19 @@ def test_measure_comm():
     assert 0 < cost["bgrad"] < 5
 
 
+def test_checkpoint_peek_epoch(tmp_path):
+    """peek_epoch reads the checkpoint epoch without a state template
+    (templateless completed-leg detection, scripts/convergence_study.py)."""
+    from pipegcn_tpu.utils.checkpoint import (
+        peek_epoch, save_checkpoint)
+
+    d = str(tmp_path / "ck")
+    assert peek_epoch(d) is None
+    state = {"params": {"w": np.ones((2, 2), np.float32)}}
+    save_checkpoint(d, state, 41)
+    assert peek_epoch(d) == 41
+
+
 def test_checkpoint_bf16_roundtrip(tmp_path):
     """bf16 leaves survive npz save/load (stored as tagged uint16 views;
     np.savez would otherwise return raw void '|V2')."""
