@@ -141,15 +141,22 @@ def _sweep_stale_tmps(directory: str, min_age_s: float = 3600.0) -> None:
             pass
 
 
+def _legacy_epoch(directory: str) -> int:
+    """Epoch of a pre-__epoch__ checkpoint layout (epoch.txt alongside
+    state.npz). Raises if unreadable — a silent default would let
+    callers resume from the wrong epoch."""
+    with open(os.path.join(directory, "epoch.txt")) as f:
+        return int(f.read().strip())
+
+
 def load_checkpoint(directory: str, template: Dict[str, Any]):
     """Returns (state, next_epoch) restored from save_checkpoint."""
     state, extras = load_pytree(os.path.join(directory, "state.npz"),
                                 template, with_extras=True)
     if "__epoch__" in extras:
         epoch = int(extras["__epoch__"])
-    else:  # checkpoints from before the epoch moved into the npz
-        with open(os.path.join(directory, "epoch.txt")) as f:
-            epoch = int(f.read().strip())
+    else:
+        epoch = _legacy_epoch(directory)
     return state, epoch
 
 
@@ -168,9 +175,4 @@ def peek_epoch(directory: str):
     with np.load(os.path.join(directory, "state.npz")) as data:
         if "__epoch__" in data.files:
             return int(data["__epoch__"])
-    # pre-__epoch__ legacy layout: epoch.txt alongside. Raise (not
-    # None) on an unreadable file — load_checkpoint would raise for the
-    # same state, and a silent 0 would let callers truncate resume
-    # history they are about to need
-    with open(os.path.join(directory, "epoch.txt")) as f:
-        return int(f.read().strip())
+    return _legacy_epoch(directory)

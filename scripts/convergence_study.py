@@ -127,14 +127,8 @@ def run_leg(leg, sg, g, cfg, args, deadline):
         hist_f.write(json.dumps(rec) + "\n")
         hist_f.flush()
         save_checkpoint(sdir, t.state, e - 1)
-        # e == args.epochs means the leg FINISHED this window — fall
-        # through to the completion return even if the deadline passed
-        # during the final chunk
-        if e < args.epochs and deadline and time.time() > deadline:
-            print(f"# [{leg}] time budget reached at epoch {e}",
-                  flush=True)
-            hist_f.close()
-            return False, history
+        # deadline-after-checkpoint: handled by the top-of-loop check
+        # (e == args.epochs instead exits to the completion return)
     hist_f.close()
     print(f"# [{leg}] complete: {history[-1]}", flush=True)
     return True, history

@@ -70,27 +70,44 @@ def main():
 
     from pipegcn_tpu.ops.block_spmm import make_device_block_spmm_fn
 
+    interp = jax.default_backend() == "cpu"
+
     def variant(name, keep):
+        # The tables ride as jit ARGUMENTS, never closure constants:
+        # jit embeds closed-over arrays into the HLO, and the axon
+        # tunnel ships that HLO as one remote_compile HTTP body — GBs
+        # of embedded tables exceed its length limit (HTTP 413). The
+        # factory's host logic depends only on dict keys/shapes, so
+        # re-invoking it under trace is sound (the Trainer passes the
+        # same tables as shard_map operands for the same reason).
         dd = {k: v for k, v in d.items() if keep(k)}
-        fn = jax.jit(make_device_block_spmm_fn(
-            dd, d["in_deg"], n_max, n_src, tr._block_tile,
-            chunk_edges=cfg.spmm_chunk,
-            interpret=jax.default_backend() == "cpu"))
-        grad = jax.jit(jax.grad(lambda f: fn(f).astype(jnp.float32).sum()))
+
+        def apply(tables, in_deg, f):
+            fn = make_device_block_spmm_fn(
+                tables, in_deg, n_max, n_src, tr._block_tile,
+                chunk_edges=cfg.spmm_chunk, interpret=interp)
+            return fn(f)
+
+        fwd = jax.jit(apply)
+
+        @jax.jit
+        def grad(tables, in_deg, f):
+            return jax.grad(lambda ff: apply(tables, in_deg, ff)
+                            .astype(jnp.float32).sum())(f)
 
         def timed(g, label):
-            g(fbuf)  # compile
-            float(jnp.sum(g(fbuf)[0]))
+            g(dd, d["in_deg"], fbuf)  # compile
+            float(jnp.sum(g(dd, d["in_deg"], fbuf)[0]))
             ts = []
             for _ in range(args.reps):
                 t0 = time.perf_counter()
-                float(jnp.sum(g(fbuf)[0]))
+                float(jnp.sum(g(dd, d["in_deg"], fbuf)[0]))
                 ts.append(time.perf_counter() - t0)
             print(f"{name:12s} {label:8s} {min(ts)*1e3:8.1f} ms",
                   file=sys.stderr)
             return min(ts)
 
-        f = timed(fn, "fwd")
+        f = timed(fwd, "fwd")
         fb = timed(grad, "fwd+bwd")
         return f, fb
 
