@@ -352,6 +352,20 @@ class Trainer:
             use_block()
             return
 
+        # the Pallas CSR kernel's grid cannot carry the emulate_parts
+        # vmap batch axis (the TPU lowering rejects the batched block
+        # shapes — observed on-chip, round 4); emulated runs use the
+        # XLA-composed paths
+        if self.emulated:
+            if impl == "pallas":
+                raise ValueError(
+                    "spmm_impl='pallas' does not support emulate_parts "
+                    "(vmap-batched Pallas grid); use 'auto', 'block' or "
+                    "'bucket'")
+            if impl == "auto":
+                use_large()
+                return
+
         # cheap VMEM gate first (needs only shapes) — skip the O(E) table
         # build when 'auto' will reject the shard anyway
         n_src_rows = self.sg.n_max + self.sg.halo_size

@@ -142,7 +142,8 @@ def write_report(args, results, backend):
         f"Graph: {args.nodes} nodes / avg degree {args.degree} "
         f"(~{args.nodes * args.degree // 2} undirected edges), "
         f"{args.feat} features, {args.classes} classes, noise "
-        f"{args.noise}, homophily {args.homophily}. Model: "
+        f"{args.noise}, label noise {args.label_noise}, homophily "
+        f"{args.homophily}. Model: "
         f"{args.layers}x{args.hidden} GraphSAGE + use_pp, bf16, "
         f"P={args.parts} (emulate_parts on {backend}). The reference's "
         "comparison "
@@ -192,6 +193,38 @@ def write_report(args, results, backend):
     print("\n".join(lines))
 
 
+def graph_ident(args):
+    """Every arg that shapes the generated graph or the build — cache
+    and leg-state keys are only paths, so an edited config must be
+    caught by comparing this, not silently trained across tasks."""
+    return {k: getattr(args, k) for k in
+            ("nodes", "degree", "feat", "classes", "noise",
+             "label_noise", "homophily", "parts", "cluster_size")}
+
+
+def check_task_identity(args):
+    """Refuse to resume LEG state (checkpoints + history) recorded for
+    a different task or training config — unlike the derived artifact
+    cache (rebuilt in place on mismatch), thousands of trained epochs
+    must never be silently mixed across tasks or auto-deleted."""
+    ident = {**graph_ident(args), "hidden": args.hidden,
+             "layers": args.layers, "lr": args.lr}
+    path = os.path.join(args.state_dir, "task.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            prev = json.load(f)
+        if prev != ident:
+            raise RuntimeError(
+                f"state dir {args.state_dir} holds legs trained on "
+                f"{prev}, not the requested {ident}; point "
+                "--state-dir at a fresh directory (or delete it) to "
+                "start this study")
+    else:
+        os.makedirs(args.state_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(ident, f)
+
+
 def build_or_load_artifacts(args):
     """Generate (or load cached) full graph + ShardedGraph build.
 
@@ -210,12 +243,7 @@ def build_or_load_artifacts(args):
     cache = os.path.join(args.state_dir, "artifacts") \
         if args.cache_artifacts else None
     gpath = os.path.join(cache, "eval_graph.npz") if cache else None
-    # every arg that shapes the generated graph or the build — the
-    # cache key is only the path, so an edited config must be caught
-    # here, not silently trained on the old artifacts
-    ident = {k: getattr(args, k) for k in
-             ("nodes", "degree", "feat", "classes", "noise",
-              "homophily", "parts", "cluster_size")}
+    ident = graph_ident(args)
     cfg_path = os.path.join(cache, "config.json") if cache else None
     if cache and ShardedGraph.exists(cache) and os.path.exists(gpath):
         t0 = time.time()
@@ -224,10 +252,17 @@ def build_or_load_artifacts(args):
             with open(cfg_path) as f:
                 cached_ident = json.load(f)
         if cached_ident != ident:
-            raise RuntimeError(
-                f"cached artifacts at {cache} were built for "
-                f"{cached_ident}, not the requested {ident}; delete "
-                "the directory to rebuild")
+            # derived cache for a different config: rebuild in place
+            # (an unattended queue must not wedge on a config edit;
+            # cross-task LEG state is guarded separately by task.json,
+            # which refuses rather than deletes)
+            import shutil
+
+            print(f"# cached artifacts at {cache} were built for "
+                  f"{cached_ident}, not {ident} — rebuilding",
+                  flush=True)
+            shutil.rmtree(cache)
+            return build_or_load_artifacts(args)
         sg = ShardedGraph.load(cache)
         with np.load(gpath) as z:
             g = Graph(num_nodes=int(z["num_nodes"]), src=z["src"],
@@ -242,7 +277,8 @@ def build_or_load_artifacts(args):
     g = synthetic_graph(
         num_nodes=args.nodes, avg_degree=args.degree, n_feat=args.feat,
         n_class=args.classes, homophily=args.homophily,
-        noise=args.noise, train_frac=0.66, val_frac=0.1, seed=0)
+        noise=args.noise, label_noise=args.label_noise,
+        train_frac=0.66, val_frac=0.1, seed=0)
     parts = partition_graph(g, args.parts, seed=0)
     cluster = None
     if args.cluster_size:
@@ -283,6 +319,11 @@ def main():
     ap.add_argument("--epochs", type=int, default=3000)
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--noise", type=float, default=4.0)
+    ap.add_argument("--label-noise", type=float, default=0.0,
+                    help="fraction of labels flipped to a random other "
+                         "class (accuracy ceiling ~1-p; full-density "
+                         "studies need it — degree-492 aggregation "
+                         "saturates clean SBM tasks at 100%)")
     ap.add_argument("--homophily", type=float, default=0.7)
     ap.add_argument("--fused", type=int, default=25,
                     help="epochs per fused device dispatch (long "
@@ -338,6 +379,7 @@ def main():
 
     from pipegcn_tpu.models import ModelConfig
 
+    check_task_identity(args)
     deadline = time.time() + args.time_budget if args.time_budget else 0
     g, sg = build_or_load_artifacts(args)
     print(f"# graph: {g.num_nodes} nodes / {g.num_edges} directed "

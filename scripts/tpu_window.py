@@ -69,6 +69,7 @@ QUEUE = [
     # per-leg checkpoints, so each window advances it by its budget
     ("convergence_study",
      [sys.executable, "scripts/convergence_study.py",
+      "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
       "--time-budget", "1500"],
      2400),
     # VERDICT r3 item 3, full scale: the 97.1%-claim analogue at FULL
@@ -80,7 +81,8 @@ QUEUE = [
      [sys.executable, "scripts/convergence_study.py",
       "--nodes", "232965", "--degree", "492", "--feat", "602",
       "--classes", "41", "--parts", "2", "--cluster-size", "1024",
-      "--spmm-impl", "auto", "--spmm-chunk", "2097152",
+      "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
+      "--spmm-impl", "auto", "--spmm-chunk", "524288",
       "--block-group", "4",
       "--fused", "8", "--eval-every", "100",
       "--cache-artifacts", "--time-budget", "3600",

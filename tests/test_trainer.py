@@ -343,6 +343,23 @@ def test_identity_collectives_switch(graph):
     assert not np.allclose(real, ident, rtol=1e-6)
 
 
+def test_emulate_auto_never_picks_pallas(graph):
+    """emulate_parts + spmm_impl='auto' must route around the Pallas
+    CSR kernel (its grid cannot carry the emulation vmap batch axis —
+    TPU lowering rejects it, observed round 4); forcing 'pallas' under
+    emulation raises."""
+    parts = partition_graph(graph, 4, seed=0)
+    sg = ShardedGraph.build(graph, parts, n_parts=4)
+    cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
+                      train_size=sg.n_train_global, spmm_impl="auto")
+    tc = TrainConfig(seed=0, emulate_parts=True)
+    t = Trainer(sg, cfg, tc)
+    assert t._pallas_tables is None
+    assert np.isfinite(t.train_epoch(0))
+    with pytest.raises(ValueError, match="emulate_parts"):
+        Trainer(sg, dataclasses.replace(cfg, spmm_impl="pallas"), tc)
+
+
 def test_emulate_parts_matches_mesh(graph):
     """emulate_parts=True (vmap-with-axis_name on ONE device) must
     reproduce the real shard_map mesh run to float rounding — losses
