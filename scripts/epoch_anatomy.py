@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Attribute the non-SpMM epoch floor by config ablation on the chip.
+
+The probe-traffic decomposition (results/probe_traffic_tpu_g1.json)
+puts the SpMM terms at 0.982 s of the measured 1.5006 s epoch; the
+remaining 0.518 s floor covers linears, norms, dropout RNG, fbuf
+assembly and dispatch. This script times the SAME production config
+with one ingredient removed at a time — the deltas attribute the
+floor to its parts so the next kernel/layout lever targets the right
+term (the reference has no analogue; this is perf tooling for the
+driver headline, reference README.md:93-94).
+
+Variants: baseline (block-u4-float8, the headline config) |
+dropout=0 (no RNG, no mask traffic) | norm=None (no LayerNorm
+fwd/bwd) | n_linear tail only dispatch floor probe: fused=1 vs 4.
+
+Usage: python scripts/epoch_anatomy.py [--part ...] [--reps 3]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def time_config(sg, cfg, tcfg, reps, blk):
+    from pipegcn_tpu.parallel import Trainer
+
+    t0 = time.perf_counter()
+    tr = Trainer(sg, cfg, tcfg)
+    setup = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    tr.train_epochs(0, 1)
+    compile_s = time.perf_counter() - t0
+    if blk > 1:
+        tr.train_epochs(1, blk)  # fused-program compile, off the clock
+    times = []
+    e = 1 + blk
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        tr.train_epochs(e, blk)
+        times.append((time.perf_counter() - t0) / blk)
+        e += blk
+    del tr
+    return float(np.median(times)), setup, compile_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--part",
+                    default="partitions/bench-reddit-1-c2-s1024")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--blk", type=int, default=4)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--out", default="results/epoch_anatomy.json")
+    args = ap.parse_args()
+
+    from bench import init_backend
+
+    backend = init_backend(1, 60.0, args.cpu)
+    import dataclasses
+
+    import jax
+
+    if backend.startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import TrainConfig
+    from pipegcn_tpu.partition import ShardedGraph
+
+    sg = ShardedGraph.load(args.part)
+    base = ModelConfig(
+        layer_sizes=(sg.n_feat, 256, 256, 256, sg.n_class),
+        use_pp=True, norm="layer", dropout=0.5,
+        train_size=sg.n_train_global, spmm_chunk=2_097_152,
+        dtype="bfloat16", spmm_impl="block", block_group=4,
+        rem_dtype="float8")
+    tcfg = TrainConfig(lr=0.01, n_epochs=200, enable_pipeline=True,
+                       eval=False, fused_epochs=args.blk, seed=0)
+
+    variants = [
+        ("baseline", base, tcfg),
+        ("dropout0", dataclasses.replace(base, dropout=0.0), tcfg),
+        ("no-norm", dataclasses.replace(base, norm=None), tcfg),
+        ("fused1", base, dataclasses.replace(tcfg, fused_epochs=1)),
+    ]
+    rec = {"backend": jax.default_backend()}
+    base_s = None
+    for name, cfg, tc in variants:
+        blk = tc.fused_epochs
+        s, setup, comp = time_config(sg, cfg, tc, args.reps, blk)
+        rec[name] = round(s, 4)
+        delta = "" if base_s is None else f" (delta {s - base_s:+.4f})"
+        base_s = base_s if base_s is not None else s
+        print(f"# {name}: {s:.4f} s/epoch{delta} "
+              f"(setup {setup:.0f}s compile {comp:.0f}s)", flush=True)
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

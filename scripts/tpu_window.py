@@ -65,6 +65,11 @@ QUEUE = [
     ("bench_default",
      [sys.executable, "bench.py"],
      3600),
+    # attribute the 0.518 s non-SpMM floor (probe round 4): ablate
+    # dropout RNG / LayerNorm / dispatch amortization on the chip
+    ("epoch_anatomy",
+     [sys.executable, "scripts/epoch_anatomy.py"],
+     2400),
     # full-density convergence study (VERDICT item 3): resumable via
     # per-leg checkpoints, so each window advances it by its budget
     ("convergence_study",
