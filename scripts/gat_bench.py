@@ -30,6 +30,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--part",
                     default="partitions/bench-reddit-1-c2-s1024")
+    ap.add_argument("--dataset", default=None,
+                    help="build (and cache) a dedicated artifact from "
+                         "this dataset spec instead of --part — e.g. "
+                         "synthetic:60000:30:602:41. The full "
+                         "Reddit-scale GAT epoch exceeds the tunnel's "
+                         "~80 s execute ceiling and crashes the worker "
+                         "(results/tpu_window/gat_bench.log, round 4), "
+                         "so chip rankings run at a reduced scale")
     ap.add_argument("--impl", default="bucket",
                     choices=["bucket", "xla"])
     ap.add_argument("--epochs", type=int, default=4,
@@ -53,7 +61,26 @@ def main():
     from pipegcn_tpu.parallel import Trainer, TrainConfig
     from pipegcn_tpu.partition import ShardedGraph
 
-    sg = ShardedGraph.load(args.part)
+    if args.dataset:
+        part_path = os.path.join(
+            "partitions",
+            "gat-" + args.dataset.replace(":", "_") + "-c-s1024")
+        if ShardedGraph.exists(part_path):
+            sg = ShardedGraph.load(part_path)
+        else:
+            from pipegcn_tpu.graph import load_data
+            from pipegcn_tpu.partition import (locality_clusters,
+                                               partition_graph)
+
+            g = load_data(args.dataset)
+            parts = partition_graph(g, 1, seed=0)
+            cluster = locality_clusters(g, target_size=1024, seed=0)
+            sg = ShardedGraph.build(g, parts, n_parts=1,
+                                    cluster=cluster)
+            sg.save(part_path)
+            sg.cache_dir = part_path
+    else:
+        sg = ShardedGraph.load(args.part)
     cfg = ModelConfig(
         # 3 graph layers like the SAGE headline (no use_pp for GAT)
         layer_sizes=(sg.n_feat, args.hidden, args.hidden, args.hidden,
