@@ -63,15 +63,6 @@ QUEUE = [
      [sys.executable, "scripts/gat_bench.py",
       "--dataset", "synthetic:60000:30:602:41"],
      3600),
-    ("gat_bench_small_xla",
-     [sys.executable, "scripts/gat_bench.py",
-      "--dataset", "synthetic:60000:30:602:41", "--impl", "xla"],
-     3600),
-    ("gat_bench_small_f8",
-     [sys.executable, "scripts/gat_bench.py",
-      "--dataset", "synthetic:60000:30:602:41",
-      "--rem-dtype", "float8"],
-     3600),
     ("bench_default",
      [sys.executable, "bench.py"],
      3600),
@@ -104,6 +95,18 @@ QUEUE = [
       "--state-dir", "results/convergence_state_full",
       "--out", "results/convergence_fullscale.md"],
      7200),
+    # LAST: the raw-xla GAT compile crashed the remote compile helper
+    # once (HTTP 500) around a tunnel death — quarantined at the tail
+    # so a repeat cannot burn the load-bearing steps above
+    ("gat_bench_small_f8",
+     [sys.executable, "scripts/gat_bench.py",
+      "--dataset", "synthetic:60000:30:602:41",
+      "--rem-dtype", "float8"],
+     3600),
+    ("gat_bench_small_xla",
+     [sys.executable, "scripts/gat_bench.py",
+      "--dataset", "synthetic:60000:30:602:41", "--impl", "xla"],
+     3600),
 ]
 
 
