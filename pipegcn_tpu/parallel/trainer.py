@@ -283,6 +283,17 @@ class Trainer:
 
                 self._gat_tables = self._cached_tables(
                     "gat", lambda: build_sharded_gat_tables(self.sg))
+            if (self.cfg.rem_dtype is None
+                    and float(np.mean(self.sg.edge_count)) > 2e7):
+                import warnings
+
+                warnings.warn(
+                    "GAT at this edge count without --rem-dtype "
+                    "float8: bf16 transport measured ~2x the epoch "
+                    "time and crashed the tunneled TPU worker at "
+                    "Reddit scale (results/gat_tpu_bench.md); fp8 is "
+                    "accuracy-validated (results/"
+                    "staleness_parity_gat.md)")
             return
         if impl == "xla":
             return

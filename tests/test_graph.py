@@ -61,6 +61,28 @@ def test_synthetic_graph_shapes():
     assert (g.src == g.dst).sum() == 500
 
 
+def test_synthetic_label_noise():
+    """label_noise flips ~p of labels to a DIFFERENT class (the
+    irreducible-error ceiling full-density convergence studies rely
+    on); 0.0 is bit-identical to the pre-feature generator."""
+    g0 = synthetic_graph(num_nodes=4000, avg_degree=6, n_feat=8,
+                         n_class=7, seed=3)
+    g0b = synthetic_graph(num_nodes=4000, avg_degree=6, n_feat=8,
+                          n_class=7, seed=3, label_noise=0.0)
+    assert (g0.ndata["label"] == g0b.ndata["label"]).all()
+    gn = synthetic_graph(num_nodes=4000, avg_degree=6, n_feat=8,
+                         n_class=7, seed=3, label_noise=0.25)
+    flipped = (gn.ndata["label"] != g0.ndata["label"])
+    frac = flipped.mean()
+    assert 0.18 < frac < 0.32, frac  # ~Binomial(4000, .25)
+    # flips always land on a different class, never out of range
+    assert (gn.ndata["label"] >= 0).all()
+    assert (gn.ndata["label"] < 7).all()
+    # graph structure and features untouched
+    assert (gn.src == g0.src).all()
+    np.testing.assert_array_equal(gn.ndata["feat"], g0.ndata["feat"])
+
+
 def test_synthetic_multilabel():
     g = synthetic_graph(num_nodes=200, n_class=6, multilabel=True, seed=2)
     assert is_multilabel(g)
