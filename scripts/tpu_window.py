@@ -95,6 +95,10 @@ QUEUE = [
       "--state-dir", "results/convergence_state_full",
       "--out", "results/convergence_fullscale.md"],
      7200),
+    # per-pass attribution of the 38 s GAT epoch (bucket-only, safe)
+    ("gat_microbench",
+     [sys.executable, "scripts/gat_microbench.py"],
+     2400),
     # LAST: the raw-xla GAT compile crashed the remote compile helper
     # once (HTTP 500) around a tunnel death — quarantined at the tail
     # so a repeat cannot burn the load-bearing steps above
