@@ -60,21 +60,58 @@ def run_leg(leg, sg, g, cfg, args, deadline):
 
     sdir = os.path.join(args.state_dir, leg)
     hist_path = os.path.join(sdir, "history.jsonl")
+    lhist_path = None
+    if args.light_dir:
+        os.makedirs(args.light_dir, exist_ok=True)
+        lhist_path = os.path.join(args.light_dir,
+                                  f"{leg}_history.jsonl")
+    def write_rows(path, rows):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
     history = []
-    if os.path.exists(hist_path):
-        with open(hist_path) as f:
+    src = hist_path if os.path.exists(hist_path) else (
+        lhist_path if lhist_path and os.path.exists(lhist_path)
+        else None)
+    if src:
+        with open(src) as f:
             history = [json.loads(l) for l in f if l.strip()]
 
     # completed-leg fast path and exhausted-budget bail BEFORE Trainer
     # construction, which at full scale pays device upload + minutes of
-    # kernel-table work per call
+    # kernel-table work per call. The LIGHT checkpoint (params+opt+norm
+    # only, git-committable ~MBs) backs the full local one: gitignored
+    # state did not survive the round-3->4 boundary, and losing hours
+    # of full-scale training to a workspace wipe is not acceptable.
+    light = os.path.join(args.light_dir, f"{leg}.npz") \
+        if args.light_dir else None
     ck_epoch = peek_epoch(sdir)
+    from_light = False
+    if ck_epoch is None and light and os.path.exists(light):
+        with np.load(light) as zz:
+            ck_epoch = int(zz["__epoch__"])
+        from_light = True
     start = (ck_epoch + 1) if ck_epoch is not None else 0
     if history and history[-1]["epoch"] >= start:
         history = [r for r in history if r["epoch"] < start]
-        with open(hist_path, "w") as f:
-            for r in history:
-                f.write(json.dumps(r) + "\n")
+        write_rows(hist_path, history)
+        if lhist_path and os.path.exists(lhist_path):
+            write_rows(lhist_path, history)
+    if src == lhist_path and not os.path.exists(hist_path) and history:
+        # re-seed the authoritative copy after a workspace wipe
+        write_rows(hist_path, history)
+    if lhist_path and src == hist_path and history:
+        # seed/catch-up the survival mirror: --light-dir may be enabled
+        # mid-study, and a gapped mirror would later become the
+        # authoritative history after a wipe
+        lrows = []
+        if os.path.exists(lhist_path):
+            with open(lhist_path) as f:
+                lrows = [json.loads(l) for l in f if l.strip()]
+        if len(lrows) < len(history):
+            write_rows(lhist_path, history)
     if start >= args.epochs:
         return True, history
     if deadline and time.time() > deadline:
@@ -87,6 +124,26 @@ def run_leg(leg, sg, g, cfg, args, deadline):
     if checkpoint_exists(sdir):
         state, _ = load_checkpoint(sdir, t.state)
         t.state = state
+    elif from_light:
+        # params/opt/norm from the light checkpoint over a fresh
+        # trainer: the staleness/EMA carries restart from zeros and
+        # re-warm within ~an epoch (the staleness-exactness property).
+        # The file stores replica 0 only (the psum'd update keeps every
+        # part's copy identical); re-broadcast over the leading P axis
+        import jax.numpy as jnp
+
+        from pipegcn_tpu.utils.checkpoint import load_pytree
+
+        subset = {k: t.state[k] for k in ("params", "opt", "norm")}
+        tmpl0 = jax.tree_util.tree_map(lambda v: v[0], subset)
+        r0 = load_pytree(light, tmpl0)
+        restored = jax.tree_util.tree_map(
+            lambda full, x: jnp.broadcast_to(x, full.shape)
+            .astype(full.dtype), subset, r0)
+        t.state = {**t.state, **restored}
+        print(f"# [{leg}] light-resume at epoch {start} "
+              "(staleness/EMA carries reset; re-warm ~1 epoch)",
+              flush=True)
     print(f"# [{leg}] resuming at epoch {start}", flush=True)
 
     os.makedirs(sdir, exist_ok=True)
@@ -126,7 +183,23 @@ def run_leg(leg, sg, g, cfg, args, deadline):
         history.append(rec)
         hist_f.write(json.dumps(rec) + "\n")
         hist_f.flush()
+        if lhist_path:
+            with open(lhist_path, "a") as lf:
+                lf.write(json.dumps(rec) + "\n")
         save_checkpoint(sdir, t.state, e - 1)
+        if light:
+            from pipegcn_tpu.utils.checkpoint import save_pytree
+
+            os.makedirs(args.light_dir, exist_ok=True)
+            # replica 0 only: every part's params/opt/norm copy is
+            # identical by the psum'd update, so committing all P is
+            # pure repo bloat
+            save_pytree(
+                light,
+                jax.tree_util.tree_map(
+                    lambda v: np.asarray(v[0]),
+                    {k: t.state[k] for k in ("params", "opt", "norm")}),
+                extra={"__epoch__": np.asarray(e - 1, np.int64)})
         # deadline-after-checkpoint: handled by the top-of-loop check
         # (e == args.epochs instead exits to the completion return)
     hist_f.close()
@@ -206,23 +279,28 @@ def check_task_identity(args):
     """Refuse to resume LEG state (checkpoints + history) recorded for
     a different task or training config — unlike the derived artifact
     cache (rebuilt in place on mismatch), thousands of trained epochs
-    must never be silently mixed across tasks or auto-deleted."""
+    must never be silently mixed across tasks or auto-deleted. The
+    stamp lives in BOTH --state-dir and --light-dir: after a workspace
+    wipe only the light dir survives, and a light resume must be
+    guarded just as strictly."""
     ident = {**graph_ident(args), "hidden": args.hidden,
              "layers": args.layers, "lr": args.lr}
-    path = os.path.join(args.state_dir, "task.json")
-    if os.path.exists(path):
-        with open(path) as f:
-            prev = json.load(f)
-        if prev != ident:
-            raise RuntimeError(
-                f"state dir {args.state_dir} holds legs trained on "
-                f"{prev}, not the requested {ident}; point "
-                "--state-dir at a fresh directory (or delete it) to "
-                "start this study")
-    else:
-        os.makedirs(args.state_dir, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(ident, f)
+    dirs = [args.state_dir] + ([args.light_dir] if args.light_dir
+                               else [])
+    for d in dirs:
+        path = os.path.join(d, "task.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                prev = json.load(f)
+            if prev != ident:
+                raise RuntimeError(
+                    f"{d} holds legs trained on {prev}, not the "
+                    f"requested {ident}; point the study at a fresh "
+                    "directory (or delete it) to start over")
+        else:
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(ident, f)
 
 
 def build_or_load_artifacts(args):
@@ -359,6 +437,14 @@ def main():
                     help="union-gather group size for the block "
                          "kernel's dense path")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--light-dir", default="",
+                    help="git-TRACKED dir for compact per-leg "
+                         "checkpoints (params+opt+norm, ~MBs) + "
+                         "history mirrors; survives the workspace "
+                         "wipe between driver rounds, unlike the "
+                         "gitignored --state-dir. Resume from it "
+                         "resets the staleness carries (~1-epoch "
+                         "re-warm)")
     ap.add_argument("--state-dir",
                     default="results/convergence_state")
     ap.add_argument("--out",

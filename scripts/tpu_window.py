@@ -76,6 +76,7 @@ QUEUE = [
     ("convergence_study",
      [sys.executable, "scripts/convergence_study.py",
       "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
+      "--light-dir", "results/convergence_light/d492",
       "--time-budget", "1500"],
      2400),
     # VERDICT r3 item 3, full scale: the 97.1%-claim analogue at FULL
@@ -92,6 +93,7 @@ QUEUE = [
       "--block-group", "4",
       "--fused", "8", "--eval-every", "100",
       "--cache-artifacts", "--time-budget", "3600",
+      "--light-dir", "results/convergence_light/full",
       "--state-dir", "results/convergence_state_full",
       "--out", "results/convergence_fullscale.md"],
      7200),
