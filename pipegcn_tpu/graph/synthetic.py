@@ -103,9 +103,13 @@ def synthetic_graph(
             # the real datasets (Reddit tops out at 97.1%, reference
             # README.md:98) — without it, high-degree aggregation
             # saturates SBM tasks at 100% and convergence comparisons
-            # lose their resolution
-            flip = rng.random(num_nodes) < label_noise
-            shift = rng.integers(1, n_class, size=num_nodes)
+            # lose their resolution. Drawn from a DEDICATED generator
+            # so the split permutation below is identical across
+            # label_noise settings at a fixed seed (a clean-vs-noisy
+            # comparison must not also change train/val/test masks).
+            nrng = np.random.default_rng(seed ^ 0x5EED)
+            flip = nrng.random(num_nodes) < label_noise
+            shift = nrng.integers(1, n_class, size=num_nodes)
             label = np.where(flip, (label + shift) % n_class, label)
 
     perm = rng.permutation(num_nodes)

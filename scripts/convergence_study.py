@@ -77,7 +77,17 @@ def run_leg(leg, sg, g, cfg, args, deadline):
         else None)
     if src:
         with open(src) as f:
-            history = [json.loads(l) for l in f if l.strip()]
+            for l in f:
+                if not l.strip():
+                    continue
+                try:
+                    history.append(json.loads(l))
+                except json.JSONDecodeError:
+                    # the window queue SIGKILLs mid-append on timeout;
+                    # a half-written trailing row must not wedge every
+                    # later window — the checkpoint is the source of
+                    # truth and rows >= start are truncated below
+                    break
 
     # completed-leg fast path and exhausted-budget bail BEFORE Trainer
     # construction, which at full scale pays device upload + minutes of

@@ -41,6 +41,13 @@ def main():
     ap.add_argument("--part-dir", default="partitions/projection")
     args = ap.parse_args()
 
+    if args.dataset != "synthetic-reddit":
+        print("# WARNING: epoch-model constants (BLOCK_S/ROW_RATE/"
+              "AUX_S/FIXED_S, N1_ROWS) are probe-calibrated on the "
+              "synthetic-reddit P=1 chip run; aux/floor scaling for "
+              f"'{args.dataset}' is extrapolation, not calibration",
+              file=sys.stderr)
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
