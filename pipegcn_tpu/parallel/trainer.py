@@ -75,6 +75,13 @@ class TrainConfig:
     # run up to this many epochs per dispatch (lax.scan inside the jitted
     # step); 1 = one program per epoch (reference-like granularity)
     fused_epochs: int = 1
+    # PRNG implementation for the per-epoch dropout keys: 'threefry'
+    # (jax default — counter-based, ALU-heavy per element on TPU) or
+    # 'rbg' (hardware RNG-backed, much cheaper bit generation; masks
+    # differ from threefry at the same seed but are equally valid
+    # dropout noise). A floor-shrink lever for the dropout-RNG share of
+    # the non-SpMM epoch floor (scripts/epoch_anatomy.py measures it).
+    rng_impl: str = "threefry"
     # Run the P-part SPMD program on ONE device: the identical
     # per-device step is wrapped in jax.vmap(axis_name='parts') instead
     # of shard_map — vmap implements psum/ppermute/axis_index
@@ -283,17 +290,19 @@ class Trainer:
 
                 self._gat_tables = self._cached_tables(
                     "gat", lambda: build_sharded_gat_tables(self.sg))
-            if (self.cfg.rem_dtype is None
-                    and float(np.mean(self.sg.edge_count)) > 2e7):
-                import warnings
+                # rem_dtype advice applies only to the bucket kernel,
+                # which is what consumes it — not the raw-xla path
+                if (self.cfg.rem_dtype is None
+                        and float(np.mean(self.sg.edge_count)) > 2e7):
+                    import warnings
 
-                warnings.warn(
-                    "GAT at this edge count without --rem-dtype "
-                    "float8: bf16 transport measured ~2x the epoch "
-                    "time and crashed the tunneled TPU worker at "
-                    "Reddit scale (results/gat_tpu_bench.md); fp8 is "
-                    "accuracy-validated (results/"
-                    "staleness_parity_gat.md)")
+                    warnings.warn(
+                        "GAT at this edge count without --rem-dtype "
+                        "float8: bf16 transport measured ~2x the epoch "
+                        "time and crashed the tunneled TPU worker at "
+                        "Reddit scale (results/gat_tpu_bench.md); fp8 "
+                        "is accuracy-validated (results/"
+                        "staleness_parity_gat.md)")
             return
         if impl == "xla":
             return
@@ -872,6 +881,9 @@ class Trainer:
         # single source of the per-run base key: train_epoch and
         # train_epochs MUST fold epochs from the same base so fused and
         # unfused runs are bit-identical
+        if self.tcfg.rng_impl != "threefry":
+            return jax.random.key(self.tcfg.seed + 17,
+                                  impl=self.tcfg.rng_impl)
         return jax.random.PRNGKey(self.tcfg.seed + 17)
 
     def train_epoch(self, epoch: int) -> float:
