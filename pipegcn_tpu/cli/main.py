@@ -239,6 +239,7 @@ def run(args) -> dict:
         seed=args.seed,
         eval=args.eval,
         fused_epochs=args.fused_epochs,
+        rng_impl=args.rng_impl,
     )
     trainer = Trainer(sg, cfg, tcfg)
 

@@ -146,6 +146,12 @@ def create_parser() -> argparse.ArgumentParser:
                         default=1,
                         help="epochs per compiled dispatch (lax.scan); "
                              "amortizes host round-trips")
+    parser.add_argument("--rng-impl", "--rng_impl",
+                        choices=["threefry", "rbg"], default="threefry",
+                        help="dropout PRNG: threefry (jax default) or "
+                             "rbg (hardware-RNG-backed, cheaper mask "
+                             "generation on TPU; different but equally "
+                             "valid masks at the same seed)")
     parser.add_argument("--local-reorder", "--local_reorder",
                         choices=["none", "cluster"], default="cluster",
                         help="local-id ordering within each partition: "
