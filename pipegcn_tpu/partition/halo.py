@@ -531,13 +531,20 @@ class ShardedGraph:
     ]
 
     # format history: v1 edges grouped by device only; v2 adds the per-
-    # device dst-sorted (CSR) edge order that spmm's sorted path relies on
+    # device dst-sorted (CSR) edge order that spmm's sorted path relies
+    # on; v3 stores the same arrays as individual uncompressed .npy
+    # files so loaders can mmap them (papers100M-class artifacts exceed
+    # RAM as one decompressed npz; a v3 reader touches only the ranks
+    # it slices — the per-rank loading the reference gets from dgl's
+    # per-part files, helper/utils.py:132-144)
     FORMAT_VERSION = 2
+    MMAP_FORMAT_VERSION = 3
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, mmap: bool = False) -> None:
         os.makedirs(path, exist_ok=True)
         manifest = {
-            "format_version": self.FORMAT_VERSION,
+            "format_version": (self.MMAP_FORMAT_VERSION if mmap
+                               else self.FORMAT_VERSION),
             "num_parts": self.num_parts,
             "n_max": self.n_max,
             "b_max": self.b_max,
@@ -551,10 +558,16 @@ class ShardedGraph:
         # arrays first, manifest last: exists() keys off the manifest, so
         # a reader polling a shared filesystem (multi-host prepare) never
         # observes a half-written artifact
-        np.savez_compressed(
-            os.path.join(path, "arrays.npz"),
-            **{k: getattr(self, k) for k in self._ARRAYS},
-        )
+        if mmap:
+            adir = os.path.join(path, "arrays")
+            os.makedirs(adir, exist_ok=True)
+            for k in self._ARRAYS:
+                np.save(os.path.join(adir, f"{k}.npy"), getattr(self, k))
+        else:
+            np.savez_compressed(
+                os.path.join(path, "arrays.npz"),
+                **{k: getattr(self, k) for k in self._ARRAYS},
+            )
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=2)
 
@@ -563,10 +576,17 @@ class ShardedGraph:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
         version = manifest.pop("format_version", 0)
+        if version == ShardedGraph.MMAP_FORMAT_VERSION:
+            adir = os.path.join(path, "arrays")
+            arrays = {k: np.load(os.path.join(adir, f"{k}.npy"),
+                                 mmap_mode="r")
+                      for k in ShardedGraph._ARRAYS}
+            return ShardedGraph(**manifest, cache_dir=path, **arrays)
         if version != ShardedGraph.FORMAT_VERSION:
             raise ValueError(
                 f"partition artifact at {path} has format v{version}, "
-                f"expected v{ShardedGraph.FORMAT_VERSION}; re-partition "
+                f"expected v{ShardedGraph.FORMAT_VERSION} (or mmap "
+                f"v{ShardedGraph.MMAP_FORMAT_VERSION}); re-partition "
                 f"(delete the directory or drop --skip-partition)"
             )
         arrays = np.load(os.path.join(path, "arrays.npz"))

@@ -238,6 +238,27 @@ def test_artifact_roundtrip(tmp_path):
     assert sg2.multilabel == sg.multilabel
 
 
+def test_artifact_roundtrip_mmap_v3(tmp_path):
+    """The v3 (per-array .npy, mmap-loaded) layout must roundtrip
+    identically to v2 and stay usable as lazily-sliced memmaps —
+    the papers100M-class loading path (one rank's slice touched,
+    not the whole artifact)."""
+    g = karate_club()
+    parts = partition_graph(g, 2, seed=0)
+    sg = ShardedGraph.build(g, parts)
+    path = str(tmp_path / "part_v3")
+    sg.save(path, mmap=True)
+    assert ShardedGraph.exists(path)
+    sg2 = ShardedGraph.load(path)
+    for k in ShardedGraph._ARRAYS:
+        assert isinstance(getattr(sg2, k), np.memmap), k
+        np.testing.assert_array_equal(getattr(sg, k), getattr(sg2, k))
+    assert sg2.num_parts == sg.num_parts
+    # per-rank slice is a plain in-RAM copy
+    rank0_feat = np.asarray(sg2.feat[0])
+    np.testing.assert_array_equal(rank0_feat, sg.feat[0])
+
+
 def test_build_chunked_bit_identical():
     """build_chunked must reproduce build() EXACTLY — every array, every
     scalar — including cluster layouts, multilabel data, memmap-like
