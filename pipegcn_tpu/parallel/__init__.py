@@ -2,6 +2,7 @@ from .mesh import make_mesh, PARTS_AXIS
 from .halo import halo_exchange, exchange_blocks, return_blocks, make_stale_concat
 from .trainer import Trainer, TrainConfig
 from .evaluator import ShardedEvaluator
+from .sequential import SequentialRunner
 
 __all__ = [
     "make_mesh",
@@ -13,4 +14,5 @@ __all__ = [
     "Trainer",
     "TrainConfig",
     "ShardedEvaluator",
+    "SequentialRunner",
 ]

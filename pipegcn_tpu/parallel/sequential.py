@@ -1,0 +1,360 @@
+"""RAM-bounded sequential execution of the pipelined SPMD step.
+
+Runs the SAME staleness-1 training step as the shard_map Trainer
+(trainer.py:671-792) one rank at a time on a single device, with the
+collectives replaced by host-side routing. This is exact, not an
+approximation, because PipeGCN-style pipelining (reference
+feature_buffer.py:153-163, 219-236) makes every cross-rank input to
+epoch e an output of epoch e-1:
+
+  - layer halo features consumed at epoch e were exchanged at e-1
+    (the staleness-1 carry), so rank r's epoch-e compute never needs a
+    peer's epoch-e activations;
+  - the boundary gradients injected at e are the probe cotangents the
+    peers computed at e-1;
+  - the only intra-epoch collective is psum(grads) — an associative
+    reduction the host performs after the per-rank backward passes.
+
+Peak memory is therefore ONE rank's tables + activations regardless of
+P, which makes papers100M-class 64-part configs (reference
+helper/utils.py:17-30; BASELINE.json multi-host grid) trainable on a
+single host for validation — the role dgl's per-part files + a >=120 GB
+host play for the reference (README.md:29-30).
+
+Routing mirrors parallel/halo.py exactly:
+  exchange_blocks: receiver r's distance-d halo block is owner
+    (r-d) mod P's send block for distance d (_fwd_perm);
+  return_blocks: owner o's distance-d bgrad block is the probe
+    cotangent computed by peer (o+d) mod P at its distance-d slot
+    (_bwd_perm).
+tests/test_sequential.py pins loss-trajectory equality against the
+shard_map Trainer on a multi-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.sage import ModelConfig, forward, init_norm_state, init_params
+from ..train.losses import bce_logits_sum, cross_entropy_sum
+from ..train.optim import adam_init, adam_update
+from .halo import make_stale_concat
+from .trainer import TrainConfig
+
+
+def _ladder_caps(edge_src_by_rank, edge_dst_by_rank, P, n_max,
+                 n_src_rows):
+    """Shared bucket ladders + per-bucket row caps WITHOUT building any
+    tables: one cheap degree-histogram pass per rank (the streamed
+    analogue of build_sharded_bucket_tables's cap scan)."""
+    from ..ops.bucket_spmm import _bucket_widths
+
+    max_in = max_out = 1
+    hists = []
+    for r in range(P):
+        src = np.asarray(edge_src_by_rank(r))
+        dst = np.asarray(edge_dst_by_rank(r))
+        real = dst < n_max
+        di = np.bincount(dst[real], minlength=n_max)
+        do = np.bincount(src[real], minlength=n_src_rows)
+        max_in = max(max_in, int(di.max(initial=1)))
+        max_out = max(max_out, int(do.max(initial=1)))
+        hists.append((di, do))
+    fw = _bucket_widths(max_in)
+    bw = _bucket_widths(max_out)
+
+    def counts(deg, widths):
+        w = np.asarray(widths, np.int64)
+        bid = np.minimum(np.searchsorted(w, np.maximum(deg, 1)),
+                         len(widths) - 1)
+        real = deg > 0
+        return np.bincount(bid[real], minlength=len(widths))
+
+    fwd_caps = np.zeros(len(fw), np.int64)
+    bwd_caps = np.zeros(len(bw), np.int64)
+    for di, do in hists:
+        fwd_caps = np.maximum(fwd_caps, counts(di, fw))
+        bwd_caps = np.maximum(bwd_caps, counts(do, bw))
+    return fw, bw, fwd_caps.tolist(), bwd_caps.tolist()
+
+
+def _rank_bucket_tables(edge_src, edge_dst, n_max, n_src_rows, fw, bw,
+                        fwd_caps, bwd_caps):
+    """One rank's bucket tables padded to the shared caps — same
+    layout/keys as build_sharded_bucket_tables minus the leading device
+    axis, so one traced program serves every rank."""
+    from ..ops.bucket_spmm import BucketPlan
+
+    p = BucketPlan(edge_src, edge_dst, n_max, n_src_rows,
+                   fwd_widths=fw, bwd_widths=bw)
+
+    def pad_to_cap(mat, cap, sentinel):
+        if mat.shape[0] == cap:
+            return mat
+        return np.pad(mat, ((0, cap - mat.shape[0]), (0, 0)),
+                      constant_values=sentinel)
+
+    def reoffset_inv(inv, cnts, caps):
+        inv = inv.astype(np.int64)
+        out = np.full_like(inv, sum(caps))
+        off_old = off_new = 0
+        for n_b, cap in zip(cnts, caps):
+            in_b = (inv >= off_old) & (inv < off_old + n_b)
+            out[in_b] = inv[in_b] - off_old + off_new
+            off_old += n_b
+            off_new += cap
+        return out.astype(np.int32)
+
+    t = {
+        "bkt_fwd_inv": reoffset_inv(p.fwd_inv, p.fwd_counts, fwd_caps),
+        "bkt_bwd_inv": reoffset_inv(p.bwd_inv, p.bwd_counts, bwd_caps),
+    }
+    for b in range(len(fw)):
+        if fwd_caps[b]:
+            t[f"bkt_fwd_{b:02d}"] = pad_to_cap(p.fwd_mats[b],
+                                               fwd_caps[b], n_src_rows)
+    for b in range(len(bw)):
+        if bwd_caps[b]:
+            t[f"bkt_bwd_{b:02d}"] = pad_to_cap(p.bwd_mats[b],
+                                               bwd_caps[b], n_max)
+    return t
+
+
+class SequentialRunner:
+    """One-rank-at-a-time executor of the pipelined training step.
+
+    sg: a ShardedGraph (arrays may be v3 memmaps — only rank slices are
+    materialized). feat_fn/label_fn(rank) optionally synthesize the
+    rank's [n_max, F] features / [n_max] labels instead of reading
+    sg.feat/sg.label (papers100M-scale artifacts store topology only).
+    """
+
+    def __init__(self, sg, cfg: ModelConfig, tcfg: TrainConfig,
+                 feat_fn: Optional[Callable[[int], np.ndarray]] = None,
+                 label_fn: Optional[Callable[[int], np.ndarray]] = None,
+                 table_cache: Optional[Dict[int, dict]] = None,
+                 log: Callable[[str], None] = lambda s: None):
+        if not tcfg.enable_pipeline:
+            raise ValueError("SequentialRunner implements the pipelined "
+                             "(staleness-1) step; vanilla mode has "
+                             "intra-epoch halo dependencies between "
+                             "ranks and needs the mesh trainer")
+        if cfg.norm == "batch":
+            raise ValueError("SyncBatchNorm needs intra-epoch psum of "
+                             "activations; use norm='layer' or None")
+        if cfg.model == "gat":
+            raise ValueError("gat is not wired into SequentialRunner")
+        if cfg.use_pp:
+            raise ValueError("use_pp's one-shot precompute is a "
+                             "cross-rank exchange; run with use_pp=False")
+        self.sg = sg
+        self.cfg = dataclasses.replace(cfg, sorted_edges=True)
+        self.tcfg = tcfg
+        self.P = sg.num_parts
+        self.n_max = sg.n_max
+        self.b_max = sg.b_max
+        self.H = sg.halo_size
+        self.n_train = float(sg.n_train_global)
+        self._feat_fn = feat_fn
+        self._label_fn = label_fn
+        # caching every rank's tables would break the O(one-rank) RAM
+        # bound this class exists for (P=64 at papers100M scale is tens
+        # of GB of tables) — it is therefore strictly opt-in: pass a
+        # dict (can be lru-like) only when the graph is small enough
+        self._table_cache = table_cache
+        self._log = log
+
+        self._glayers = [str(i) for i in range(cfg.n_graph_layers)]
+        self._widths = {k: cfg.layer_sizes[int(k)] for k in self._glayers}
+
+        n_src_rows = self.n_max + self.H
+        self._ladder = _ladder_caps(
+            lambda r: sg.edge_src[r], lambda r: sg.edge_dst[r],
+            self.P, self.n_max, n_src_rows)
+        self._n_src_rows = n_src_rows
+
+        rng = jax.random.PRNGKey(tcfg.seed)
+        self.params = init_params(rng, self.cfg)
+        self.opt = adam_init(self.params)
+        self.norm = init_norm_state(self.cfg)
+
+        cdt = self.cfg.compute_dtype
+        zeros = lambda dt: {
+            k: np.zeros((self.H, self._widths[k]), dt)
+            for k in self._glayers}
+        # per-rank receiver-side carry, exactly Trainer._init_comm
+        self.comm = [
+            {"halo": zeros(cdt), "bgrad": zeros(cdt),
+             **({"favg": zeros(np.float32)} if tcfg.feat_corr else {}),
+             **({"bavg": zeros(np.float32)} if tcfg.grad_corr else {})}
+            for _ in range(self.P)
+        ]
+        self.last_epoch = 0
+        self._jit_rank = jax.jit(self._make_rank_step())
+        self._jit_adam = jax.jit(
+            lambda g, o, p: adam_update(g, o, p, lr=tcfg.lr,
+                                        weight_decay=tcfg.weight_decay))
+
+    # ---------------- per-rank data ----------------------------------
+    def _rank_data(self, r: int) -> Dict[str, np.ndarray]:
+        sg = self.sg
+        e = int(sg.edge_count[r])
+        src = np.asarray(sg.edge_src[r][:e])
+        dst = np.asarray(sg.edge_dst[r][:e])
+        if self._table_cache is not None and r in self._table_cache:
+            tables = self._table_cache[r]
+        else:
+            fw, bw, fc, bc = self._ladder
+            tables = _rank_bucket_tables(src, dst, self.n_max,
+                                         self._n_src_rows, fw, bw, fc, bc)
+            if self._table_cache is not None:
+                self._table_cache[r] = tables
+        feat = (self._feat_fn(r) if self._feat_fn is not None
+                else np.asarray(sg.feat[r]))
+        label = (self._label_fn(r) if self._label_fn is not None
+                 else np.asarray(sg.label[r]))
+        d = {
+            "feat": feat.astype(self.cfg.compute_dtype),
+            "label": label,
+            "train_mask": np.asarray(sg.train_mask[r]),
+            "in_deg": np.asarray(sg.in_deg[r]),
+            "send_idx": np.asarray(sg.send_idx[r]).astype(np.int32),
+            "send_mask": np.asarray(sg.send_mask[r]),
+            "row_mask": (np.arange(self.n_max)
+                         < int(sg.inner_count[r])).astype(np.float32),
+        }
+        d.update(tables)
+        return d
+
+    # ---------------- the jitted per-rank step ------------------------
+    def _make_rank_step(self):
+        cfg, tcfg = self.cfg, self.tcfg
+        n_max, H, P, b_max = self.n_max, self.H, self.P, self.b_max
+        glayers, widths = self._glayers, self._widths
+        multilabel = self.sg.multilabel
+        cdt = cfg.compute_dtype
+
+        def rank_step(params, norm, rng, d, stale_halo, stale_bgrad):
+            """stale_halo/stale_bgrad: {layer: [H, F]} in compute dtype —
+            already the corrected (EMA) buffers when corr is on; the
+            host picks them, mirroring trainer.py:697-706."""
+            from ..ops.bucket_spmm import make_device_bucket_spmm_fn
+
+            probes = {k: jnp.zeros((H, widths[k]), cdt) for k in glayers}
+            sends = {}
+
+            def comm_update(i, h):
+                k = str(i)
+                op = make_stale_concat(d["send_idx"], d["send_mask"],
+                                       n_max)
+                fbuf = op(h, stale_halo[k], stale_bgrad[k], probes_in[k])
+                hs = jax.lax.stop_gradient(h)
+                # this epoch's send blocks, routed by the host: block
+                # d-1 = masked gather of the rows sent to (r+d) mod P
+                # (exchange_blocks's pre-permute payload)
+                blk = jnp.take(hs, d["send_idx"], axis=0)  # [P-1, B, F]
+                sends[k] = jnp.where(d["send_mask"][:, :, None], blk, 0.0)
+                return fbuf
+
+            spmm_fn = make_device_bucket_spmm_fn(
+                d, d["in_deg"], self._n_src_rows,
+                chunk_edges=cfg.spmm_chunk, rem_dtype=cfg.rem_dtype)
+            edge_dummy = jnp.zeros((8,), jnp.int32)
+
+            def loss_fn(params, probes_arg):
+                nonlocal probes_in
+                probes_in = probes_arg
+                logits, new_norm = forward(
+                    params, cfg, d["feat"], edge_dummy, edge_dummy,
+                    d["in_deg"], n_max, training=True, rng=rng,
+                    comm_update=comm_update, norm_state=norm,
+                    psum=lambda x: x, row_mask=d["row_mask"],
+                    spmm_fn=spmm_fn, gat_fn=None,
+                )
+                if multilabel:
+                    loss = bce_logits_sum(logits, d["label"],
+                                          d["train_mask"])
+                else:
+                    loss = cross_entropy_sum(logits, d["label"],
+                                             d["train_mask"])
+                return loss, new_norm
+
+            probes_in = probes
+            (loss, new_norm), grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, probes)
+            pgrads, probe_grads = grads
+            return loss, pgrads, probe_grads, sends, new_norm
+
+        return rank_step
+
+    # ---------------- epoch loop --------------------------------------
+    def run_epoch(self, epoch: int) -> float:
+        tcfg, P, H, b_max = self.tcfg, self.P, self.H, self.b_max
+        cdt = self.cfg.compute_dtype
+        if tcfg.rng_impl != "threefry":
+            base = jax.random.key(tcfg.seed + 17, impl=tcfg.rng_impl)
+        else:
+            base = jax.random.PRNGKey(tcfg.seed + 17)
+        rng_e = jax.random.fold_in(base, epoch)
+
+        tm = jax.tree_util.tree_map
+        loss_sum = 0.0
+        grad_sum = None
+        sends_all, probes_all = [], []
+        new_norm0 = None
+        for r in range(P):
+            d = self._rank_data(r)
+            c = self.comm[r]
+            stale_halo = {
+                k: (c["favg"][k].astype(cdt) if tcfg.feat_corr
+                    else c["halo"][k]) for k in self._glayers}
+            stale_bgrad = {
+                k: (c["bavg"][k].astype(cdt) if tcfg.grad_corr
+                    else c["bgrad"][k]) for k in self._glayers}
+            rng_r = jax.random.fold_in(rng_e, r)
+            loss, pgrads, probe_grads, sends, new_norm = jax.device_get(
+                self._jit_rank(self.params, self.norm, rng_r, d,
+                               stale_halo, stale_bgrad))
+            loss_sum += float(loss)
+            grad_sum = (pgrads if grad_sum is None
+                        else tm(np.add, grad_sum, pgrads))
+            sends_all.append(sends)
+            probes_all.append(probe_grads)
+            if new_norm0 is None:
+                new_norm0 = new_norm
+            self._log(f"rank {r}: loss_sum {loss_sum:.4f}")
+
+        # ---- host-side collectives ----
+        pgrads = tm(lambda g: (g / self.n_train).astype(np.float32),
+                    grad_sum)
+        self.params, self.opt = jax.device_get(
+            self._jit_adam(pgrads, self.opt, self.params))
+        self.norm = new_norm0
+
+        for r in range(P):
+            c = self.comm[r]
+            for k in self._glayers:
+                halo_next = np.zeros((H, self._widths[k]), cdt)
+                bgrad_next = np.zeros((H, self._widths[k]), cdt)
+                for dd in range(1, P):
+                    sl = slice((dd - 1) * b_max, dd * b_max)
+                    # _fwd_perm: r receives owner (r-d)'s distance-d send
+                    halo_next[sl] = sends_all[(r - dd) % P][k][dd - 1]
+                    # _bwd_perm: r's send rows were consumed by (r+d)
+                    bgrad_next[sl] = probes_all[(r + dd) % P][k][sl]
+                c["halo"][k] = halo_next
+                c["bgrad"][k] = bgrad_next
+                m = tcfg.corr_momentum
+                if tcfg.feat_corr:
+                    c["favg"][k] = (m * c["favg"][k]
+                                    + (1 - m) * halo_next.astype(np.float32))
+                if tcfg.grad_corr:
+                    c["bavg"][k] = (m * c["bavg"][k]
+                                    + (1 - m) * bgrad_next.astype(np.float32))
+        self.last_epoch = epoch + 1
+        return loss_sum / self.n_train
