@@ -89,6 +89,14 @@ def main():
         ("baseline", base, tcfg),
         ("dropout0", dataclasses.replace(base, dropout=0.0), tcfg),
         ("no-norm", dataclasses.replace(base, norm=None), tcfg),
+        # combined leg: if its delta ~= dropout0 + no-norm deltas the
+        # floor decomposes additively and the un-ablatable rest
+        # (linears/loss/opt/assembly) is baseline - combined - dispatch
+        ("dropout0-no-norm",
+         dataclasses.replace(base, dropout=0.0, norm=None), tcfg),
+        # fast-RNG lever: if this recovers most of the dropout0 delta,
+        # --rng-impl rbg is a production win with dropout kept at 0.5
+        ("rbg", base, dataclasses.replace(tcfg, rng_impl="rbg")),
         ("fused1", base, dataclasses.replace(tcfg, fused_epochs=1)),
     ]
     rec = {"backend": jax.default_backend()}

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Off-shape chip point for the auto-kernel policy (VERDICT r4 item 8).
+
+The auto thresholds (_AUTO_BLOCK_MIN_EDGES / _AUTO_BLOCK_MIN_COVERAGE,
+parallel/trainer.py) and the f8-transport lever were calibrated on ONE
+graph family (synthetic-Reddit: 233k nodes, deg 492, F=602/256). This
+benches a second family on chip — the ogbn-products shape (2.45M nodes,
+deg ~51, F=100, 47 classes, hidden 128: reference
+scripts/ogbn-products.sh + helper/utils.py:17-30) or the Yelp shape —
+and records what `auto` resolves to there plus the measured
+block/bucket/f8 ranking, so the policy rests on two shape points
+instead of one.
+
+Dispatch discipline follows scripts/gat_bench.py: single-epoch probe
+(min of two), fused blocks sized under the tunnel's ~80 s execute
+ceiling, device->host scalar read per dispatch.
+
+Usage:
+  python scripts/offshape_bench.py --shape products --build-only  # host
+  python scripts/offshape_bench.py --shape products --impl auto
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# dataset spec + reference model config per shape:
+#   products: 2,449,029 nodes / avg deg ~51 / 100 feats / 47 classes;
+#     3 layers x 128 hidden, dropout 0.3 (scripts/ogbn-products.sh)
+#   yelp: 716,847 nodes / deg ~19 / 300 feats / 100 classes;
+#     4 layers x 512 hidden, dropout 0.1 (scripts/yelp.sh)
+SHAPES = {
+    "products": ("synthetic:2449029:51:100:47", 128, 3, 0.3),
+    "yelp": ("synthetic:716847:19:300:100", 512, 4, 0.1),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shape", default="products", choices=sorted(SHAPES))
+    ap.add_argument("--impl", default="auto",
+                    choices=["auto", "block", "bucket"])
+    ap.add_argument("--rem-dtype", default="float8",
+                    choices=["none", "bfloat16", "float8"])
+    ap.add_argument("--block-group", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=8,
+                    help="max fused-epoch block length")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--build-only", action="store_true",
+                    help="build + cache the partition artifact (and "
+                         "kernel tables) on the host, no measurement")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu or args.build_only:
+        jax.config.update("jax_platforms", "cpu")
+
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import Trainer, TrainConfig
+    from pipegcn_tpu.partition import ShardedGraph
+
+    dataset, hidden, n_layers, dropout = SHAPES[args.shape]
+    part_path = os.path.join("partitions", f"offshape-{args.shape}-1-s1024")
+    t0 = time.time()
+    if ShardedGraph.exists(part_path):
+        sg = ShardedGraph.load(part_path)
+        print(f"# loaded cached artifact ({time.time()-t0:.0f}s)",
+              file=sys.stderr)
+    else:
+        from pipegcn_tpu.graph import load_data
+        from pipegcn_tpu.partition import (locality_clusters,
+                                           partition_graph)
+
+        g = load_data(dataset)
+        parts = partition_graph(g, 1, seed=0)
+        cluster = locality_clusters(g, target_size=1024, seed=0)
+        sg = ShardedGraph.build(g, parts, n_parts=1, cluster=cluster)
+        sg.save(part_path)
+        print(f"# built artifact ({time.time()-t0:.0f}s)",
+              file=sys.stderr)
+    sg.cache_dir = part_path
+
+    cfg = ModelConfig(
+        layer_sizes=(sg.n_feat,) + (hidden,) * (n_layers - 1)
+                    + (sg.n_class,),
+        use_pp=True, norm="layer", dropout=dropout,
+        train_size=sg.n_train_global, spmm_chunk=2_097_152,
+        dtype="bfloat16", spmm_impl=args.impl,
+        block_group=args.block_group, rem_dtype=args.rem_dtype,
+    )
+    tcfg = TrainConfig(lr=0.003,
+                       n_epochs=3 + args.epochs * (args.reps + 2),
+                       enable_pipeline=True, eval=False,
+                       fused_epochs=args.epochs)
+    t0 = time.time()
+    tr = Trainer(sg, cfg, tcfg)
+    resolved = ("block" if tr._block_tables is not None else
+                "bucket" if tr._bucket_tables is not None else
+                args.impl)
+    print(f"# trainer init (tables) {time.time()-t0:.0f}s; "
+          f"impl={args.impl} resolved={resolved}", file=sys.stderr)
+    if args.build_only:
+        print(f"# artifact + {resolved} tables cached at {part_path}")
+        return
+
+    from bench import MAX_DISPATCH_S
+
+    t0 = time.perf_counter()
+    losses = tr.train_epochs(0, 1)
+    print(f"# compile+first {time.perf_counter()-t0:.0f}s "
+          f"loss={float(losses[-1]):.4f}", file=sys.stderr)
+    singles = []
+    for i in (1, 2):
+        t0 = time.perf_counter()
+        losses = tr.train_epochs(i, 1)
+        singles.append(time.perf_counter() - t0)
+    single = min(singles)
+    print(f"# single epoch {single:.2f}s", file=sys.stderr)
+    blk = max(1, min(args.epochs,
+                     int(MAX_DISPATCH_S // max(single, 1e-6))))
+    e = 3
+    if blk > 1:
+        t0 = time.perf_counter()
+        tr.train_epochs(e, blk)
+        e += blk
+        print(f"# fused-{blk} warmup/compile "
+              f"{time.perf_counter()-t0:.0f}s", file=sys.stderr)
+
+    times = []
+    for r in range(args.reps):
+        t0 = time.perf_counter()
+        losses = tr.train_epochs(e, blk)
+        dt = time.perf_counter() - t0
+        e += blk
+        times.append(dt / blk)
+        print(f"# block {r}: {dt:.2f}s -> {dt/blk:.3f} s/epoch "
+              f"loss={float(losses[-1]):.4f}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"offshape_{args.shape}_{args.impl}_epoch_time"
+                  + ("" if args.rem_dtype == "none"
+                     else f"_{args.rem_dtype}"),
+        "value": round(float(np.median(times)), 4),
+        "unit": "s/epoch",
+        "resolved_impl": resolved,
+        "block_group": args.block_group,
+        "hidden": hidden,
+        "dispatch_epochs": blk,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,53 +25,36 @@ sys.path.insert(0, REPO)
 
 LOG_DIR = os.path.join(REPO, "results", "tpu_window")
 
-# (name, argv, timeout_s) — priority order: most load-bearing first.
-# bench.py self-degrades on crashes; the microbench/gat steps are
-# best-effort.
+# (name, argv, timeout_s) — priority order: most load-bearing first
+# (round-5 order: VERDICT r4 items 1-3 lead). bench.py self-degrades
+# on crashes; the microbench/gat steps are best-effort.
 QUEUE = [
-    ("probe_traffic",
-     [sys.executable, "scripts/spmm_microbench.py", "--probe-traffic"],
-     2400),
-    ("microbench_u4",
-     [sys.executable, "scripts/spmm_microbench.py", "--group", "4"],
-     2400),
-    ("bench_u4_f8",
-     [sys.executable, "bench.py", "--block-group", "4",
-      "--rem-dtype", "float8", "--no-compare"],
-     3600),
-    ("bench_u4",
-     [sys.executable, "bench.py", "--block-group", "4", "--no-compare"],
-     3600),
-    # fused Pallas dense path (ops/fused_block.py) — after the
-    # known-good configs so a bad compile can't burn the headline
-    ("microbench_u4_fused",
-     [sys.executable, "scripts/spmm_microbench.py", "--group", "4",
-      "--fused"],
-     2400),
-    ("bench_u4_fused",
-     [sys.executable, "bench.py", "--block-group", "4", "--block-fused",
-      "--no-compare"],
-     3600),
-    ("bench_u4_fused_f8",
-     [sys.executable, "bench.py", "--block-group", "4", "--block-fused",
-      "--rem-dtype", "float8", "--no-compare"],
-     3600),
-    # full-Reddit-scale GAT epochs exceed the tunnel's ~80 s execute
-    # ceiling and crash the worker (two observed crashes, round 4) —
-    # the chip ranking runs at a reduced scale instead, both kernels
-    ("gat_bench_small",
-     [sys.executable, "scripts/gat_bench.py",
-      "--dataset", "synthetic:60000:30:602:41"],
-     3600),
-    ("bench_default",
-     [sys.executable, "bench.py"],
-     3600),
-    # attribute the 0.518 s non-SpMM floor (probe round 4): ablate
-    # dropout RNG / LayerNorm / dispatch amortization on the chip
+    # VERDICT r5 item 1: attribute the 0.518 s non-SpMM floor (ablate
+    # dropout RNG / LayerNorm / fbuf assembly / dispatch amortization)
     ("epoch_anatomy",
      [sys.executable, "scripts/epoch_anatomy.py"],
      2400),
-    # full-density convergence study (VERDICT item 3): resumable via
+    # VERDICT r5 item 3: decompose the remainder's 0.63 s (cast /
+    # gather-traffic / ladder-structure / chunking shares + in-session
+    # cliff anchor)
+    ("rem_probe",
+     [sys.executable, "scripts/rem_probe.py"],
+     2400),
+    # refresh the round-5 headline + results/last_tpu_bench.json
+    ("bench_u4_f8_r5",
+     [sys.executable, "bench.py", "--block-group", "4",
+      "--rem-dtype", "float8", "--no-compare"],
+     3600),
+    # VERDICT r5 item 8: second shape point for the auto-kernel policy
+    ("offshape_products",
+     [sys.executable, "scripts/offshape_bench.py", "--shape",
+      "products", "--impl", "auto"],
+     3600),
+    ("offshape_products_bucket",
+     [sys.executable, "scripts/offshape_bench.py", "--shape",
+      "products", "--impl", "bucket"],
+     3600),
+    # calibrated-task convergence study (VERDICT item 2): resumable via
     # per-leg checkpoints, so each window advances it by its budget
     ("convergence_study",
      [sys.executable, "scripts/convergence_study.py",
