@@ -138,6 +138,8 @@ class SequentialRunner:
                  feat_fn: Optional[Callable[[int], np.ndarray]] = None,
                  label_fn: Optional[Callable[[int], np.ndarray]] = None,
                  table_cache: Optional[Dict[int, dict]] = None,
+                 compact_halo: bool = False,
+                 keep_carry: bool = True,
                  log: Callable[[str], None] = lambda s: None):
         if not tcfg.enable_pipeline:
             raise ValueError("SequentialRunner implements the pipelined "
@@ -172,9 +174,43 @@ class SequentialRunner:
         self._glayers = [str(i) for i in range(cfg.n_graph_layers)]
         self._widths = {k: cfg.layer_sizes[int(k)] for k in self._glayers}
 
+        # compact_halo: replace the mesh trainer's uniform per-distance
+        # pad (b_max = global max over ALL (owner, dest) pairs) with
+        # per-distance caps B_d = max over owners of send_counts[:, d-1].
+        # On power-law graphs (papers100M class) the uniform pad wastes
+        # ~10x halo rows — locality puts huge send lists at distance 1
+        # and small ones everywhere else. Exact for dropout=0 (dropped
+        # pad rows are zero-feature, zero-edge); with dropout>0 the
+        # [N+H, F] mask shape changes, so trajectories differ from the
+        # mesh trainer by dropout noise only.
+        self.compact = compact_halo
+        # keep_carry=False: one-shot mode — run epoch 0 (stale buffers
+        # are zeros by definition) without routing or storing the next
+        # carry. The carry for ALL ranks is inherently distributed state
+        # (P x layers x 2 x [H, F] — hundreds of GB at papers100M
+        # scale); a single-host full-scale validation step cannot hold
+        # it, and does not need to for one step.
+        self.keep_carry = keep_carry
+        if self.compact and self.P > 1:
+            caps = [int(np.max(np.asarray(sg.send_counts)[:, dd]))
+                    for dd in range(self.P - 1)]
+            # round to 8 for layout friendliness but never beyond the
+            # artifact's own pad (send_idx is only b_max wide)
+            caps = [min(-(-c // 8) * 8, self.b_max) if c else 0
+                    for c in caps]
+            self._b_caps = caps
+            self._b_off = np.concatenate(
+                [[0], np.cumsum(caps)]).astype(np.int64)
+            self.H = int(self._b_off[-1])
+        else:
+            self.compact = False
+            self._b_caps = [self.b_max] * max(self.P - 1, 0)
+            self._b_off = np.arange(self.P) * self.b_max
         n_src_rows = self.n_max + self.H
         self._ladder = _ladder_caps(
-            lambda r: sg.edge_src[r], lambda r: sg.edge_dst[r],
+            lambda r: self._remap_src(r, np.asarray(
+                sg.edge_src[r][:int(sg.edge_count[r])])),
+            lambda r: np.asarray(sg.edge_dst[r][:int(sg.edge_count[r])]),
             self.P, self.n_max, n_src_rows)
         self._n_src_rows = n_src_rows
 
@@ -193,7 +229,7 @@ class SequentialRunner:
              **({"favg": zeros(np.float32)} if tcfg.feat_corr else {}),
              **({"bavg": zeros(np.float32)} if tcfg.grad_corr else {})}
             for _ in range(self.P)
-        ]
+        ] if keep_carry else None
         self.last_epoch = 0
         self._jit_rank = jax.jit(self._make_rank_step())
         self._jit_adam = jax.jit(
@@ -201,10 +237,39 @@ class SequentialRunner:
                                         weight_decay=tcfg.weight_decay))
 
     # ---------------- per-rank data ----------------------------------
+    def _remap_src(self, r: int, src: np.ndarray) -> np.ndarray:
+        """Map halo slots from the artifact's uniform-b_max numbering
+        (n_max + (d-1)*b_max + k, partition/halo.py _localize_edges) to
+        the compact per-distance layout. Identity when not compact."""
+        if not self.compact:
+            return src
+        halo = src >= self.n_max
+        slot = src[halo].astype(np.int64) - self.n_max
+        dd = slot // self.b_max          # distance-1 index (d-1)
+        k = slot % self.b_max
+        out = src.astype(np.int64).copy()
+        out[halo] = self.n_max + self._b_off[dd] + k
+        return out
+
+    def _compact_send(self, r: int):
+        """Flattened send idx/mask in the compact per-distance layout
+        ([H] each; rows beyond a distance's real send count masked)."""
+        sg = self.sg
+        idx = np.zeros(self.H, np.int32)
+        mask = np.zeros(self.H, bool)
+        for dd in range(self.P - 1):
+            c = self._b_caps[dd]
+            if not c:
+                continue
+            o = int(self._b_off[dd])
+            idx[o:o + c] = np.asarray(sg.send_idx[r, dd, :c])
+            mask[o:o + c] = np.asarray(sg.send_mask[r, dd, :c])
+        return idx, mask
+
     def _rank_data(self, r: int) -> Dict[str, np.ndarray]:
         sg = self.sg
         e = int(sg.edge_count[r])
-        src = np.asarray(sg.edge_src[r][:e])
+        src = self._remap_src(r, np.asarray(sg.edge_src[r][:e]))
         dst = np.asarray(sg.edge_dst[r][:e])
         if self._table_cache is not None and r in self._table_cache:
             tables = self._table_cache[r]
@@ -218,13 +283,20 @@ class SequentialRunner:
                 else np.asarray(sg.feat[r]))
         label = (self._label_fn(r) if self._label_fn is not None
                  else np.asarray(sg.label[r]))
+        if self.compact:
+            sidx, smask = self._compact_send(r)
+        else:
+            sidx = np.asarray(sg.send_idx[r]).astype(np.int32).reshape(-1)
+            smask = np.asarray(sg.send_mask[r]).reshape(-1)
         d = {
             "feat": feat.astype(self.cfg.compute_dtype),
             "label": label,
             "train_mask": np.asarray(sg.train_mask[r]),
             "in_deg": np.asarray(sg.in_deg[r]),
-            "send_idx": np.asarray(sg.send_idx[r]).astype(np.int32),
-            "send_mask": np.asarray(sg.send_mask[r]),
+            # flat [H] in both layouts (the uniform layout's flattened
+            # [P-1, B] order IS the halo slot order)
+            "send_idx": sidx,
+            "send_mask": smask,
             "row_mask": (np.arange(self.n_max)
                          < int(sg.inner_count[r])).astype(np.float32),
         }
@@ -234,10 +306,11 @@ class SequentialRunner:
     # ---------------- the jitted per-rank step ------------------------
     def _make_rank_step(self):
         cfg, tcfg = self.cfg, self.tcfg
-        n_max, H, P, b_max = self.n_max, self.H, self.P, self.b_max
+        n_max, H = self.n_max, self.H
         glayers, widths = self._glayers, self._widths
         multilabel = self.sg.multilabel
         cdt = cfg.compute_dtype
+        keep_carry = self.keep_carry
 
         def rank_step(params, norm, rng, d, stale_halo, stale_bgrad):
             """stale_halo/stale_bgrad: {layer: [H, F]} in compute dtype —
@@ -253,12 +326,14 @@ class SequentialRunner:
                 op = make_stale_concat(d["send_idx"], d["send_mask"],
                                        n_max)
                 fbuf = op(h, stale_halo[k], stale_bgrad[k], probes_in[k])
-                hs = jax.lax.stop_gradient(h)
-                # this epoch's send blocks, routed by the host: block
-                # d-1 = masked gather of the rows sent to (r+d) mod P
-                # (exchange_blocks's pre-permute payload)
-                blk = jnp.take(hs, d["send_idx"], axis=0)  # [P-1, B, F]
-                sends[k] = jnp.where(d["send_mask"][:, :, None], blk, 0.0)
+                if keep_carry:
+                    hs = jax.lax.stop_gradient(h)
+                    # this epoch's send rows [H, F], routed by the host
+                    # in halo slot order (exchange_blocks's pre-permute
+                    # payload, flattened)
+                    blk = jnp.take(hs, d["send_idx"], axis=0)
+                    sends[k] = jnp.where(d["send_mask"][:, None], blk,
+                                         0.0)
                 return fbuf
 
             spmm_fn = make_device_bucket_spmm_fn(
@@ -285,17 +360,36 @@ class SequentialRunner:
                 return loss, new_norm
 
             probes_in = probes
-            (loss, new_norm), grads = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True)(params, probes)
-            pgrads, probe_grads = grads
-            return loss, pgrads, probe_grads, sends, new_norm
+            if keep_carry:
+                (loss, new_norm), (pgrads, probe_grads) = \
+                    jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                       has_aux=True)(params, probes)
+                return loss, pgrads, probe_grads, sends, new_norm
+            # one-shot mode: no next-epoch carry, so neither the probe
+            # cotangents nor the send rows are fetched (XLA drops the
+            # dead halo-cotangent extraction)
+            (loss, new_norm), pgrads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, probes)
+            return loss, pgrads, {}, {}, new_norm
 
         return rank_step
 
     # ---------------- epoch loop --------------------------------------
-    def run_epoch(self, epoch: int) -> float:
-        tcfg, P, H, b_max = self.tcfg, self.P, self.H, self.b_max
+    def run_epoch(self, epoch: int,
+                  state_path: Optional[str] = None) -> float:
+        """state_path (one-shot mode only): checkpoint the grad
+        accumulator + rank cursor after every rank, so a multi-hour
+        full-scale epoch survives interruption — the partial sums are
+        exact (host psum is associative) and a restart resumes at the
+        next rank."""
+        import os
+        import pickle
+
+        tcfg, P, H = self.tcfg, self.P, self.H
         cdt = self.cfg.compute_dtype
+        if state_path is not None and self.keep_carry:
+            raise ValueError("per-rank resume requires keep_carry=False "
+                             "(the carry would need checkpointing too)")
         if tcfg.rng_impl != "threefry":
             base = jax.random.key(tcfg.seed + 17, impl=tcfg.rng_impl)
         else:
@@ -305,17 +399,31 @@ class SequentialRunner:
         tm = jax.tree_util.tree_map
         loss_sum = 0.0
         grad_sum = None
+        start_rank = 0
+        if state_path is not None and os.path.exists(state_path):
+            with open(state_path, "rb") as f:
+                st = pickle.load(f)
+            if st["epoch"] == epoch:
+                start_rank = st["next_rank"]
+                loss_sum = st["loss_sum"]
+                grad_sum = st["grad_sum"]
+                self._log(f"resuming epoch {epoch} at rank {start_rank}")
         sends_all, probes_all = [], []
         new_norm0 = None
-        for r in range(P):
+        zero_stale = {k: np.zeros((H, self._widths[k]), cdt)
+                      for k in self._glayers} if self.comm is None else None
+        for r in range(start_rank, P):
             d = self._rank_data(r)
-            c = self.comm[r]
-            stale_halo = {
-                k: (c["favg"][k].astype(cdt) if tcfg.feat_corr
-                    else c["halo"][k]) for k in self._glayers}
-            stale_bgrad = {
-                k: (c["bavg"][k].astype(cdt) if tcfg.grad_corr
-                    else c["bgrad"][k]) for k in self._glayers}
+            if self.comm is None:  # one-shot: epoch-0 staleness = zeros
+                stale_halo = stale_bgrad = zero_stale
+            else:
+                c = self.comm[r]
+                stale_halo = {
+                    k: (c["favg"][k].astype(cdt) if tcfg.feat_corr
+                        else c["halo"][k]) for k in self._glayers}
+                stale_bgrad = {
+                    k: (c["bavg"][k].astype(cdt) if tcfg.grad_corr
+                        else c["bgrad"][k]) for k in self._glayers}
             rng_r = jax.random.fold_in(rng_e, r)
             loss, pgrads, probe_grads, sends, new_norm = jax.device_get(
                 self._jit_rank(self.params, self.norm, rng_r, d,
@@ -327,6 +435,12 @@ class SequentialRunner:
             probes_all.append(probe_grads)
             if new_norm0 is None:
                 new_norm0 = new_norm
+            if state_path is not None:
+                with open(state_path + ".tmp", "wb") as f:
+                    pickle.dump({"epoch": epoch, "next_rank": r + 1,
+                                 "loss_sum": loss_sum,
+                                 "grad_sum": grad_sum}, f)
+                os.replace(state_path + ".tmp", state_path)
             self._log(f"rank {r}: loss_sum {loss_sum:.4f}")
 
         # ---- host-side collectives ----
@@ -334,27 +448,34 @@ class SequentialRunner:
                     grad_sum)
         self.params, self.opt = jax.device_get(
             self._jit_adam(pgrads, self.opt, self.params))
-        self.norm = new_norm0
+        if new_norm0 is not None:  # resumed-at-P restarts keep norm
+            self.norm = new_norm0
 
-        for r in range(P):
-            c = self.comm[r]
-            for k in self._glayers:
-                halo_next = np.zeros((H, self._widths[k]), cdt)
-                bgrad_next = np.zeros((H, self._widths[k]), cdt)
-                for dd in range(1, P):
-                    sl = slice((dd - 1) * b_max, dd * b_max)
-                    # _fwd_perm: r receives owner (r-d)'s distance-d send
-                    halo_next[sl] = sends_all[(r - dd) % P][k][dd - 1]
-                    # _bwd_perm: r's send rows were consumed by (r+d)
-                    bgrad_next[sl] = probes_all[(r + dd) % P][k][sl]
-                c["halo"][k] = halo_next
-                c["bgrad"][k] = bgrad_next
-                m = tcfg.corr_momentum
-                if tcfg.feat_corr:
-                    c["favg"][k] = (m * c["favg"][k]
-                                    + (1 - m) * halo_next.astype(np.float32))
-                if tcfg.grad_corr:
-                    c["bavg"][k] = (m * c["bavg"][k]
-                                    + (1 - m) * bgrad_next.astype(np.float32))
+        if self.comm is not None:
+            for r in range(P):
+                c = self.comm[r]
+                for k in self._glayers:
+                    halo_next = np.zeros((H, self._widths[k]), cdt)
+                    bgrad_next = np.zeros((H, self._widths[k]), cdt)
+                    for dd in range(1, P):
+                        sl = slice(int(self._b_off[dd - 1]),
+                                   int(self._b_off[dd - 1])
+                                   + self._b_caps[dd - 1])
+                        # _fwd_perm: r receives owner (r-d)'s
+                        # distance-d send rows (same slot range)
+                        halo_next[sl] = sends_all[(r - dd) % P][k][sl]
+                        # _bwd_perm: r's send rows were consumed by (r+d)
+                        bgrad_next[sl] = probes_all[(r + dd) % P][k][sl]
+                    c["halo"][k] = halo_next
+                    c["bgrad"][k] = bgrad_next
+                    m = tcfg.corr_momentum
+                    if tcfg.feat_corr:
+                        c["favg"][k] = (
+                            m * c["favg"][k]
+                            + (1 - m) * halo_next.astype(np.float32))
+                    if tcfg.grad_corr:
+                        c["bavg"][k] = (
+                            m * c["bavg"][k]
+                            + (1 - m) * bgrad_next.astype(np.float32))
         self.last_epoch = epoch + 1
         return loss_sum / self.n_train

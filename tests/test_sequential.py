@@ -65,6 +65,39 @@ def test_sequential_dropout_matches_trainer(sharded):
                                rtol=2e-3, atol=2e-3)
 
 
+def test_sequential_compact_halo_matches_trainer(sharded):
+    """The compact per-distance halo layout drops only zero-feature,
+    zero-edge pad rows, so with dropout=0 it must reproduce the mesh
+    trainer exactly while using fewer halo slots."""
+    sg = sharded
+    cfg = _cfg(sg)
+    tcfg = TrainConfig(lr=0.01, n_epochs=4, enable_pipeline=True,
+                       feat_corr=True, grad_corr=True, eval=False,
+                       seed=5)
+    tr = Trainer(sg, cfg, tcfg)
+    mesh_losses = [tr.train_epoch(e) for e in range(4)]
+    run = SequentialRunner(sg, cfg, tcfg, compact_halo=True)
+    assert run.H <= sg.halo_size
+    seq_losses = [run.run_epoch(e) for e in range(4)]
+    np.testing.assert_allclose(seq_losses, mesh_losses,
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_sequential_one_shot_matches_epoch0(sharded):
+    """keep_carry=False (the single-host full-scale mode) must produce
+    the exact epoch-0 loss: staleness buffers are zeros at epoch 0
+    whether or not a carry is kept."""
+    sg = sharded
+    cfg = _cfg(sg)
+    tcfg = TrainConfig(lr=0.01, enable_pipeline=True, eval=False, seed=4)
+    full = SequentialRunner(sg, cfg, tcfg)
+    l_full = full.run_epoch(0)
+    oneshot = SequentialRunner(sg, cfg, tcfg, compact_halo=True,
+                               keep_carry=False)
+    l_one = oneshot.run_epoch(0)
+    np.testing.assert_allclose(l_one, l_full, rtol=1e-4, atol=1e-4)
+
+
 def test_sequential_rejects_unsupported(sharded):
     sg = sharded
     with pytest.raises(ValueError, match="pipelined"):
