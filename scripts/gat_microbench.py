@@ -168,6 +168,32 @@ def main():
               f"(x{rec['gat_pass_s']/rec['bucket_pass_s']:.1f})",
               flush=True)
 
+    # --- narrow-row gather-rate curve ----------------------------------
+    # The attention kernel's per-edge el/stat gathers fetch 8-16 B rows
+    # (H=4 bf16/f32) — far below the 256 B slab the SAGE cliff analysis
+    # covered. If the request rate collapses at sub-32 B rows, the GAT
+    # fix is packing el/stats into the wide z slabs (one request per
+    # edge total), not a different softmax. M matches this graph's
+    # edge count so the numbers read directly as per-pass seconds.
+    M = int(sg.edge_count.sum())
+    idx = jnp.asarray(rng.integers(0, R, size=M).astype(np.int32))
+
+    @jax.jit
+    def flat_gather(tbl, ii):
+        return (jnp.take(tbl, ii, axis=0).astype(jnp.float32).sum(0),)
+
+    rec["narrow_gather"] = {}
+    for elems, dt, tag_w in ((4, jnp.bfloat16, "8B"),
+                             (4, jnp.float32, "16B"),
+                             (16, jnp.bfloat16, "32B"),
+                             (64, jnp.bfloat16, "128B"),
+                             (128, jnp.bfloat16, "256B")):
+        tbl = jnp.asarray(
+            rng.standard_normal((R, elems)).astype(np.float32)).astype(dt)
+        t = timed(flat_gather, (tbl, idx), f"gather {tag_w}-rows")
+        rec["narrow_gather"][tag_w] = {
+            "s": t, "rows_per_s": M / t if t > 0 else None}
+
     tag = f"{jax.default_backend()}_{args.rem_dtype}"
     out = os.path.join(REPO, "results", f"gat_microbench_{tag}.json")
     with open(out, "w") as f:

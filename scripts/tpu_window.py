@@ -54,6 +54,12 @@ QUEUE = [
      [sys.executable, "scripts/offshape_bench.py", "--shape",
       "products", "--impl", "bucket"],
      3600),
+    # cheap GAT attribution (incl. the narrow-row gather-rate curve
+    # that decides the el-packing-vs-Pallas-softmax question) BEFORE
+    # the convergence legs, which absorb every remaining window second
+    ("gat_microbench",
+     [sys.executable, "scripts/gat_microbench.py"],
+     2400),
     # calibrated-task convergence study (VERDICT item 2): resumable via
     # per-leg checkpoints, so each window advances it by its budget
     ("convergence_study",
@@ -80,10 +86,6 @@ QUEUE = [
       "--state-dir", "results/convergence_state_full",
       "--out", "results/convergence_fullscale.md"],
      7200),
-    # per-pass attribution of the 38 s GAT epoch (bucket-only, safe)
-    ("gat_microbench",
-     [sys.executable, "scripts/gat_microbench.py"],
-     2400),
     # LAST: the raw-xla GAT compile crashed the remote compile helper
     # once (HTTP 500) around a tunnel death — quarantined at the tail
     # so a repeat cannot burn the load-bearing steps above
