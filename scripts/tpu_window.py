@@ -61,7 +61,10 @@ QUEUE = [
      [sys.executable, "scripts/gat_microbench.py"],
      2400),
     # calibrated-task convergence study (VERDICT item 2): resumable via
-    # per-leg checkpoints, so each window advances it by its budget
+    # per-leg checkpoints, so each window advances it by its budget.
+    # (A round-5 attempt to grind this on the CPU host was reverted:
+    # the xla-impl raw-gather epoch at 3.9M edges x 4 emulated parts is
+    # ~minutes on one CPU core vs ~ms on chip — the study is chip-work.)
     ("convergence_study",
      [sys.executable, "scripts/convergence_study.py",
       "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
