@@ -54,6 +54,12 @@ QUEUE = [
      [sys.executable, "scripts/offshape_bench.py", "--shape",
       "products", "--impl", "bucket"],
      3600),
+    # the policy question is bucket-vs-BLOCK at this shape (auto
+    # resolves to bucket there); block tables prewarmed host-side
+    ("offshape_products_block",
+     [sys.executable, "scripts/offshape_bench.py", "--shape",
+      "products", "--impl", "block"],
+     3600),
     # cheap GAT attribution (incl. the narrow-row gather-rate curve
     # that decides the el-packing-vs-Pallas-softmax question) BEFORE
     # the convergence legs, which absorb every remaining window second
