@@ -80,15 +80,21 @@ QUEUE = [
     # VERDICT r3 item 3, full scale: the 97.1%-claim analogue at FULL
     # node count AND full degree (232,965 nodes x avg degree 492 =
     # Reddit's shape, reference README.md:91-99), P=2 like the
-    # reference's scripts/reddit.sh, 3000 epochs x 3 legs. Resumable
-    # + artifact-cached: each window pass advances it by its budget.
+    # reference's scripts/reddit.sh. Epochs 1200 (was 3000): 3000 is
+    # Reddit's schedule; the calibrated SBM separates variants by ~150
+    # epochs at degree 6 (results/staleness_parity_reddit_scale.md)
+    # and the label-noise ceiling bounds attainable accuracy — 1200
+    # makes a COMPLETED 3-leg study realistic in sporadic ~45-min
+    # windows (3 legs x 1200 x ~1.4 s/epoch ~ 1.4 h of chip time)
+    # where an incomplete 3000-epoch one repeats round 4's failure.
+    # Resumable + artifact-cached: each window advances it.
     ("convergence_full",
      [sys.executable, "scripts/convergence_study.py",
       "--nodes", "232965", "--degree", "492", "--feat", "602",
       "--classes", "41", "--parts", "2", "--cluster-size", "1024",
       "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
       "--spmm-impl", "auto", "--spmm-chunk", "524288",
-      "--block-group", "4",
+      "--block-group", "4", "--epochs", "1200",
       "--fused", "8", "--eval-every", "100",
       "--cache-artifacts", "--time-budget", "3600",
       "--light-dir", "results/convergence_light/full",
