@@ -98,6 +98,28 @@ def test_sequential_one_shot_matches_epoch0(sharded):
     np.testing.assert_allclose(l_one, l_full, rtol=1e-4, atol=1e-4)
 
 
+def test_sequential_compact_halo_pad4_artifact():
+    """pad_to=4 artifacts can have b_max below the compact layout's
+    round-to-8 caps; the caps must clamp to b_max (regression for the
+    _compact_send broadcast crash)."""
+    # seed 1 chosen so the clamp BINDS: b_max=52, per-distance max
+    # counts [49, 50] -> round-to-8 gives 56 > b_max (asserted below
+    # so fixture drift can't silently un-bind the regression)
+    g = synthetic_graph(num_nodes=300, avg_degree=6, n_feat=8,
+                        n_class=4, seed=1)
+    parts = partition_graph(g, 3, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=3, pad_to=4)
+    raw_caps = np.asarray(sg.send_counts).max(axis=0)
+    assert any(-(-int(c) // 8) * 8 > sg.b_max for c in raw_caps), \
+        "fixture no longer exercises the cap clamp"
+    cfg = ModelConfig(layer_sizes=(8, 12, 4), norm="layer", dropout=0.0,
+                      train_size=sg.n_train_global, spmm_impl="bucket")
+    tcfg = TrainConfig(lr=0.01, enable_pipeline=True, eval=False, seed=1)
+    run = SequentialRunner(sg, cfg, tcfg, compact_halo=True)
+    losses = [run.run_epoch(e) for e in range(2)]
+    assert all(np.isfinite(losses))
+
+
 def test_sequential_rejects_unsupported(sharded):
     sg = sharded
     with pytest.raises(ValueError, match="pipelined"):
