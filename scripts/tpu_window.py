@@ -40,6 +40,19 @@ QUEUE = [
     ("rem_probe",
      [sys.executable, "scripts/rem_probe.py"],
      2400),
+    # calibrated-task convergence study (VERDICT item 2) THIRD so a
+    # single ~45-min window covers the top-2 probes AND puts real
+    # training hours on the accuracy claim (on chip this study is
+    # minutes per leg; the budget bounds it per pass). Resumable via
+    # per-leg checkpoints. (A round-5 attempt to grind it on the CPU
+    # host was reverted: the xla-impl raw-gather epoch at 3.9M edges x
+    # 4 emulated parts is ~minutes on one CPU core vs ~ms on chip.)
+    ("convergence_study",
+     [sys.executable, "scripts/convergence_study.py",
+      "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
+      "--light-dir", "results/convergence_light/d492",
+      "--time-budget", "1500"],
+     2400),
     # refresh the round-5 headline + results/last_tpu_bench.json
     ("bench_u4_f8_r5",
      [sys.executable, "bench.py", "--block-group", "4",
@@ -65,17 +78,6 @@ QUEUE = [
     # the convergence legs, which absorb every remaining window second
     ("gat_microbench",
      [sys.executable, "scripts/gat_microbench.py"],
-     2400),
-    # calibrated-task convergence study (VERDICT item 2): resumable via
-    # per-leg checkpoints, so each window advances it by its budget.
-    # (A round-5 attempt to grind this on the CPU host was reverted:
-    # the xla-impl raw-gather epoch at 3.9M edges x 4 emulated parts is
-    # ~minutes on one CPU core vs ~ms on chip — the study is chip-work.)
-    ("convergence_study",
-     [sys.executable, "scripts/convergence_study.py",
-      "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
-      "--light-dir", "results/convergence_light/d492",
-      "--time-budget", "1500"],
      2400),
     # VERDICT r3 item 3, full scale: the 97.1%-claim analogue at FULL
     # node count AND full degree (232,965 nodes x avg degree 492 =
