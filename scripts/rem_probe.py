@@ -124,6 +124,11 @@ def main():
 
     res["anchor_s"] = timed(anchor, (fbuf8, flat_idx), "anchor",
                             padded_rows)
+    # sorted-index anchor: if ascending requests run much faster than
+    # random ones, locality-ordering bucket rows at table build (free,
+    # host-side) is a production lever worth a follow-up
+    res["anchor_sorted_s"] = timed(
+        anchor, (fbuf8, jnp.sort(flat_idx)), "anchor-sort", padded_rows)
 
     def rem(f, ms, iv):
         return bucket_aggregate(transport_cast(f, fp8), ms, iv,
