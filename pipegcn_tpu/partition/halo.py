@@ -62,6 +62,53 @@ def _stable_argsort(keys: np.ndarray) -> np.ndarray:
     return stable_argsort(keys)
 
 
+class _RaggedEdges:
+    """Per-rank trimmed edge arrays of a trim_edges v3 artifact.
+
+    Indexing by rank (`arr[r]`) memmaps that rank's trimmed 1-D file —
+    slicing it `[:edge_count[r]]` is the identity, so per-rank code
+    written against the padded [P, e_max] stack works unchanged.
+    Whole-array operations (astype/reshape/...) are intentionally
+    unsupported: the padded stack was not stored."""
+
+    def __init__(self, adir: str, key: str, num_parts: int):
+        self._adir = adir
+        self._key = key
+        self.num_parts = num_parts
+
+    def __len__(self):
+        return self.num_parts
+
+    def __getitem__(self, r):
+        if not isinstance(r, (int, np.integer)):
+            raise TypeError(
+                f"{self._key} is stored per-rank trimmed "
+                "(trim_edges artifact); index by rank int only")
+        if not 0 <= int(r) < self.num_parts:
+            raise IndexError(
+                f"rank {r} out of range [0, {self.num_parts})")
+        return np.load(os.path.join(self._adir,
+                                    f"{self._key}_r{int(r):03d}.npy"),
+                       mmap_mode="r")
+
+    def __array__(self, *a, **kw):
+        # numpy coercion (np.asarray / zeros_like / iteration fallback)
+        # must fail with the explanatory message, not a confusing
+        # FileNotFoundError past the last rank or a silently unpadded
+        # stack of equal-length ranks
+        raise TypeError(
+            f"{self._key} is a trim_edges per-rank view; the padded "
+            "[P, e_max] stack was not stored — re-save without "
+            "trim_edges for whole-array consumers")
+
+    def __getattr__(self, name):
+        raise AttributeError(
+            f"{self._key} is a trim_edges per-rank view; the padded "
+            f"[P, e_max] stack was not stored (re-save without "
+            f"trim_edges for whole-array consumers like the mesh "
+            f"Trainer) — attribute {name!r} unsupported")
+
+
 @dataclasses.dataclass
 class ShardedGraph:
     """Stacked per-device arrays (leading axis = device / partition).
@@ -540,7 +587,19 @@ class ShardedGraph:
     FORMAT_VERSION = 2
     MMAP_FORMAT_VERSION = 3
 
-    def save(self, path: str, mmap: bool = False) -> None:
+    def save(self, path: str, mmap: bool = False,
+             trim_edges: bool = False) -> None:
+        """trim_edges (v3/mmap only): store edge_src/edge_dst per rank,
+        TRIMMED to each rank's real edge count, instead of the padded
+        [P, e_max] stack — at papers100M scale the pareto-hub rank sets
+        e_max ~2.7x the mean and the padded stack alone is ~69 GB on
+        disk. load() then returns a _RaggedEdges view for those two
+        keys; per-rank consumers (SequentialRunner, the ladder scan)
+        index it exactly like the stacked array (`arr[r][:e]`), while
+        whole-array consumers fail loudly (the mesh Trainer wants the
+        padded stack — rebuild without trim_edges for that)."""
+        if trim_edges and not mmap:
+            raise ValueError("trim_edges requires mmap=True (v3)")
         os.makedirs(path, exist_ok=True)
         manifest = {
             "format_version": (self.MMAP_FORMAT_VERSION if mmap
@@ -555,6 +614,8 @@ class ShardedGraph:
             "multilabel": self.multilabel,
             "source_edge_checksum": self.source_edge_checksum,
         }
+        if trim_edges:
+            manifest["trimmed_edges"] = True
         # arrays first, manifest last: exists() keys off the manifest, so
         # a reader polling a shared filesystem (multi-host prepare) never
         # observes a half-written artifact
@@ -562,6 +623,13 @@ class ShardedGraph:
             adir = os.path.join(path, "arrays")
             os.makedirs(adir, exist_ok=True)
             for k in self._ARRAYS:
+                if trim_edges and k in ("edge_src", "edge_dst"):
+                    arr = getattr(self, k)
+                    for r in range(self.num_parts):
+                        e_r = int(self.edge_count[r])
+                        np.save(os.path.join(adir, f"{k}_r{r:03d}.npy"),
+                                np.asarray(arr[r][:e_r]))
+                    continue
                 np.save(os.path.join(adir, f"{k}.npy"), getattr(self, k))
         else:
             np.savez_compressed(
@@ -577,10 +645,16 @@ class ShardedGraph:
             manifest = json.load(f)
         version = manifest.pop("format_version", 0)
         if version == ShardedGraph.MMAP_FORMAT_VERSION:
+            trimmed = manifest.pop("trimmed_edges", False)
             adir = os.path.join(path, "arrays")
-            arrays = {k: np.load(os.path.join(adir, f"{k}.npy"),
-                                 mmap_mode="r")
-                      for k in ShardedGraph._ARRAYS}
+            arrays = {}
+            for k in ShardedGraph._ARRAYS:
+                if trimmed and k in ("edge_src", "edge_dst"):
+                    arrays[k] = _RaggedEdges(adir, k,
+                                             manifest["num_parts"])
+                    continue
+                arrays[k] = np.load(os.path.join(adir, f"{k}.npy"),
+                                    mmap_mode="r")
             return ShardedGraph(**manifest, cache_dir=path, **arrays)
         if version != ShardedGraph.FORMAT_VERSION:
             raise ValueError(

@@ -257,7 +257,11 @@ def main():
                    "test_mask": test_mask, "in_deg": in_deg},
         )
         sg = ShardedGraph.build_chunked(g, parts, n_parts=args.parts)
-        sg.save(apath, mmap=True)
+        # trim_edges: the pareto-hub rank sets e_max ~2.7x the mean
+        # edge count, so the padded [64, e_max] stack alone is ~69 GB —
+        # more than this host's free disk; trimmed per-rank storage is
+        # ~26 GB and is all the sequential step reads anyway
+        sg.save(apath, mmap=True, trim_edges=True)
         record("artifact", t0)
         del sg, g
     sg = ShardedGraph.load(apath)

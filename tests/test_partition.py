@@ -258,6 +258,26 @@ def test_artifact_roundtrip_mmap_v3(tmp_path):
     rank0_feat = np.asarray(sg2.feat[0])
     np.testing.assert_array_equal(rank0_feat, sg.feat[0])
 
+    # trim_edges variant: per-rank trimmed edge files, identical up to
+    # each rank's real edge count; whole-array access fails loudly
+    tpath = str(tmp_path / "part_v3_trim")
+    sg.save(tpath, mmap=True, trim_edges=True)
+    sg3 = ShardedGraph.load(tpath)
+    for r in range(sg.num_parts):
+        e = int(sg.edge_count[r])
+        np.testing.assert_array_equal(sg3.edge_src[r][:e],
+                                      sg.edge_src[r][:e])
+        np.testing.assert_array_equal(sg3.edge_dst[r][:e],
+                                      sg.edge_dst[r][:e])
+    with pytest.raises(AttributeError, match="trim_edges"):
+        sg3.edge_src.astype(np.int32)
+    with pytest.raises(TypeError, match="trim_edges"):
+        np.asarray(sg3.edge_src)
+    with pytest.raises(IndexError):
+        sg3.edge_src[sg.num_parts]
+    with pytest.raises(ValueError, match="mmap"):
+        sg.save(str(tmp_path / "bad"), trim_edges=True)
+
 
 def test_build_chunked_bit_identical():
     """build_chunked must reproduce build() EXACTLY — every array, every
