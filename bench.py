@@ -309,10 +309,8 @@ def main():
             backend = "cpu-fallback"
             jax.config.update("jax_platforms", "cpu")
 
-    from pipegcn_tpu.graph import load_data
     from pipegcn_tpu.models import ModelConfig
     from pipegcn_tpu.parallel import Trainer, TrainConfig
-    from pipegcn_tpu.partition import ShardedGraph, partition_graph
 
     device_kind = jax.devices()[0].device_kind
     n_parts = args.parts or len(jax.devices())
@@ -330,44 +328,26 @@ def main():
         print("# cpu-fallback: degrading to the small config, 3 single-"
               "epoch blocks, no comparison run", file=sys.stderr)
     if args.small:
-        dataset = "synthetic:10000:20:64:16"
         hidden, n_layers = 64, 3
         spmm_chunk = None
-        name = f"bench-small-{n_parts}"
     else:
-        dataset = "synthetic-reddit"
         hidden, n_layers = 256, 4
         spmm_chunk = 2_097_152  # bound gathered messages to [2M, F]
         # ([2M, 602] f32 = 4.8 GB peak for the pp precompute gather)
-        name = f"bench-reddit-{n_parts}"
 
-    # "-c" suffix: artifacts with cluster-reordered local ids (the same
-    # format; a different, locality-aware numbering). "2": generator
-    # revision (simple graph — duplicate sampled pairs deduped, matching
-    # the real Reddit's multiplicity-1 adjacency). The cluster
-    # granularity is part of the artifact identity (cluster_suffix
-    # always encodes it; measured sweep in docs/PERF_NOTES.md).
-    from pipegcn_tpu.partition.partitioner import cluster_suffix
+    # Artifact naming/recipe live in partition.bench_artifact (shared
+    # with the window-queue probe scripts); cluster granularity and
+    # generator revision are part of the artifact identity (measured
+    # sweep in docs/PERF_NOTES.md). load() sets cache_dir so derived
+    # kernel tables cache under the artifact dir too.
+    from pipegcn_tpu.partition.bench_artifact import artifact_path, ensure
 
-    suf = cluster_suffix(args.cluster_size)
-    part_path = os.path.join("partitions", f"{name}-c2-{suf}")
+    part_path = artifact_path(n_parts, args.cluster_size,
+                              small=args.small)
     t0 = time.perf_counter()
-    if ShardedGraph.exists(part_path):
-        sg = ShardedGraph.load(part_path)
-        print(f"# loaded cached partitions ({time.perf_counter()-t0:.1f}s)",
-              file=sys.stderr)
-    else:
-        from pipegcn_tpu.partition import locality_clusters
-
-        g = load_data(dataset)
-        parts = partition_graph(g, n_parts, method="metis", obj="vol", seed=0)
-        cluster = locality_clusters(g, target_size=args.cluster_size,
-                                    seed=0)
-        sg = ShardedGraph.build(g, parts, n_parts=n_parts, cluster=cluster)
-        sg.save(part_path)
-        sg.cache_dir = part_path  # cache derived kernel tables too
-        print(f"# built partitions ({time.perf_counter()-t0:.1f}s)",
-              file=sys.stderr)
+    sg = ensure(part_path, log=lambda m: print(m, file=sys.stderr))
+    print(f"# partitions ready ({time.perf_counter()-t0:.1f}s)",
+          file=sys.stderr)
 
     try:
         _measure(args, backend, device_kind, n_parts, degraded, sg,

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Build (or rebuild) a bench partition artifact host-side.
+
+Thin CLI over pipegcn_tpu.partition.bench_artifact.ensure() — the one
+canonical recipe. Run while the chip queue is busy: the build is pure
+host numpy.
+
+Usage: python scripts/build_bench_artifact.py [--parts 1]
+           [--cluster-size 1024] [--small]
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--parts", type=int, default=1)
+    ap.add_argument("--cluster-size", type=int, default=1024)
+    ap.add_argument("--small", action="store_true")
+    args = ap.parse_args()
+
+    from pipegcn_tpu.partition.bench_artifact import artifact_path, ensure
+
+    path = artifact_path(args.parts, args.cluster_size, small=args.small,
+                         root=os.path.join(REPO, "partitions"))
+    ensure(path, log=lambda m: print(m, flush=True))
+    print(f"ready: {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -60,27 +60,23 @@ def main():
     from pipegcn_tpu.models import ModelConfig
     from pipegcn_tpu.parallel import Trainer, TrainConfig
     from pipegcn_tpu.partition import ShardedGraph
+    from pipegcn_tpu.partition.bench_artifact import build_artifact, ensure
 
+    log = lambda m: print(m, file=sys.stderr)  # noqa: E731
     if args.dataset:
         part_path = os.path.join(
-            "partitions",
+            REPO, "partitions",
             "gat-" + args.dataset.replace(":", "_") + "-c-s1024")
         if ShardedGraph.exists(part_path):
             sg = ShardedGraph.load(part_path)
         else:
-            from pipegcn_tpu.graph import load_data
-            from pipegcn_tpu.partition import (locality_clusters,
-                                               partition_graph)
-
-            g = load_data(args.dataset)
-            parts = partition_graph(g, 1, seed=0)
-            cluster = locality_clusters(g, target_size=1024, seed=0)
-            sg = ShardedGraph.build(g, parts, n_parts=1,
-                                    cluster=cluster)
-            sg.save(part_path)
-            sg.cache_dir = part_path
+            sg = build_artifact(args.dataset, 1, 1024, part_path, log=log)
     else:
-        sg = ShardedGraph.load(args.part)
+        # rebuilt if missing: partitions/ is not git-tracked and
+        # vanishes between rounds
+        if not os.path.isabs(args.part):
+            args.part = os.path.join(REPO, args.part)
+        sg = ensure(args.part, log=log)
     cfg = ModelConfig(
         # 3 graph layers like the SAGE headline (no use_pp for GAT)
         layer_sizes=(sg.n_feat, args.hidden, args.hidden, args.hidden,

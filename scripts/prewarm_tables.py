@@ -36,9 +36,14 @@ def main():
 
     from pipegcn_tpu.models import ModelConfig
     from pipegcn_tpu.parallel import Trainer
-    from pipegcn_tpu.partition import ShardedGraph
 
-    sg = ShardedGraph.load(args.part)
+    # rebuilt if missing: partitions/ is not git-tracked and vanishes
+    # between rounds
+    from pipegcn_tpu.partition.bench_artifact import ensure
+
+    if not os.path.isabs(args.part):
+        args.part = os.path.join(REPO, args.part)
+    sg = ensure(args.part, log=lambda m: print(m, file=sys.stderr))
     cfg = ModelConfig(
         model="gat" if args.impl == "gat" else "graphsage",
         layer_sizes=(sg.n_feat,) + (args.hidden,) * 3 + (sg.n_class,),

@@ -61,9 +61,14 @@ def main():
                                              transport_cast,
                                              transport_dtypes)
     from pipegcn_tpu.parallel import Trainer, TrainConfig
-    from pipegcn_tpu.partition import ShardedGraph
 
-    sg = ShardedGraph.load(args.part)
+    # partitions/ is not git-tracked and vanishes between rounds;
+    # ensure() rebuilds host-side (no jax) rather than failing the step
+    from pipegcn_tpu.partition.bench_artifact import ensure
+
+    if not os.path.isabs(args.part):
+        args.part = os.path.join(REPO, args.part)
+    sg = ensure(args.part, log=lambda m: print(m, file=sys.stderr))
     cfg = ModelConfig(
         layer_sizes=(sg.n_feat, 256, 256, 256, sg.n_class),
         use_pp=True, norm="layer", dropout=0.5,
