@@ -33,7 +33,12 @@ import time
 
 import numpy as np
 
+from pipegcn_tpu.obs.hw import peak_flops_for
+
 BASELINE_EPOCH_S = 0.266  # reference README.md:93-94 (2x GPU)
+
+# repo root: artifacts and result records anchor here, never the CWD
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Cap on the wall-clock of ONE device dispatch. The axon tunnel has been
 # observed to kill the TPU worker mid-run under long Execute calls
@@ -69,17 +74,8 @@ def _reexec_degraded(stage: int, reason: str) -> None:
     os.execv(sys.executable,
              [sys.executable] + argv + [_STAGE_FLAG, str(stage + 1)])
 
-# peak dense bf16 FLOP/s per chip, by device_kind substring (public specs)
-PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
+# the peak-FLOPs table lives in pipegcn_tpu/obs/hw.py (shared with the
+# report CLI's MFU computation)
 
 
 def probe_backend(timeout_s: float) -> dict:
@@ -170,8 +166,7 @@ def persist_last_tpu(value, vs_baseline, extras, backend,
     final result AND for the best-so-far number right before the risky
     fused-candidate compile (a worker death must not lose an in-hand
     measurement)."""
-    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "results", "last_tpu_bench.json")
+    last_path = os.path.join(REPO, "results", "last_tpu_bench.json")
     try:
         import datetime
 
@@ -201,14 +196,6 @@ def persist_last_tpu(value, vs_baseline, extras, backend,
         # not destroy the previous good record
     except OSError:
         pass
-
-
-def peak_flops_for(kind: str):
-    k = kind.lower()
-    for sub, f in PEAK_FLOPS:
-        if sub in k:
-            return f
-    return None
 
 
 def main():
@@ -269,6 +256,12 @@ def main():
                          "budget, default 900s)")
     ap.add_argument("--cpu", action="store_true",
                     help="run on CPU without probing the TPU backend")
+    ap.add_argument("--metrics-out", default="",
+                    help="also append the headline result to this "
+                         "metrics JSONL file through the obs sink "
+                         "(schema: pipegcn_tpu/obs/schema.py; "
+                         "summarize with python -m "
+                         "pipegcn_tpu.cli.report)")
     ap.add_argument("--force-candidate", action="store_true",
                     help=argparse.SUPPRESS)  # CPU test hook for the
     # candidate-config pass (normally TPU-gated)
@@ -342,8 +335,12 @@ def main():
     # kernel tables cache under the artifact dir too.
     from pipegcn_tpu.partition.bench_artifact import artifact_path, ensure
 
+    # anchored at the repo root like the probe scripts: bench invoked
+    # from another CWD must reuse the same cached artifacts, not build
+    # duplicates under ./partitions (ADVICE.md round 5)
     part_path = artifact_path(n_parts, args.cluster_size,
-                              small=args.small)
+                              small=args.small,
+                              root=os.path.join(REPO, "partitions"))
     t0 = time.perf_counter()
     sg = ensure(part_path, log=lambda m: print(m, file=sys.stderr))
     print(f"# partitions ready ({time.perf_counter()-t0:.1f}s)",
@@ -757,8 +754,7 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         **extras,
     }
     # anchored at the repo root (bench may be invoked from any CWD)
-    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "results", "last_tpu_bench.json")
+    last_path = os.path.join(REPO, "results", "last_tpu_bench.json")
     if backend == "tpu" and metric == "reddit_scale_epoch_time" \
             and not extras.get("degraded"):
         # record the full-quality headline so a later degraded/CPU run
@@ -775,6 +771,18 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                 result["last_tpu_measurement"] = json.load(f)
         except (OSError, ValueError):
             pass
+    if args.metrics_out:
+        # the same sink the trainer logs through: a run header (what
+        # produced the number) + one "bench" event with the headline
+        from pipegcn_tpu.obs import MetricsLogger, device_info
+
+        try:
+            with MetricsLogger(args.metrics_out) as ml:
+                ml.run_header(config=vars(args), device=device_info(),
+                              mesh={"n_parts": n_parts})
+                ml.event("bench", **result)
+        except OSError as exc:
+            print(f"# metrics sink unavailable: {exc}", file=sys.stderr)
     print(json.dumps(result))
 
 

@@ -261,19 +261,40 @@ def run(args) -> dict:
         }
         print(f"resumed from {args.checkpoint_dir} at epoch {start_epoch}")
 
-    fit_res = trainer.fit(
-        eval_graphs,
-        start_epoch=start_epoch,
-        reference_logs=True,
-        result_file=rfile,
-        inductive=args.inductive,
-        checkpoint_dir=args.checkpoint_dir or None,
-        checkpoint_every=args.checkpoint_every,
-        profile_dir=args.profile_dir or None,
-        measure_comm_cost=True,
-        sharded_eval=args.sharded_eval,
-        async_eval=not args.sync_eval,
-    )
+    metrics = None
+    if args.metrics_out:
+        from ..obs import MetricsLogger, device_info, mesh_info
+
+        metrics = MetricsLogger(args.metrics_out)
+        # args-level header (richer than the trainer's fallback): the
+        # exact CLI invocation that produced the numbers
+        metrics.run_header(
+            config=vars(args),
+            device=device_info(),
+            mesh={"n_parts": args.n_partitions,
+                  **mesh_info(trainer.mesh)},
+        )
+
+    try:
+        fit_res = trainer.fit(
+            eval_graphs,
+            start_epoch=start_epoch,
+            reference_logs=True,
+            result_file=rfile,
+            inductive=args.inductive,
+            checkpoint_dir=args.checkpoint_dir or None,
+            checkpoint_every=args.checkpoint_every,
+            profile_dir=args.profile_dir or None,
+            measure_comm_cost=True,
+            sharded_eval=args.sharded_eval,
+            async_eval=not args.sync_eval,
+            metrics=metrics,
+        )
+    finally:
+        # every record is already flushed; close releases the handle
+        # even when training crashes mid-run
+        if metrics is not None:
+            metrics.close()
 
     result = {
         "graph_name": graph_name,
@@ -281,6 +302,8 @@ def run(args) -> dict:
         "best_val": fit_res["best_val"],
         "best_epoch": fit_res["best_epoch"],
     }
+    if args.metrics_out:
+        result["metrics_out"] = args.metrics_out
     if args.eval and fit_res["best_params"] is not None:
         os.makedirs(args.model_dir, exist_ok=True)
         model_path = os.path.join(args.model_dir, f"{graph_name}_final.npz")

@@ -182,6 +182,14 @@ def create_parser() -> argparse.ArgumentParser:
                         default="",
                         help="write a jax.profiler trace of a few epochs "
                              "to this directory (TensorBoard format)")
+    parser.add_argument("--metrics-out", "--metrics_out", type=str,
+                        default="",
+                        help="append structured JSONL telemetry (run "
+                             "header + per-epoch/eval/summary records; "
+                             "schema in pipegcn_tpu/obs/schema.py, see "
+                             "docs/OBSERVABILITY.md) to this file; "
+                             "summarize with python -m "
+                             "pipegcn_tpu.cli.report")
     parser.add_argument("--sharded-eval", "--sharded_eval",
                         action="store_true",
                         help="evaluate through the training mesh instead "

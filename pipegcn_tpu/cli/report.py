@@ -1,0 +1,211 @@
+"""Reporting CLI over metrics JSONL files.
+
+    python -m pipegcn_tpu.cli.report run1.jsonl [run2.jsonl ...] [--json]
+
+Reads files written by the MetricsLogger sink (obs/metrics.py; schema
+obs/schema.py) and emits a per-run summary: epoch-time statistics,
+loss-curve deltas, gradient-norm tail, halo traffic, memory peak,
+comm/compute overlap fraction and (when the run recorded FLOPs on a
+known chip) MFU. `--json` emits one JSON object per file instead of
+the human block — the form the bench trajectory consumes.
+
+Everything is best-effort per field: a run that never measured comm
+cost, or ran on a platform without memory stats, summarizes without
+those rows rather than erroring (consumers must tolerate absent
+fields, the schema contract)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..obs.hw import peak_flops_for
+from ..obs.metrics import read_metrics
+
+
+def _median(xs: List[float]) -> Optional[float]:
+    if not xs:
+        return None
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Collapse one run's records into the summary dict the CLI
+    prints. Tolerates missing header/summary (partial files from
+    crashed runs still summarize their epochs)."""
+    header = next((r for r in records if r.get("event") == "run"), None)
+    summary = next((r for r in records if r.get("event") == "summary"),
+                   None)
+    epochs = [r for r in records if r.get("event") == "epoch"]
+    evals = [r for r in records if r.get("event") == "eval"]
+
+    out: Dict[str, Any] = {"n_epoch_records": len(epochs),
+                           "n_eval_records": len(evals)}
+    if header:
+        out["schema_version"] = header.get("schema_version")
+        dev = header.get("device") or {}
+        out["device"] = dev.get("device_kind") or dev.get("platform")
+        out["n_devices"] = dev.get("n_devices")
+        cfg = header.get("config") or {}
+        # CLI headers carry args flat; trainer fallback headers nest
+        # the TrainConfig under "train"
+        out["pipeline"] = bool(
+            cfg.get("enable_pipeline",
+                    (cfg.get("train") or {}).get("enable_pipeline",
+                                                 False)))
+
+    bench = next((r for r in records if r.get("event") == "bench"), None)
+    if bench:
+        # bench.py --metrics-out: surface the headline measurement
+        out["bench_metric"] = bench.get("metric")
+        out["bench_value"] = bench.get("value")
+        out["bench_unit"] = bench.get("unit")
+        out["vs_baseline"] = bench.get("vs_baseline")
+        if "pipeline" in bench:
+            out["pipeline"] = bool(bench["pipeline"])
+
+    steps = [r["step_time_s"] for r in epochs
+             if isinstance(r.get("step_time_s"), (int, float))]
+    if steps:
+        out["median_epoch_s"] = round(_median(steps), 6)
+        out["mean_epoch_s"] = round(sum(steps) / len(steps), 6)
+        out["total_step_s"] = round(sum(steps), 6)
+    losses = [r["loss"] for r in epochs
+              if isinstance(r.get("loss"), (int, float))]
+    if losses:
+        out["loss_first"] = round(losses[0], 6)
+        out["loss_last"] = round(losses[-1], 6)
+        out["loss_delta"] = round(losses[-1] - losses[0], 6)
+    gnorms = [r["grad_norm"] for r in epochs
+              if isinstance(r.get("grad_norm"), (int, float))]
+    if gnorms:
+        out["grad_norm_last"] = round(gnorms[-1], 6)
+    halo = [r["halo_bytes"] for r in epochs
+            if isinstance(r.get("halo_bytes"), int)]
+    if halo:
+        out["halo_bytes_per_epoch"] = max(halo)
+    ages = [r["staleness_age"] for r in epochs
+            if isinstance(r.get("staleness_age"), int)]
+    if ages:
+        out["staleness_age_max"] = max(ages)
+    peaks = [(r.get("memory") or {}).get("peak_bytes_in_use")
+             for r in epochs]
+    peaks = [p for p in peaks if isinstance(p, int)]
+    if peaks:
+        out["memory_peak_bytes"] = max(peaks)
+
+    accs = [r["val_acc"] for r in evals
+            if isinstance(r.get("val_acc"), (int, float))]
+    if accs:
+        out["best_val"] = round(max(accs), 6)
+        out["final_val"] = round(accs[-1], 6)
+    ets = [r["eval_time_s"] for r in evals
+           if isinstance(r.get("eval_time_s"), (int, float))]
+    if ets:
+        out["mean_eval_s"] = round(sum(ets) / len(ets), 6)
+
+    if summary:
+        for k in ("best_val", "best_epoch", "test_acc", "n_epochs"):
+            if summary.get(k) is not None:
+                out[k] = summary[k]
+        if summary.get("epoch_time_s") is not None:
+            # fit()'s warmup-excluded mean beats the raw record median
+            out["epoch_time_s"] = summary["epoch_time_s"]
+        cc = summary.get("comm_cost") or {}
+        comm_total = sum(v for v in cc.values()
+                         if isinstance(v, (int, float)))
+        base = out.get("epoch_time_s") or out.get("median_epoch_s")
+        if cc and base:
+            out["comm_cost_s"] = round(comm_total, 6)
+            # standalone collective cost as a fraction of the epoch: in
+            # pipelined mode this is the comm the staleness-1 carry
+            # lets XLA overlap with compute (the exposed wait is ~0,
+            # results/overlap_study.md); in vanilla mode it is an
+            # upper bound on the exposed fraction
+            out["comm_fraction"] = round(min(comm_total / base, 1.0), 4)
+            if out.get("pipeline"):
+                out["overlapped_comm_fraction"] = out["comm_fraction"]
+        fl = summary.get("flops_per_epoch")
+        base = out.get("epoch_time_s") or out.get("median_epoch_s")
+        peak = peak_flops_for(str(out.get("device") or ""))
+        nd = out.get("n_devices") or 1
+        if isinstance(fl, (int, float)) and fl and base and peak:
+            out["mfu_pct"] = round(100.0 * fl / (base * peak * nd), 2)
+    return out
+
+
+def format_summary(path: str, s: Dict[str, Any]) -> str:
+    lines = [f"== {path} =="]
+
+    def row(label, key, fmt="{}", scale=1.0):
+        v = s.get(key)
+        if v is None:
+            return
+        if isinstance(v, (int, float)) and scale != 1.0:
+            v = v * scale
+        lines.append(f"  {label:<26} {fmt.format(v)}")
+
+    row("schema version", "schema_version")
+    row("device", "device")
+    row("devices", "n_devices")
+    row("pipeline", "pipeline")
+    if s.get("bench_value") is not None:
+        lines.append("  {:<26} {} {} ({})".format(
+            "bench headline", s["bench_value"], s.get("bench_unit", ""),
+            s.get("bench_metric", "")))
+        row("vs baseline", "vs_baseline", "{:.3f}x")
+    row("epochs recorded", "n_epoch_records")
+    row("epoch time (fit mean)", "epoch_time_s", "{:.4f} s")
+    row("median epoch", "median_epoch_s", "{:.4f} s")
+    row("loss first -> last", "loss_first", "{:.4f}")
+    row("loss last", "loss_last", "{:.4f}")
+    row("loss delta", "loss_delta", "{:+.4f}")
+    row("grad norm (last)", "grad_norm_last", "{:.4e}")
+    row("halo bytes / epoch", "halo_bytes_per_epoch", "{:,}")
+    row("staleness age (max)", "staleness_age_max")
+    row("memory peak", "memory_peak_bytes", "{:,} bytes")
+    row("comm cost (standalone)", "comm_cost_s", "{:.4f} s")
+    row("comm fraction of epoch", "comm_fraction", "{:.2%}")
+    row("overlapped comm fraction", "overlapped_comm_fraction",
+        "{:.2%}")
+    row("MFU", "mfu_pct", "{:.2f} %")
+    row("best val", "best_val", "{:.4f}")
+    row("best epoch", "best_epoch")
+    row("test acc", "test_acc", "{:.4f}")
+    row("mean eval wait", "mean_eval_s", "{:.4f} s")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pipegcn_tpu.cli.report",
+        description="Summarize metrics JSONL files written with "
+                    "--metrics-out (schema: pipegcn_tpu/obs/schema.py)")
+    ap.add_argument("files", nargs="+", help="metrics JSONL file(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON summary object per file")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    for path in args.files:
+        try:
+            recs = read_metrics(path)
+            s = summarize_run(recs)
+        except (OSError, ValueError) as exc:
+            print(f"{path}: {exc}", file=sys.stderr)
+            rc = 1
+            continue
+        if args.json:
+            print(json.dumps({"file": path, **s}))
+        else:
+            print(format_summary(path, s))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

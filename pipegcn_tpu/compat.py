@@ -1,0 +1,59 @@
+"""Shims for running the newer-jax API surface on older jax releases.
+
+The SPMD layer is written against the current public API
+(`jax.shard_map` with `check_vma`, `jax.lax.pcast` for varying-type
+marks). Older releases (<= 0.4.x) ship `shard_map` under
+`jax.experimental.shard_map` with the `check_rep` spelling and have no
+varying-manifest-axes system at all. `ensure_jax_compat()` installs
+aliases so the same code runs on both:
+
+  jax.shard_map     -> experimental shard_map; check_vma maps onto
+                       check_rep (both gate the same replication check)
+  jax.lax.pcast     -> identity (no vma system: every value is already
+                       acceptable everywhere, so the mark is a no-op;
+                       halo._ensure_varying's jax.typeof probe already
+                       degrades gracefully)
+
+Idempotent and a no-op on releases that already expose the API.
+Applied by pipegcn_tpu.parallel at import, before any shard_map use.
+"""
+
+from __future__ import annotations
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """jax.ShapeDtypeStruct carrying the varying-mesh-axes declaration
+    when the release supports it (newer jax, inside shard_map with
+    check_vma); older releases have no vma system — their check_rep
+    path never inspects output vma — so the kwarg is simply dropped."""
+    import jax
+
+    if vma is not None:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def ensure_jax_compat() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kw):
+            if check_vma is not None and "check_rep" not in kw:
+                kw["check_rep"] = bool(check_vma)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axis_name, to=None):
+            del axis_name, to
+            return x
+
+        jax.lax.pcast = pcast

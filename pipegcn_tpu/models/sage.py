@@ -443,6 +443,10 @@ def forward(
         return y + b.astype(out_dtype)
 
     for i in range(cfg.n_layers):
+      # named scope per layer: forward ops (and the backward ops XLA
+      # derives from them) show up as "layer{i}/..." in profiler
+      # traces instead of anonymous fusions (obs subsystem contract)
+      with jax.named_scope(f"layer{i}"):
         is_graph = i < cfg.n_graph_layers
         # the network's last matmul produces logits in f32 for a stable
         # loss; hidden layers stay in the compute dtype

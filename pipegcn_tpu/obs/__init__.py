@@ -1,0 +1,64 @@
+"""Observability: structured telemetry for the pipelined trainer.
+
+Three pillars (docs/OBSERVABILITY.md):
+
+  schema.py   versioned record schema (run header / epoch / eval /
+              summary) + validation — the stable contract bench.py,
+              scripts/*.py and the report CLI consume
+  metrics.py  MetricsLogger, the JSONL event sink, plus host probes
+              (device_info / mesh_info / memory_snapshot)
+  trace.py    XLA trace annotations (named_phase for traced code,
+              trace_span for host spans) and PhaseTimer — the
+              exception-safe, nesting-aware generalization of the
+              reference-parity CommTimer (utils/timer.py is now a shim
+              over it)
+  format.py   the canonical log-line formatters; the reference-format
+              lines (train.py:369-371, :33-39, :54-60) are pinned
+              byte-exact by tests/test_obs.py
+  hw.py       public per-chip peak-FLOPs table (MFU reporting)
+
+The reporting CLI lives in cli/report.py (`python -m
+pipegcn_tpu.cli.report metrics.jsonl`).
+
+No reference counterpart: the reference's only telemetry is stdout
+print lines and the result txt files; this subsystem is the
+machine-readable record every perf claim reports through.
+"""
+
+from .format import epoch_line, reference_eval_line, reference_train_line
+from .metrics import (
+    MetricsLogger,
+    device_info,
+    memory_snapshot,
+    mesh_info,
+    read_metrics,
+)
+from .schema import (
+    EPOCH_FIELDS,
+    EVAL_FIELDS,
+    RUN_FIELDS,
+    SCHEMA_VERSION,
+    SUMMARY_FIELDS,
+    validate_record,
+)
+from .trace import PhaseTimer, named_phase, trace_span
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "RUN_FIELDS",
+    "EPOCH_FIELDS",
+    "EVAL_FIELDS",
+    "SUMMARY_FIELDS",
+    "validate_record",
+    "MetricsLogger",
+    "read_metrics",
+    "device_info",
+    "mesh_info",
+    "memory_snapshot",
+    "PhaseTimer",
+    "named_phase",
+    "trace_span",
+    "epoch_line",
+    "reference_train_line",
+    "reference_eval_line",
+]

@@ -1,0 +1,46 @@
+"""Canonical log-line formatters.
+
+The reference-format lines are a byte-level compatibility surface:
+tooling built against the reference's log discipline parses them, so
+the exact format strings live HERE, in one place, and
+tests/test_obs.py pins their output byte-for-byte. The trainer and
+the CLI call these instead of scattering f-strings.
+
+  reference_train_line   train.py:369-371 (Process/Epoch/Time/Comm/
+                         Reduce/Loss)
+  reference_eval_line    train.py:33-39 (inductive) / :54-60 (trans)
+  epoch_line             this framework's own (non-reference) epoch
+                         progress line
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def reference_train_line(rank: int, epoch: int, time_s: float,
+                         comm_s: float, reduce_s: float,
+                         loss: float) -> str:
+    return ("Process {:03d} | Epoch {:05d} | Time(s) {:.4f} | "
+            "Comm(s) {:.4f} | Reduce(s) {:.4f} | Loss {:.4f}"
+            .format(rank, epoch, time_s, comm_s, reduce_s, loss))
+
+
+def reference_eval_line(epoch: int, val_acc: float,
+                        test_acc: Optional[float] = None) -> str:
+    if test_acc is None:
+        # reference evaluate_induc format (:33-39)
+        return "Epoch {:05d} | Accuracy {:.2%}".format(epoch, val_acc)
+    # reference evaluate_trans format (:54-60)
+    return ("Epoch {:05d} | Validation Accuracy {:.2%} | "
+            "Test Accuracy {:.2%}".format(epoch, val_acc, test_acc))
+
+
+def epoch_line(epoch: int, time_s: float, loss: float,
+               val_acc: Optional[float] = None) -> str:
+    """The framework's own progress line (1-based epoch, like the
+    pre-refactor f-strings in fit())."""
+    s = f"Epoch {epoch:05d} | Time(s) {time_s:.4f} | Loss {loss:.4f}"
+    if val_acc is not None:
+        s += f" | Val {val_acc:.4f}"
+    return s

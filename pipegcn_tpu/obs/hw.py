@@ -1,0 +1,30 @@
+"""Public per-chip peak dense bf16 FLOP/s, for MFU reporting.
+
+Single source of truth shared by bench.py and the report CLI (the
+table previously lived inline in bench.py). Matching is by substring
+of `device.device_kind`, most specific first.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# peak dense bf16 FLOP/s per chip, by device_kind substring (public specs)
+PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops_for(kind: str) -> Optional[float]:
+    k = (kind or "").lower()
+    for sub, f in PEAK_FLOPS:
+        if sub in k:
+            return f
+    return None

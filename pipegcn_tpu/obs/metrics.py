@@ -1,0 +1,224 @@
+"""MetricsLogger — the JSONL event sink — and host-side probes.
+
+One line per record, appended and flushed immediately so a crashed run
+still leaves every completed epoch on disk (the trainer's crash
+checkpoint philosophy applied to telemetry). Values are sanitized
+through `_jsonable` (numpy scalars/arrays, jnp dtypes, tuples) so
+callers can pass device-adjacent objects without ceremony.
+
+jax is imported lazily and only by the probes (device_info /
+mesh_info / memory_snapshot): the logger itself must stay importable
+from jax-free host processes (partition builders, report tooling).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from .schema import SCHEMA_VERSION, validate_record
+
+
+def _jsonable(v: Any) -> Any:
+    """Best-effort conversion to JSON-serializable types."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    # numpy / jax scalars and arrays without importing either
+    item = getattr(v, "item", None)
+    if callable(item) and getattr(v, "ndim", None) == 0:
+        return _jsonable(v.item())
+    tolist = getattr(v, "tolist", None)
+    if callable(tolist):
+        try:
+            return _jsonable(tolist())
+        except Exception:
+            pass
+    return str(v)
+
+
+class MetricsLogger:
+    """Append-only JSONL sink with schema validation.
+
+    `path` may be a filesystem path (parent dirs created, file opened
+    in append mode) or any object with ``write``. Use as a context
+    manager or call :meth:`close`; a logger left open still has every
+    record on disk (each write is flushed)."""
+
+    def __init__(self, path: Union[str, "os.PathLike", Any],
+                 validate: bool = True):
+        self._validate = validate
+        self._owns_file = isinstance(path, (str, os.PathLike))
+        if self._owns_file:
+            path = os.fspath(path)
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._f = open(path, "a", encoding="utf-8")
+            self.path: Optional[str] = path
+        else:
+            self._f = path
+            self.path = None
+        self.header_written = False
+
+    # ---------------- record writers ----------------------------------
+
+    def write(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        rec = {k: _jsonable(v) for k, v in rec.items()}
+        if self._validate:
+            validate_record(rec)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        return rec
+
+    def run_header(self, config: Optional[dict] = None,
+                   device: Optional[dict] = None,
+                   mesh: Optional[dict] = None, **extra) -> Dict[str, Any]:
+        """The one-per-run header: schema version + what produced the
+        numbers. Idempotent guard lives in `header_written` — callers
+        that may be second in line (fit() after the CLI) check it."""
+        rec = self.write({
+            "event": "run",
+            "schema_version": SCHEMA_VERSION,
+            "time_unix": time.time(),
+            "config": config or {},
+            "device": device or {},
+            "mesh": mesh or {},
+            **extra,
+        })
+        self.header_written = True
+        return rec
+
+    def epoch(self, epoch: int, step_time_s: float, loss: float,
+              grad_norm: float, halo_bytes: int, staleness_age: int,
+              memory: Optional[dict] = None, **extra) -> Dict[str, Any]:
+        return self.write({
+            "event": "epoch",
+            "epoch": int(epoch),
+            "step_time_s": float(step_time_s),
+            "loss": float(loss),
+            "grad_norm": float(grad_norm),
+            "halo_bytes": int(halo_bytes),
+            "staleness_age": int(staleness_age),
+            "memory": memory,
+            **extra,
+        })
+
+    def eval_record(self, epoch: int, eval_time_s: float, val_acc: float,
+                    **extra) -> Dict[str, Any]:
+        return self.write({
+            "event": "eval",
+            "epoch": int(epoch),
+            "eval_time_s": float(eval_time_s),
+            "val_acc": float(val_acc),
+            **extra,
+        })
+
+    def summary(self, n_epochs: int, epoch_time_s: Optional[float],
+                best_val: float, **extra) -> Dict[str, Any]:
+        return self.write({
+            "event": "summary",
+            "n_epochs": int(n_epochs),
+            "epoch_time_s": (None if epoch_time_s is None
+                             else float(epoch_time_s)),
+            "best_val": float(best_val),
+            **extra,
+        })
+
+    def event(self, event: str, **fields) -> Dict[str, Any]:
+        """Free-form record (e.g. bench headline, rank progress) — only
+        the ``event`` discriminator is contracted."""
+        return self.write({"event": event, **fields})
+
+    # ---------------- lifecycle ---------------------------------------
+
+    def close(self) -> None:
+        if self._owns_file and not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_metrics(path: Union[str, "os.PathLike"]) -> List[Dict[str, Any]]:
+    """Parse a metrics JSONL file; skips blank lines, raises on a
+    malformed one (a torn final line from a killed run is reported with
+    its line number rather than silently dropped)."""
+    out = []
+    with open(os.fspath(path), encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError as exc:
+                raise ValueError(f"{path}:{i}: malformed JSONL line "
+                                 f"({exc})") from exc
+    return out
+
+
+# ---------------- host probes (lazy jax) ------------------------------
+
+
+def device_info() -> Dict[str, Any]:
+    """Backend identity for the run header; {} when jax has no
+    initialized backend (pure-host tooling)."""
+    try:
+        import jax
+
+        d = jax.local_devices()[0]
+        return {
+            "platform": d.platform,
+            "device_kind": d.device_kind,
+            "n_devices": jax.device_count(),
+            "n_local_devices": jax.local_device_count(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+    except Exception:
+        return {}
+
+
+def mesh_info(mesh) -> Dict[str, Any]:
+    """Axis names/sizes of a jax.sharding.Mesh (header `mesh` field)."""
+    try:
+        return {
+            "axis_names": list(mesh.axis_names),
+            "shape": {str(k): int(v) for k, v in
+                      dict(mesh.shape).items()},
+            "n_devices": int(len(mesh.devices.flat)),
+        }
+    except Exception:
+        return {}
+
+
+def memory_snapshot() -> Dict[str, Any]:
+    """HBM watermarks of local device 0 (`memory_stats()`), with the
+    keys always present: platforms without allocator stats (CPU) report
+    nulls so epoch records keep a stable shape."""
+    stats = None
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return {"bytes_in_use": None, "peak_bytes_in_use": None,
+                "bytes_limit": None}
+    return {
+        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "peak_bytes_in_use": int(stats.get(
+            "peak_bytes_in_use", stats.get("bytes_in_use", 0))),
+        "bytes_limit": (int(stats["bytes_limit"])
+                        if "bytes_limit" in stats else None),
+    }

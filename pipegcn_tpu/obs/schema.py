@@ -1,0 +1,108 @@
+"""Versioned metrics schema.
+
+Every JSONL record carries an ``event`` discriminator; the required
+fields (and their JSON types) per event kind are listed below. Records
+may carry EXTRA fields freely — consumers must ignore unknown keys —
+but a required field may never be removed or retyped without bumping
+``SCHEMA_VERSION`` (tests/test_obs.py pins the v1 field list; the
+drift check fails any PR that breaks the contract silently).
+
+Type tags are JSON types: "string" | "integer" | "number" | "object"
+| "array" | "boolean". "integer" excludes booleans; "number" accepts
+ints and floats. A required field may be null only when its tag ends
+with "?" (e.g. the memory probe returns nulls off-accelerator).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+SCHEMA_VERSION = 1
+
+# one run header per file/run: what produced the numbers
+RUN_FIELDS: Dict[str, str] = {
+    "event": "string",           # "run"
+    "schema_version": "integer",
+    "time_unix": "number",
+    "config": "object",          # model/train/CLI config snapshot
+    "device": "object",          # platform / device_kind / counts
+    "mesh": "object",            # n_parts, axis names/shape
+}
+
+# one record per training epoch
+EPOCH_FIELDS: Dict[str, str] = {
+    "event": "string",           # "epoch"
+    "epoch": "integer",          # 0-based global epoch index
+    "step_time_s": "number",     # wall-clock of this epoch's dispatch
+    "loss": "number",            # global mean train loss
+    "grad_norm": "number",       # l2 norm of the reduced gradient
+    "halo_bytes": "integer",     # est. halo wire bytes this epoch
+    "staleness_age": "integer",  # age (epochs) of consumed boundary data
+    "memory": "object?",         # bytes_in_use / peak_bytes_in_use
+}
+
+# one record per harvested evaluation
+EVAL_FIELDS: Dict[str, str] = {
+    "event": "string",           # "eval"
+    "epoch": "integer",          # epoch the evaluated params belong to
+    "eval_time_s": "number",     # exposed harvest wait (async) / full
+    "val_acc": "number",
+}
+
+# one summary per completed run
+SUMMARY_FIELDS: Dict[str, str] = {
+    "event": "string",           # "summary"
+    "n_epochs": "integer",
+    "epoch_time_s": "number?",   # warmup-excluded mean (fit() semantics)
+    "best_val": "number",
+}
+
+_BY_EVENT = {
+    "run": RUN_FIELDS,
+    "epoch": EPOCH_FIELDS,
+    "eval": EVAL_FIELDS,
+    "summary": SUMMARY_FIELDS,
+}
+
+_JSON_TYPES = {
+    "string": str,
+    "integer": int,
+    "number": (int, float),
+    "object": dict,
+    "array": list,
+    "boolean": bool,
+}
+
+
+def validate_record(rec: Mapping) -> None:
+    """Raise ValueError when `rec` misses a required field of its event
+    kind or carries it with the wrong JSON type. Unknown event kinds
+    (free-form ``MetricsLogger.event`` records) and extra fields pass —
+    the schema constrains only the contracted record kinds."""
+    ev = rec.get("event")
+    fields = _BY_EVENT.get(ev)
+    if fields is None:
+        if not isinstance(ev, str) or not ev:
+            raise ValueError(f"record without a string 'event': {rec!r}")
+        return
+    for name, tag in fields.items():
+        nullable = tag.endswith("?")
+        if nullable:
+            tag = tag[:-1]
+        if name not in rec:
+            raise ValueError(f"{ev} record missing field {name!r}")
+        v = rec[name]
+        if v is None:
+            if nullable:
+                continue
+            raise ValueError(f"{ev} record field {name!r} is null")
+        py = _JSON_TYPES[tag]
+        # bool is an int subclass in python; exclude it from the
+        # numeric tags so a True never masquerades as a count
+        if isinstance(v, bool) and tag in ("integer", "number"):
+            raise ValueError(
+                f"{ev} record field {name!r}: expected {tag}, got bool")
+        if not isinstance(v, py):
+            raise ValueError(
+                f"{ev} record field {name!r}: expected {tag}, "
+                f"got {type(v).__name__}")

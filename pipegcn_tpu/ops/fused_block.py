@@ -151,7 +151,9 @@ def fused_union_apply(a_bits_t: jax.Array, a_idx: jax.Array,
             pltpu.SemaphoreType.DMA((2, G)),
         ],
     )
-    sds = (jax.ShapeDtypeStruct((R, G, T, fp), jnp.float32, vma=vma)
+    from ..compat import shape_dtype_struct
+
+    sds = (shape_dtype_struct((R, G, T, fp), jnp.float32, vma=vma)
            if vma is not None
            else jax.ShapeDtypeStruct((R, G, T, fp), jnp.float32))
     out = pl.pallas_call(

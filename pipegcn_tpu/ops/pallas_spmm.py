@@ -110,8 +110,10 @@ def _spmm_pallas_call(fbuf, edge_src_padded, starts, ends, in_deg_padded,
     out_shape = (n_blocks * ROW_BLOCK, n_feat)
     if vma is not None:
         # inside shard_map with check_vma the output's varying mesh axes
-        # must be declared explicitly
-        out_sds = jax.ShapeDtypeStruct(out_shape, jnp.float32, vma=vma)
+        # must be declared explicitly (older jax: compat drops the kwarg)
+        from ..compat import shape_dtype_struct
+
+        out_sds = shape_dtype_struct(out_shape, jnp.float32, vma=vma)
     else:
         out_sds = jax.ShapeDtypeStruct(out_shape, jnp.float32)
     out = pl.pallas_call(

@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec
 
 from ..graph.csr import Graph
 from ..models.sage import forward
+from ..obs.trace import named_phase
 from .halo import halo_exchange
 from .mesh import PARTS_AXIS
 
@@ -106,12 +107,14 @@ class ShardedEvaluator:
                 d, n_max=n_max, n_src_rows=n_max + sg.halo_size,
                 transport=False,
             ) if use_tables else None
-            logits, _ = forward(
-                params, self._cfg, d["feat"], d["edge_src"],
-                d["edge_dst"], d["in_deg"], n_max,
-                training=False, halo_eval=True, comm_update=comm_update,
-                norm_state=norm, spmm_fn=spmm, gat_fn=gat,
-            )
+            with named_phase("eval"):
+                logits, _ = forward(
+                    params, self._cfg, d["feat"], d["edge_src"],
+                    d["edge_dst"], d["in_deg"], n_max,
+                    training=False, halo_eval=True,
+                    comm_update=comm_update,
+                    norm_state=norm, spmm_fn=spmm, gat_fn=gat,
+                )
             if multilabel:
                 pred = logits > 0
                 lab = label > 0.5
@@ -128,7 +131,8 @@ class ShardedEvaluator:
                 total = jnp.sum(mask, dtype=jnp.int32)
                 counts = jnp.stack([correct, total,
                                     jnp.zeros((), jnp.int32)])
-            return jax.lax.psum(counts, PARTS_AXIS)
+            with named_phase("eval_metric_reduce"):
+                return jax.lax.psum(counts, PARTS_AXIS)
 
         spec = PartitionSpec(PARTS_AXIS)
         repl = PartitionSpec()

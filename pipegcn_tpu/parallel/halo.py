@@ -89,20 +89,26 @@ def exchange_blocks(
 
     h: [N, F] inner rows; send_idx/mask: [P-1, B]. Returns the halo block
     [(P-1)*B, F]: distance-d rows hold features owned by (r-d) mod P.
+
+    The whole gather->permute->concat runs under the "halo_exchange"
+    named scope so --profile-dir traces attribute the ring collectives
+    (and their backward scatters) to the phase, not anonymous fusions.
     """
-    blocks = []
-    for d in range(1, num_parts):
-        blk = jnp.take(h, send_idx[d - 1], axis=0)
-        blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
-        blocks.append(_ring_permute(blk, axis_name, _fwd_perm(num_parts, d)))
-    if not blocks:
-        # P=1: no halo, but the empty result must still be marked
-        # device-varying so it types consistently as carry state (e.g.
-        # in the fused-epoch scan)
-        return _ensure_varying(
-            jnp.zeros((0, h.shape[-1]), h.dtype), axis_name
-        )
-    return jnp.concatenate(blocks, axis=0)
+    with jax.named_scope("halo_exchange"):
+        blocks = []
+        for d in range(1, num_parts):
+            blk = jnp.take(h, send_idx[d - 1], axis=0)
+            blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
+            blocks.append(
+                _ring_permute(blk, axis_name, _fwd_perm(num_parts, d)))
+        if not blocks:
+            # P=1: no halo, but the empty result must still be marked
+            # device-varying so it types consistently as carry state
+            # (e.g. in the fused-epoch scan)
+            return _ensure_varying(
+                jnp.zeros((0, h.shape[-1]), h.dtype), axis_name
+            )
+        return jnp.concatenate(blocks, axis=0)
 
 
 def halo_exchange(
@@ -135,16 +141,18 @@ def return_blocks(
     from owner (r-d); after the reverse permute, the device holds — in the
     same [(P-1)*B, F] layout — the gradients its peers computed for the
     rows listed in its own send_idx (block d-1 <- peer (r+d))."""
-    outs = []
-    for d in range(1, num_parts):
-        blk = jax.lax.dynamic_slice_in_dim(
-            halo_grad, (d - 1) * b_max, b_max, axis=0
-        )
-        outs.append(_ring_permute(blk, axis_name, _bwd_perm(num_parts, d)))
-    if not outs:
-        # P=1 empty case: keep the varying type (see exchange_blocks)
-        return _ensure_varying(jnp.zeros_like(halo_grad), axis_name)
-    return jnp.concatenate(outs, axis=0)
+    with jax.named_scope("bgrad_return"):
+        outs = []
+        for d in range(1, num_parts):
+            blk = jax.lax.dynamic_slice_in_dim(
+                halo_grad, (d - 1) * b_max, b_max, axis=0
+            )
+            outs.append(
+                _ring_permute(blk, axis_name, _bwd_perm(num_parts, d)))
+        if not outs:
+            # P=1 empty case: keep the varying type (see exchange_blocks)
+            return _ensure_varying(jnp.zeros_like(halo_grad), axis_name)
+        return jnp.concatenate(outs, axis=0)
 
 
 def make_stale_concat(send_idx: jax.Array, send_mask: jax.Array, n_dst: int):

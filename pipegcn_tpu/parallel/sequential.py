@@ -34,6 +34,7 @@ shard_map Trainer on a multi-device CPU mesh.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Dict, Optional
 
 import jax
@@ -41,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.sage import ModelConfig, forward, init_norm_state, init_params
+from ..obs.metrics import memory_snapshot
 from ..train.losses import bce_logits_sum, cross_entropy_sum
 from ..train.optim import adam_init, adam_update
 from .halo import make_stale_concat
@@ -140,7 +142,8 @@ class SequentialRunner:
                  table_cache: Optional[Dict[int, dict]] = None,
                  compact_halo: bool = False,
                  keep_carry: bool = True,
-                 log: Callable[[str], None] = lambda s: None):
+                 log: Callable[[str], None] = lambda s: None,
+                 metrics=None):
         if not tcfg.enable_pipeline:
             raise ValueError("SequentialRunner implements the pipelined "
                              "(staleness-1) step; vanilla mode has "
@@ -170,6 +173,11 @@ class SequentialRunner:
         # dict (can be lru-like) only when the graph is small enough
         self._table_cache = table_cache
         self._log = log
+        # optional obs.MetricsLogger: run_epoch appends one epoch
+        # record per completed epoch (same schema the mesh trainer
+        # emits, obs/schema.py), so full-scale sequential validation
+        # runs feed the same report CLI
+        self._metrics = metrics
 
         self._glayers = [str(i) for i in range(cfg.n_graph_layers)]
         self._widths = {k: cfg.layer_sizes[int(k)] for k in self._glayers}
@@ -213,6 +221,13 @@ class SequentialRunner:
             lambda r: np.asarray(sg.edge_dst[r][:int(sg.edge_count[r])]),
             self.P, self.n_max, n_src_rows)
         self._n_src_rows = n_src_rows
+        # telemetry: host-routed halo traffic per epoch (forward rows +
+        # returned cotangents for every rank) — the sequential analogue
+        # of Trainer.est_halo_bytes_per_epoch
+        item = jnp.dtype(self.cfg.compute_dtype).itemsize
+        self._halo_bytes = 0 if self.P == 1 else int(sum(
+            2 * self.P * self.H * w * item
+            for w in self._widths.values()))
 
         rng = jax.random.PRNGKey(tcfg.seed)
         self.params = init_params(rng, self.cfg)
@@ -385,6 +400,7 @@ class SequentialRunner:
         import os
         import pickle
 
+        t_start = time.perf_counter()
         tcfg, P, H = self.tcfg, self.P, self.H
         cdt = self.cfg.compute_dtype
         if state_path is not None and self.keep_carry:
@@ -478,4 +494,20 @@ class SequentialRunner:
                             m * c["bavg"][k]
                             + (1 - m) * bgrad_next.astype(np.float32))
         self.last_epoch = epoch + 1
-        return loss_sum / self.n_train
+        mean_loss = loss_sum / self.n_train
+        if self._metrics is not None:
+            # same record shape as the mesh trainer's (obs/schema.py);
+            # grad norm over the reduced (psum'd / n_train) gradient
+            gnorm = float(np.sqrt(sum(
+                float(np.sum(np.square(np.asarray(g, np.float64))))
+                for g in jax.tree_util.tree_leaves(pgrads))))
+            self._metrics.epoch(
+                epoch=epoch,
+                step_time_s=time.perf_counter() - t_start,
+                loss=float(mean_loss),
+                grad_norm=gnorm,
+                halo_bytes=self._halo_bytes,
+                staleness_age=int(1 if epoch > 0 else 0),
+                memory=memory_snapshot(),
+            )
+        return mean_loss

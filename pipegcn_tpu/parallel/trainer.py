@@ -39,6 +39,9 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..graph.csr import Graph
 from ..models.sage import ModelConfig, forward, init_norm_state, init_params
+from ..obs.format import epoch_line, reference_eval_line, reference_train_line
+from ..obs.metrics import device_info, memory_snapshot, mesh_info
+from ..obs.trace import PhaseTimer, named_phase
 from ..ops.spmm import spmm_mean
 from ..partition.halo import ShardedGraph
 from ..train.losses import bce_logits_sum, cross_entropy_sum
@@ -181,11 +184,12 @@ class Trainer:
 
         @partial(jax.jit, static_argnames=("n",))
         def _eval_run(params, norm, feat, es, ed, deg, n):
-            logits, _ = forward(
-                params, self._eval_cfg, feat, es, ed, deg, n,
-                training=False, norm_state=norm,
-                eval_pp_agg=self._eval_cfg.use_pp,
-            )
+            with named_phase("eval"):
+                logits, _ = forward(
+                    params, self._eval_cfg, feat, es, ed, deg, n,
+                    training=False, norm_state=norm,
+                    eval_pp_agg=self._eval_cfg.use_pp,
+                )
             return logits
 
         self._eval_run = _eval_run
@@ -748,12 +752,20 @@ class Trainer:
 
             # gradient reduction: psum of sum-loss grads / global n_train
             # (reference reducer.py:24-31 semantics, minus the threads)
-            pgrads = jax.tree_util.tree_map(lambda g: psum(g) / n_train,
-                                            pgrads)
-            new_params, new_opt = adam_update(
-                pgrads, opt, params, lr=tcfg.lr,
-                weight_decay=tcfg.weight_decay,
-            )
+            with named_phase("grad_reduce"):
+                pgrads = jax.tree_util.tree_map(
+                    lambda g: psum(g) / n_train, pgrads)
+            # global l2 norm of the reduced gradient (telemetry; the
+            # grads are replicated post-psum, so this is the true
+            # distributed gradient's norm, not a per-device slice's)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(pgrads)))
+            with named_phase("adam_update"):
+                new_params, new_opt = adam_update(
+                    pgrads, opt, params, lr=tcfg.lr,
+                    weight_decay=tcfg.weight_decay,
+                )
 
             new_comm = {}
             if pipeline:
@@ -789,7 +801,7 @@ class Trainer:
                 "norm": new_norm,
                 "comm": new_comm,
             }
-            return new_state, loss_out
+            return new_state, {"loss": loss_out, "grad_norm": gnorm}
 
         if self.emulated:
             # vmap(axis_name) in place of shard_map: identical step
@@ -803,16 +815,17 @@ class Trainer:
                 st = dict(state)
                 st["comm"] = tm(lambda v: v[None], state["comm"])
                 d1 = tm(lambda v: v[None], data)
-                ns, loss = step(st, d1, rng)
+                ns, m = step(st, d1, rng)
                 ns["comm"] = tm(lambda v: v[0], ns["comm"])
-                return ns, loss
+                return ns, m
 
             vm = jax.vmap(vstep, in_axes=(0, 0, None), out_axes=0,
                           axis_name=PARTS_AXIS)
 
             def emu(state, data, rng):
-                ns, loss = vm(state, data, rng)
-                return ns, loss[0]  # psum'd: identical across parts
+                ns, m = vm(state, data, rng)
+                # psum'd: identical across parts
+                return ns, {k: v[0] for k, v in m.items()}
 
             def emu_multi(state, data, rngs):
                 def body(st, rng):
@@ -848,11 +861,13 @@ class Trainer:
                         and "blk_a_bits_t" in self._block_tables
                         and jax.default_backend() == "cpu")
         check_vma = not ((use_pallas and pallas_interp) or fused_interp)
+        # both step metrics are replicated scalars (post-psum)
+        metric_spec = {"loss": PartitionSpec(), "grad_norm": PartitionSpec()}
         smapped = jax.shard_map(
             step,
             mesh=self.mesh,
             in_specs=(state_spec, data_spec, PartitionSpec()),
-            out_specs=(state_spec, PartitionSpec()),
+            out_specs=(state_spec, metric_spec),
             check_vma=check_vma,
         )
 
@@ -869,7 +884,7 @@ class Trainer:
             multi,
             mesh=self.mesh,
             in_specs=(state_spec, data_spec, PartitionSpec()),
-            out_specs=(state_spec, PartitionSpec()),
+            out_specs=(state_spec, metric_spec),
             check_vma=check_vma,
         )
         self._multi_step = jax.jit(smapped_multi, donate_argnums=(0,))
@@ -888,7 +903,11 @@ class Trainer:
 
     def train_epoch(self, epoch: int) -> float:
         rng = jax.random.fold_in(self._epoch_rng_base(), epoch)
-        self.state, loss = self._step(self.state, self.data, rng)
+        self.state, m = self._step(self.state, self.data, rng)
+        # per-step telemetry (loss + grad norm, scalars) for fit()'s
+        # metrics sink; train_epochs stores the [k]-array equivalents
+        self._last_metrics = m
+        loss = m["loss"]
         # last_epoch labels the buffers self.state now references (the
         # previous state was DONATED into the dispatch, so there is no
         # older state to fall back to). If the dispatch failed, these
@@ -910,9 +929,10 @@ class Trainer:
         rngs = jax.vmap(lambda e: jax.random.fold_in(base, e))(
             jnp.arange(start_epoch, start_epoch + k)
         )
-        self.state, losses = self._multi_step(self.state, self.data, rngs)
+        self.state, ms = self._multi_step(self.state, self.data, rngs)
+        self._last_metrics = ms  # [k] arrays; see train_epoch
         self.last_epoch = start_epoch + k  # see train_epoch
-        return np.asarray(losses)
+        return np.asarray(ms["loss"])
 
     def fit(
         self,
@@ -929,6 +949,7 @@ class Trainer:
         measure_comm_cost: bool = False,
         sharded_eval: bool = False,
         async_eval: bool = True,
+        metrics=None,
     ) -> Dict[str, Any]:
         """The single epoch loop (reference train.py:327-400): periodic
         evaluation, best-val/BN-stats tracking, timing with <5-epoch
@@ -951,11 +972,28 @@ class Trainer:
 
         `sharded_eval=True` evaluates through the training mesh
         (parallel/evaluator.py) instead of one device — required when
-        the full eval graph exceeds a single device's memory."""
+        the full eval graph exceeds a single device's memory.
+
+        `metrics` (an obs.MetricsLogger or None) appends structured
+        JSONL telemetry: a run header (written here only if the caller
+        has not already written a richer one), one record per epoch
+        (step time, loss, grad norm, halo bytes, staleness age, HBM
+        watermarks), one record per harvested evaluation, and a final
+        run summary — the schema in obs/schema.py and
+        docs/OBSERVABILITY.md. The sink never changes the log_fn
+        stream: --reference-logs output stays byte-identical."""
         from ..utils.checkpoint import save_checkpoint
-        from ..utils.timer import CommTimer
 
         tcfg = self.tcfg
+        if metrics is not None and not metrics.header_written:
+            # direct-API callers (tests, bench) get a header derived
+            # from the trainer's own config; the CLI writes its richer
+            # args-level header before calling fit()
+            metrics.run_header(
+                config={"model": dataclasses.asdict(self.cfg),
+                        "train": dataclasses.asdict(self.tcfg)},
+                device=device_info(), mesh=mesh_info(self.mesh))
+        halo_bytes = self.est_halo_bytes_per_epoch()
         best_val, best_params, best_norm, best_epoch = 0.0, None, None, -1
         durs = []
         eval_durs = []
@@ -984,30 +1022,32 @@ class Trainer:
 
         def _harvest_eval(p):
             nonlocal best_val, best_params, best_norm, best_epoch
-            # plain perf_counter: CommTimer keys are once-per-epoch and a
-            # boundary can harvest AND run a sync eval in one iteration
+            # plain perf_counter: an epoch boundary can harvest AND run
+            # a sync eval in one iteration, so no phase key fits
             t0 = time.perf_counter()
             acc = self.eval_finish(p["handles"]["val"])
-            eval_durs.append(time.perf_counter() - t0)
+            eval_wait = time.perf_counter() - t0
+            eval_durs.append(eval_wait)
             e = p["epoch"]
+            eval_extra = {}
             if reference_logs:
                 if inductive:
-                    # reference evaluate_induc format (:33-39)
-                    buf = "Epoch {:05d} | Accuracy {:.2%}".format(e, acc)
+                    buf = reference_eval_line(e, acc)
                 else:
-                    # reference evaluate_trans format (:54-60)
                     t_acc = self.eval_finish(p["handles"]["test"])
-                    buf = ("Epoch {:05d} | Validation Accuracy "
-                           "{:.2%} | Test Accuracy {:.2%}".format(
-                               e, acc, t_acc))
+                    buf = reference_eval_line(e, acc, t_acc)
+                    eval_extra["test_acc"] = float(t_acc)
                 if result_file:
                     with open(result_file, "a+") as f:
                         f.write(buf + "\n")
                 log_fn(buf)
             else:
-                log_fn(f"Epoch {e + 1:05d} | Time(s) "
-                       f"{np.mean(durs or [p['dur']]):.4f} | Loss "
-                       f"{p['loss']:.4f} | Val {acc:.4f}")
+                log_fn(epoch_line(e + 1,
+                                  float(np.mean(durs or [p["dur"]])),
+                                  p["loss"], acc))
+            if metrics is not None:
+                metrics.eval_record(e, eval_wait, float(acc),
+                                    **eval_extra)
             history.append((e + 1, p["loss"], acc))
             if acc > best_val:
                 best_val = acc
@@ -1019,7 +1059,7 @@ class Trainer:
                 best_norm = jax.device_get(p["snap_n"])
         comm_cost = {"comm": 0.0, "reduce": 0.0}
         comm_measured = False
-        timer = CommTimer()
+        timer = PhaseTimer()
         profiling = False
         n_epochs = tcfg.n_epochs
 
@@ -1050,11 +1090,16 @@ class Trainer:
                 if profiling or (profile_dir and epoch < start_epoch + 10):
                     chunk = 1  # epoch-granular around the profiled window
                 timer.clear()
-                with timer.timer("step"):
+                # annotate=True: the host span shows up in --profile-dir
+                # traces next to the named device phases
+                with timer.phase("step", annotate=True):
                     if chunk == 1:
                         loss = self.train_epoch(epoch)
+                        blk_losses = np.asarray([loss])
                     else:
-                        loss = float(self.train_epochs(epoch, chunk)[-1])
+                        blk_losses = np.asarray(
+                            self.train_epochs(epoch, chunk))
+                        loss = float(blk_losses[-1])
                     jax.block_until_ready(self.state["params"])
                 dur = timer.durations()["step"] / chunk
                 if profiling and epoch >= start_epoch + 8:
@@ -1076,6 +1121,30 @@ class Trainer:
                         and not eval_in_stream:
                     durs.extend([dur] * chunk)
                 eval_in_stream = False
+                if metrics is not None:
+                    # one record per epoch in the block; grad norms ride
+                    # the step output ([k] arrays for fused blocks), the
+                    # HBM watermark is sampled once per dispatch
+                    gn = np.atleast_1d(np.asarray(
+                        self._last_metrics["grad_norm"], np.float64))
+                    mem = memory_snapshot()
+                    for j in range(chunk):
+                        e_j = epoch + j
+                        metrics.epoch(
+                            epoch=e_j,
+                            step_time_s=dur,
+                            loss=float(blk_losses[j]),
+                            grad_norm=float(gn[j] if gn.size > 1
+                                            else gn[0]),
+                            halo_bytes=halo_bytes,
+                            # pipelined mode consumes epoch e-1's
+                            # boundary data (zeros at the very first
+                            # epoch); vanilla exchanges synchronously
+                            staleness_age=int(
+                                1 if tcfg.enable_pipeline and e_j > 0
+                                else 0),
+                            memory=mem,
+                        )
                 epoch += chunk - 1  # body below sees the block's last epoch
                 if measure_comm_cost and not comm_measured and \
                         epoch >= min(start_epoch + 5, n_epochs - 1):
@@ -1100,13 +1169,13 @@ class Trainer:
                                "ring (both modes move both)")
 
                 if reference_logs and (epoch + 1) % 10 == 0:
-                    # reference log line format (train.py:369-371); rank is
+                    # reference log line format (train.py:369-371,
+                    # pinned byte-exact in obs/format.py); rank is
                     # always 0 in SPMD (one controller)
-                    log_fn("Process {:03d} | Epoch {:05d} | Time(s) {:.4f} | "
-                           "Comm(s) {:.4f} | Reduce(s) {:.4f} | Loss {:.4f}"
-                           .format(0, epoch, float(np.mean(durs or [dur])),
-                                   comm_cost["comm"] + comm_cost["bgrad"],
-                                   comm_cost["reduce"], loss))
+                    log_fn(reference_train_line(
+                        0, epoch, float(np.mean(durs or [dur])),
+                        comm_cost["comm"] + comm_cost["bgrad"],
+                        comm_cost["reduce"], loss))
 
                 if (epoch + 1) % tcfg.log_every == 0:
                     do_eval = tcfg.eval and eval_graphs and "val" in eval_graphs
@@ -1123,9 +1192,9 @@ class Trainer:
                     else:
                         history.append((epoch + 1, loss, None))
                         if not reference_logs:
-                            log_fn(f"Epoch {epoch + 1:05d} | Time(s) "
-                                   f"{np.mean(durs or [dur]):.4f} | Loss "
-                                   f"{loss:.4f}")
+                            log_fn(epoch_line(
+                                epoch + 1,
+                                float(np.mean(durs or [dur])), loss))
 
                 if checkpoint_dir and (epoch + 1) % checkpoint_every == 0 \
                         and jax.process_index() == 0:
@@ -1202,6 +1271,32 @@ class Trainer:
             result["test_acc"] = self.evaluate(g, mask, params=best_params,
                                                norm=best_norm,
                                                sharded=sharded_eval)
+        if metrics is not None:
+            summ: Dict[str, Any] = {
+                "n_epochs": n_epochs - start_epoch,
+                "epoch_time_s": result["epoch_time"],
+                "best_val": float(best_val),
+                "best_epoch": int(best_epoch),
+                "eval_time_s": result["eval_time"],
+                "comm_cost": comm_cost if comm_measured else None,
+            }
+            if "test_acc" in result:
+                summ["test_acc"] = float(result["test_acc"])
+            if 1 in seen_chunks:
+                # XLA's own per-epoch FLOP count (whole-job scale) so
+                # the report CLI can derive MFU. Only when the run
+                # already compiled the single-epoch program — cost
+                # analysis on a fused-only run would pay a whole extra
+                # compile for a telemetry extra. Best-effort: some
+                # backends expose no analysis.
+                try:
+                    ca = self.step_cost_analysis()
+                    if ca.get("flops"):
+                        summ["flops_per_epoch"] = \
+                            float(ca["flops"]) * self.P
+                except Exception:
+                    pass
+            metrics.summary(**summ)
         return result
 
     # ---------------- cost analysis -----------------------------------
@@ -1221,11 +1316,12 @@ class Trainer:
         return {k: float(v) for k, v in ca.items()
                 if isinstance(v, (int, float))}
 
-    def est_ici_bytes_per_epoch(self) -> int:
-        """Estimated inter-device traffic per epoch: per exchanged graph
-        layer, every device ships its halo block forward and the boundary
-        gradients back (2x); plus the ring all-reduce of the grads
-        (~2x param bytes per device)."""
+    def est_halo_bytes_per_epoch(self) -> int:
+        """Estimated halo wire bytes per epoch: per exchanged graph
+        layer, every device ships its halo block forward and the
+        boundary gradients back (2x). This is the metrics records'
+        `halo_bytes` field; est_ici_bytes_per_epoch adds the gradient
+        all-reduce on top."""
         if self.P == 1:
             return 0
         item = 4 if self.cfg.compute_dtype == jnp.float32 else 2
@@ -1233,12 +1329,19 @@ class Trainer:
         for i in self._graph_layer_range():
             total += 2 * self.P * self.sg.halo_size * self._layer_width(i) \
                 * item
+        return int(total)
+
+    def est_ici_bytes_per_epoch(self) -> int:
+        """Estimated inter-device traffic per epoch: the per-layer halo
+        exchange (est_halo_bytes_per_epoch) plus the ring all-reduce of
+        the grads (~2x param bytes per device)."""
+        if self.P == 1:
+            return 0
         n_params = sum(
             int(np.prod(p.shape))
             for p in jax.tree_util.tree_leaves(self.state["params"])
         )
-        total += 2 * self.P * n_params * 4
-        return int(total)
+        return self.est_halo_bytes_per_epoch() + int(2 * self.P * n_params * 4)
 
     # ---------------- comm cost measurement ---------------------------
 

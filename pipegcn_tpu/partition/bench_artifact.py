@@ -52,8 +52,13 @@ def _publish(sg, path: str, log) -> None:
     Race-tolerant: builds land in a per-pid temp sibling; whoever
     renames first wins, losers discard their copy. A stale
     manifest-less dir at ``path`` (a save killed mid-write before this
-    scheme existed) is replaced, re-checking validity right before the
-    rmtree so a concurrent winner's fresh artifact is never deleted.
+    scheme existed) is renamed aside into a per-pid trash sibling and
+    deleted THERE — readers never observe a half-deleted dir at
+    ``path``, and the validity check happens immediately before the
+    single atomic rename, so the window in which a concurrent winner's
+    fresh artifact could be displaced is one rename wide (not the
+    length of an rmtree). Even then both builds are deterministic
+    copies of the same artifact, so the re-publish is identical.
     """
     from . import ShardedGraph
 
@@ -66,10 +71,19 @@ def _publish(sg, path: str, log) -> None:
         return
     except OSError:
         pass
+    # re-check RIGHT before displacing anything: a concurrent winner
+    # may have renamed a valid artifact into place since our failed
+    # rename above
     if not ShardedGraph.exists(path) and os.path.isdir(path):
         log(f"# replacing stale non-artifact dir at {path}")
+        trash = f"{path}.trash-{os.getpid()}"
         try:
-            shutil.rmtree(path)
+            os.rename(path, trash)  # aside, never rmtree in place
+        except OSError:
+            pass  # a concurrent builder displaced it first
+        else:
+            shutil.rmtree(trash, ignore_errors=True)
+        try:
             os.rename(tmp, path)
             return
         except OSError:

@@ -1,40 +1,35 @@
-"""Timing utilities.
+"""Timing utilities — the reference-parity shim over PhaseTimer.
 
 CommTimer mirrors the reference's helper/timer/comm_timer.py API (spans
 keyed 'forward_{layer}'/'backward_{layer}', duplicate keys raise,
-`tot_time()` summed per epoch, `clear()` between epochs) so tooling built
-against the reference's log discipline keeps working. In the SPMD design
-the per-layer comm is inside one jitted step, so these spans wrap
-host-blocking regions (step dispatch, eval) rather than gloo waits; the
-per-collective breakdown comes from `Trainer.measure_comm()` (standalone
-timing of the exchange/reduce collectives) and `jax.profiler` traces
-(--profile-dir).
+`tot_time()` summed per epoch, `clear()` between epochs) so tooling
+built against the reference's log discipline keeps working. It is a
+thin shim over `pipegcn_tpu.obs.trace.PhaseTimer`, which generalizes
+it: exception-safe recording (a span that raises still lands its
+duration — the original lost it), re-entrant keys that accumulate, and
+free nesting. In the SPMD design the per-layer comm is inside one
+jitted step, so these spans wrap host-blocking regions (step dispatch,
+eval) rather than gloo waits; the per-collective breakdown comes from
+`Trainer.measure_comm()` (standalone timing of the exchange/reduce
+collectives) and `jax.profiler` traces (--profile-dir).
 """
 
 from __future__ import annotations
 
-import time
 from contextlib import contextmanager
-from typing import Dict
+
+from ..obs.trace import PhaseTimer
+
+__all__ = ["CommTimer", "PhaseTimer"]
 
 
-class CommTimer:
-    def __init__(self):
-        self._durs: Dict[str, float] = {}
-
+class CommTimer(PhaseTimer):
     @contextmanager
     def timer(self, key: str):
+        # reference comm_timer.py:14-15 semantics: one span per key per
+        # epoch; PhaseTimer.phase records in a finally, so an exception
+        # inside the span still lands the duration before propagating
         if key in self._durs:
             raise RuntimeError(f"duplicate timer key: {key}")
-        t0 = time.perf_counter()
-        yield
-        self._durs[key] = time.perf_counter() - t0
-
-    def tot_time(self) -> float:
-        return sum(self._durs.values())
-
-    def durations(self) -> Dict[str, float]:
-        return dict(self._durs)
-
-    def clear(self) -> None:
-        self._durs.clear()
+        with self.phase(key):
+            yield

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Glue for the metrics report CLI (`python scripts/report.py run.jsonl`),
+equivalent to `python -m pipegcn_tpu.cli.report` — kept so the scripts/
+directory exposes the whole tooling surface (README quick start).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pipegcn_tpu.cli.report import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,364 @@
+"""Telemetry subsystem tests: schema round-trip + drift guard, the
+JSONL sink, PhaseTimer/CommTimer semantics, byte-exact reference log
+formats, the CLI --metrics-out end-to-end path, and the report CLI."""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.cli.main import result_file_name, run
+from pipegcn_tpu.cli.parser import create_parser
+from pipegcn_tpu.cli.report import main as report_main
+from pipegcn_tpu.cli.report import summarize_run
+from pipegcn_tpu.obs import (
+    MetricsLogger,
+    PhaseTimer,
+    read_metrics,
+    validate_record,
+)
+from pipegcn_tpu.obs import schema as obs_schema
+from pipegcn_tpu.obs.format import (
+    epoch_line,
+    reference_eval_line,
+    reference_train_line,
+)
+from pipegcn_tpu.utils.timer import CommTimer
+
+# ---------------- schema -------------------------------------------------
+
+# FROZEN copy of the v1 contract. If any assert below fires, a field
+# was removed or retyped without bumping SCHEMA_VERSION — consumers
+# (bench trajectory, report CLI, scripts) would break silently.
+_V1_FIELDS = {
+    "run": {
+        "event": "string", "schema_version": "integer",
+        "time_unix": "number", "config": "object", "device": "object",
+        "mesh": "object",
+    },
+    "epoch": {
+        "event": "string", "epoch": "integer", "step_time_s": "number",
+        "loss": "number", "grad_norm": "number", "halo_bytes": "integer",
+        "staleness_age": "integer", "memory": "object?",
+    },
+    "eval": {
+        "event": "string", "epoch": "integer", "eval_time_s": "number",
+        "val_acc": "number",
+    },
+    "summary": {
+        "event": "string", "n_epochs": "integer",
+        "epoch_time_s": "number?", "best_val": "number",
+    },
+}
+
+
+def test_schema_v1_drift_guard():
+    current = {"run": obs_schema.RUN_FIELDS,
+               "epoch": obs_schema.EPOCH_FIELDS,
+               "eval": obs_schema.EVAL_FIELDS,
+               "summary": obs_schema.SUMMARY_FIELDS}
+    if obs_schema.SCHEMA_VERSION == 1:
+        for kind, fields in _V1_FIELDS.items():
+            for name, tag in fields.items():
+                assert current[kind].get(name) == tag, (
+                    f"schema field {kind}.{name} removed or retyped "
+                    f"without bumping SCHEMA_VERSION")
+    else:
+        # a bump legitimizes any field change; the contract is that the
+        # version moved WITH the change
+        assert obs_schema.SCHEMA_VERSION > 1
+
+
+def test_validate_record():
+    validate_record({"event": "epoch", "epoch": 0, "step_time_s": 0.1,
+                     "loss": 1.0, "grad_norm": 0.5, "halo_bytes": 128,
+                     "staleness_age": 1, "memory": None})
+    with pytest.raises(ValueError, match="missing field"):
+        validate_record({"event": "epoch", "epoch": 0})
+    with pytest.raises(ValueError, match="expected integer"):
+        validate_record({"event": "epoch", "epoch": 0.5,
+                         "step_time_s": 0.1, "loss": 1.0,
+                         "grad_norm": 0.5, "halo_bytes": 128,
+                         "staleness_age": 1, "memory": None})
+    # bool must not pass as an integer count
+    with pytest.raises(ValueError, match="bool"):
+        validate_record({"event": "epoch", "epoch": True,
+                         "step_time_s": 0.1, "loss": 1.0,
+                         "grad_norm": 0.5, "halo_bytes": 128,
+                         "staleness_age": 1, "memory": None})
+    # unknown event kinds are free-form
+    validate_record({"event": "bench", "whatever": [1, 2]})
+
+
+# ---------------- sink ---------------------------------------------------
+
+def test_metrics_logger_roundtrip(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.run_header(config={"lr": 0.01}, device={"platform": "cpu"},
+                      mesh={"n_parts": 4})
+        assert ml.header_written
+        # numpy scalars/arrays must serialize transparently
+        ml.epoch(epoch=np.int64(0), step_time_s=np.float32(0.25),
+                 loss=np.float32(1.5), grad_norm=np.float64(0.1),
+                 halo_bytes=np.int64(4096), staleness_age=0,
+                 memory={"bytes_in_use": None,
+                         "peak_bytes_in_use": None})
+        ml.eval_record(9, 0.01, 0.9, test_acc=0.88)
+        ml.summary(n_epochs=10, epoch_time_s=0.25, best_val=0.9,
+                   comm_cost={"comm": 0.1, "reduce": 0.2})
+    recs = read_metrics(p)
+    assert [r["event"] for r in recs] == ["run", "epoch", "eval",
+                                          "summary"]
+    for r in recs:
+        validate_record(r)  # the file round-trips through the schema
+    assert recs[0]["schema_version"] == obs_schema.SCHEMA_VERSION
+    assert recs[1]["loss"] == pytest.approx(1.5)
+    assert isinstance(recs[1]["halo_bytes"], int)
+    assert recs[2]["test_acc"] == pytest.approx(0.88)
+
+    # validation rejects a bad record at write time
+    with MetricsLogger(tmp_path / "bad.jsonl") as ml:
+        with pytest.raises(ValueError):
+            ml.write({"event": "epoch", "epoch": 1})
+
+    # a torn final line is reported, not silently dropped
+    with open(p, "a") as f:
+        f.write('{"event": "epo')
+    with pytest.raises(ValueError, match="malformed"):
+        read_metrics(p)
+
+
+# ---------------- timers --------------------------------------------------
+
+def test_phase_timer_exception_safety_and_accumulation():
+    pt = PhaseTimer()
+    with pytest.raises(KeyError):
+        with pt.phase("outer"):
+            with pt.phase("inner"):  # nesting is free
+                pass
+            raise KeyError("boom")
+    # the raising span still recorded its duration
+    assert pt.durations()["outer"] >= pt.durations()["inner"] >= 0.0
+    # repeated keys accumulate instead of raising
+    with pt.phase("inner"):
+        pass
+    assert pt.counts()["inner"] == 2
+    pt.clear()
+    assert pt.tot_time() == 0.0 and pt.counts() == {}
+
+
+def test_comm_timer_records_on_exception():
+    t = CommTimer()
+    with pytest.raises(KeyError):
+        with t.timer("forward_0"):
+            raise KeyError("device loss mid-span")
+    assert "forward_0" in t.durations()  # recorded despite the raise
+    with pytest.raises(RuntimeError, match="duplicate"):
+        with t.timer("forward_0"):
+            pass
+
+
+# ---------------- reference log-format byte parity ------------------------
+
+def test_reference_log_lines_byte_exact():
+    """The pre-refactor f-strings, pinned byte-for-byte: the formatters
+    must never drift (tooling parses these lines)."""
+    assert reference_train_line(0, 9, 0.1234, 0.015, 0.002, 1.5) == (
+        "Process 000 | Epoch 00009 | Time(s) 0.1234 | Comm(s) 0.0150 | "
+        "Reduce(s) 0.0020 | Loss 1.5000")
+    assert reference_eval_line(9, 0.95) == "Epoch 00009 | Accuracy 95.00%"
+    assert reference_eval_line(19, 0.9512, 0.9401) == (
+        "Epoch 00019 | Validation Accuracy 95.12% | "
+        "Test Accuracy 94.01%")
+    assert epoch_line(10, 0.0312, 0.6931) == (
+        "Epoch 00010 | Time(s) 0.0312 | Loss 0.6931")
+    assert epoch_line(10, 0.0312, 0.6931, 0.875) == (
+        "Epoch 00010 | Time(s) 0.0312 | Loss 0.6931 | Val 0.8750")
+
+
+# ---------------- CLI end-to-end ------------------------------------------
+
+_TRAIN_RE = re.compile(
+    r"Process \d{3} \| Epoch \d{5} \| Time\(s\) \d+\.\d{4} \| "
+    r"Comm\(s\) \d+\.\d{4} \| Reduce\(s\) \d+\.\d{4} \| Loss \d+\.\d{4}")
+_EVAL_RE = re.compile(
+    r"Epoch (\d{5}) \| Validation Accuracy (\d+\.\d{2})% \| "
+    r"Test Accuracy (\d+\.\d{2})%")
+
+
+def _cli_args(tmp_path, extra):
+    base = [
+        "--dataset", "synthetic:600:8:16:4",
+        "--n-partitions", "4",
+        "--n-epochs", "12",
+        "--n-layers", "2",
+        "--n-hidden", "32",
+        "--dropout", "0.2",
+        "--log-every", "5",
+        "--fix-seed", "--seed", "7",
+        "--partition-dir", str(tmp_path / "partitions"),
+        "--model-dir", str(tmp_path / "model"),
+        "--results-dir", str(tmp_path / "results"),
+    ]
+    return create_parser().parse_args(base + extra)
+
+
+@pytest.fixture(scope="module")
+def cli_metrics_run(tmp_path_factory):
+    """One pipelined CLI smoke run with --metrics-out, shared by the
+    telemetry-content, report-CLI and reference-log tests."""
+    tmp_path = tmp_path_factory.mktemp("obs_cli")
+    mpath = tmp_path / "metrics.jsonl"
+    args = _cli_args(tmp_path, ["--enable-pipeline",
+                                "--metrics-out", str(mpath)])
+    res = run(args)
+    return tmp_path, mpath, args, res
+
+
+def test_cli_metrics_end_to_end(cli_metrics_run):
+    tmp_path, mpath, args, res = cli_metrics_run
+    assert res["metrics_out"] == str(mpath)
+    recs = read_metrics(mpath)
+    for r in recs:
+        validate_record(r)
+
+    header = recs[0]
+    assert header["event"] == "run"
+    assert header["schema_version"] == obs_schema.SCHEMA_VERSION
+    assert header["config"]["enable_pipeline"] is True
+    assert header["mesh"]["n_parts"] == 4
+    assert header["device"].get("platform") == "cpu"
+
+    epochs = [r for r in recs if r["event"] == "epoch"]
+    assert [r["epoch"] for r in epochs] == list(range(12))
+    for r in epochs:
+        assert r["step_time_s"] > 0
+        assert np.isfinite(r["loss"])
+        assert r["grad_norm"] > 0
+        assert r["halo_bytes"] > 0  # P=4: real halo traffic
+        assert set(r["memory"]) >= {"bytes_in_use", "peak_bytes_in_use"}
+    # staleness-1 pipelining: epoch 0 consumes zero-initialized buffers
+    assert epochs[0]["staleness_age"] == 0
+    assert all(r["staleness_age"] == 1 for r in epochs[1:])
+    # the pipelined loss still goes down on this easy graph
+    assert epochs[-1]["loss"] < epochs[0]["loss"]
+
+    evals = [r for r in recs if r["event"] == "eval"]
+    assert evals and all(0 <= r["val_acc"] <= 1 for r in evals)
+    assert "test_acc" in evals[0]  # transductive eval scores test too
+
+    summ = [r for r in recs if r["event"] == "summary"]
+    assert len(summ) == 1
+    assert summ[0]["n_epochs"] == 12
+    assert summ[0]["best_val"] == pytest.approx(res["best_val"])
+    assert summ[0]["comm_cost"]["comm"] > 0  # measure_comm_cost path
+
+
+def test_cli_reference_logs_unchanged(cli_metrics_run):
+    """--reference-logs output must stay byte-identical through the
+    telemetry refactor: every result-file line matches the reference
+    format exactly, and re-rendering the parsed values through the
+    pinned formatter reproduces each line byte-for-byte."""
+    tmp_path, mpath, args, res = cli_metrics_run
+    rfile = result_file_name(args)
+    lines = open(rfile).read().strip().splitlines()
+    assert lines
+    for line in lines:
+        m = _EVAL_RE.fullmatch(line)
+        assert m, f"reference-format line drifted: {line!r}"
+        rebuilt = reference_eval_line(int(m.group(1)),
+                                      float(m.group(2)) / 100.0,
+                                      float(m.group(3)) / 100.0)
+        assert rebuilt == line
+
+
+def test_cli_stdout_train_lines_reference_format(tmp_path, capsys):
+    """The Process/Comm/Reduce stdout lines keep the reference's exact
+    field layout (train.py:369-371)."""
+    args = _cli_args(tmp_path, ["--no-eval"])
+    run(args)
+    out = capsys.readouterr().out
+    train_lines = [ln for ln in out.splitlines()
+                   if ln.startswith("Process")]
+    assert train_lines  # 12 epochs -> the epoch-9 boundary logs once
+    for ln in train_lines:
+        assert _TRAIN_RE.fullmatch(ln), f"drifted: {ln!r}"
+
+
+# ---------------- report CLI ----------------------------------------------
+
+def test_report_cli_summarizes_run(cli_metrics_run, capsys):
+    _, mpath, _, res = cli_metrics_run
+    rc = report_main([str(mpath)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "median epoch" in out
+    assert "best val" in out
+    # --json emits a machine-readable summary
+    rc = report_main([str(mpath), "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["n_epoch_records"] == 12
+    assert s["pipeline"] is True
+    assert s["median_epoch_s"] > 0
+    assert s["best_val"] == pytest.approx(res["best_val"])
+    assert s["loss_delta"] < 0
+    assert 0 < s["comm_fraction"] <= 1
+    assert s["overlapped_comm_fraction"] == s["comm_fraction"]
+    assert s["halo_bytes_per_epoch"] > 0
+    assert s["staleness_age_max"] == 1
+
+
+def test_report_cli_tolerates_partial_files(tmp_path, capsys):
+    """A crashed run's file (header + some epochs, no summary) still
+    summarizes; a missing file errors with rc=1, not a traceback."""
+    p = tmp_path / "partial.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        for e in range(3):
+            ml.epoch(epoch=e, step_time_s=0.5 + e, loss=1.0 - 0.1 * e,
+                     grad_norm=0.5, halo_bytes=0, staleness_age=0,
+                     memory=None)
+    assert report_main([str(p)]) == 0
+    s_out = capsys.readouterr().out
+    assert "epochs recorded" in s_out
+    summ = summarize_run(read_metrics(p))
+    assert summ["n_epoch_records"] == 3
+    assert summ["median_epoch_s"] == pytest.approx(1.5)
+    assert summ["loss_delta"] == pytest.approx(-0.2)
+    assert report_main([str(tmp_path / "nope.jsonl")]) == 1
+
+
+# ---------------- sequential runner records -------------------------------
+
+def test_sequential_runner_emits_epoch_records(tmp_path):
+    from pipegcn_tpu.graph import synthetic_graph
+    from pipegcn_tpu.models import ModelConfig
+    from pipegcn_tpu.parallel import SequentialRunner, TrainConfig
+    from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+    g = synthetic_graph(num_nodes=400, avg_degree=6, n_feat=8,
+                        n_class=3, seed=3)
+    parts = partition_graph(g, 4, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4)
+    cfg = ModelConfig(layer_sizes=(8, 16, 3), dropout=0.0,
+                      train_size=sg.n_train_global, spmm_impl="bucket")
+    mpath = tmp_path / "seq.jsonl"
+    with MetricsLogger(mpath) as ml:
+        ml.run_header(config={"runner": "sequential"}, device={},
+                      mesh={"n_parts": 4})
+        runner = SequentialRunner(
+            sg, cfg, TrainConfig(n_epochs=2, enable_pipeline=True),
+            metrics=ml)
+        for e in range(2):
+            runner.run_epoch(e)
+    recs = read_metrics(mpath)
+    epochs = [r for r in recs if r["event"] == "epoch"]
+    assert len(epochs) == 2
+    for r in epochs:
+        validate_record(r)
+        assert r["grad_norm"] > 0 and r["halo_bytes"] > 0
+    assert epochs[0]["staleness_age"] == 0
+    assert epochs[1]["staleness_age"] == 1
