@@ -147,17 +147,36 @@ def prepare(args):
 
 def _await_partition_artifact(part_path: str, n_partitions: int,
                               timeout_s: float = 3600.0,
-                              poll_s: float = 2.0):
+                              poll_s: float = 2.0,
+                              max_poll_s: float = 30.0):
+    """Poll the shared filesystem for process 0's finished artifact.
+
+    Exponential backoff with jitter: a 64-host pod polling a shared
+    filesystem in lockstep every 2 s is a thundering herd for the whole
+    multi-hour partition build; backing off to `max_poll_s` (desynced
+    by the jitter) costs at most one extra poll interval of startup
+    latency. A progress line keeps long waits diagnosable from the
+    rank's log."""
     import time
 
-    deadline = time.monotonic() + timeout_s
+    start = time.monotonic()
+    deadline = start + timeout_s
+    poll = poll_s
+    next_report = start
     while not ShardedGraph.exists(part_path):
-        if time.monotonic() > deadline:
+        now = time.monotonic()
+        if now > deadline:
             raise TimeoutError(
                 f"timed out waiting for partition artifact at {part_path} "
                 f"(is the partition dir on a shared filesystem?)"
             )
-        time.sleep(poll_s)
+        if now >= next_report:
+            print(f"waiting for partition artifact at {part_path} "
+                  f"({int(now - start)}s elapsed, poll {poll:.1f}s)")
+            next_report = now + 30.0
+        time.sleep(min(poll + random.uniform(0, poll * 0.25),
+                       max(deadline - time.monotonic(), 0.1)))
+        poll = min(poll * 1.6, max_poll_s)
     sg = ShardedGraph.load(part_path)
     if sg.num_parts != n_partitions:
         raise ValueError(
@@ -185,6 +204,12 @@ def run(args) -> dict:
         )
     if args.backend not in ("xla", "gloo"):
         raise ValueError(f"unknown backend: {args.backend}")
+    if args.resume and not args.checkpoint_dir:
+        # fail BEFORE the partition/trainer build: a silent no-op
+        # resume restarted multi-day runs from epoch 0 unnoticed
+        raise ValueError(
+            "--resume requires --checkpoint-dir (there is nothing to "
+            "resume from)")
 
     # deferred jax import so the parser works without initializing backends
     import jax
@@ -248,18 +273,21 @@ def run(args) -> dict:
     rfile = result_file_name(args)
 
     start_epoch = 0
-    if args.resume and args.checkpoint_dir and \
-            checkpoint_exists(args.checkpoint_dir):
-        trainer.state, start_epoch = load_checkpoint(
-            args.checkpoint_dir, jax.device_get(trainer.state)
-        )
-        trainer.state = {
-            "params": jax.device_put(trainer.state["params"], trainer._repl),
-            "opt": jax.device_put(trainer.state["opt"], trainer._repl),
-            "norm": jax.device_put(trainer.state["norm"], trainer._repl),
-            "comm": jax.device_put(trainer.state["comm"], trainer._shard),
-        }
-        print(f"resumed from {args.checkpoint_dir} at epoch {start_epoch}")
+    if args.resume:
+        if checkpoint_exists(args.checkpoint_dir):
+            host_state, start_epoch = load_checkpoint(
+                args.checkpoint_dir, jax.device_get(trainer.state)
+            )
+            trainer.restore_state(host_state)
+            print(f"resumed from {args.checkpoint_dir} "
+                  f"at epoch {start_epoch}")
+        else:
+            warnings.warn(
+                f"--resume: no checkpoint found in "
+                f"{args.checkpoint_dir!r}; starting a FRESH run from "
+                f"epoch 0 (first checkpoint will be written there)")
+            print(f"WARNING: --resume found no checkpoint in "
+                  f"{args.checkpoint_dir!r}; training from scratch")
 
     metrics = None
     if args.metrics_out:
@@ -275,21 +303,44 @@ def run(args) -> dict:
                   **mesh_info(trainer.mesh)},
         )
 
+    # ---- fault tolerance (docs/RESILIENCE.md) ----
+    from ..resilience import (DivergenceSentinel, FaultPlan,
+                              PreemptionHandler, SentinelConfig)
+
+    sentinel = None
+    if getattr(args, "sentinel", True):
+        sentinel = DivergenceSentinel(SentinelConfig(
+            loss_factor=args.sentinel_loss_factor,
+            grad_norm_max=args.sentinel_grad_max,
+            max_retries=args.sentinel_max_retries,
+            lr_backoff=args.sentinel_lr_backoff,
+            snapshot_every=args.sentinel_snapshot_every,
+            flush_on_trip=args.sentinel_flush,
+        ))
+    fault_plan = FaultPlan.parse(args.fault_plan) if args.fault_plan \
+        else None
+    preemption = PreemptionHandler()
+
     try:
-        fit_res = trainer.fit(
-            eval_graphs,
-            start_epoch=start_epoch,
-            reference_logs=True,
-            result_file=rfile,
-            inductive=args.inductive,
-            checkpoint_dir=args.checkpoint_dir or None,
-            checkpoint_every=args.checkpoint_every,
-            profile_dir=args.profile_dir or None,
-            measure_comm_cost=True,
-            sharded_eval=args.sharded_eval,
-            async_eval=not args.sync_eval,
-            metrics=metrics,
-        )
+        with preemption.installed(enabled=not args.no_signal_handlers):
+            fit_res = trainer.fit(
+                eval_graphs,
+                start_epoch=start_epoch,
+                reference_logs=True,
+                result_file=rfile,
+                inductive=args.inductive,
+                checkpoint_dir=args.checkpoint_dir or None,
+                checkpoint_every=args.checkpoint_every,
+                checkpoint_keep=args.checkpoint_keep,
+                profile_dir=args.profile_dir or None,
+                measure_comm_cost=True,
+                sharded_eval=args.sharded_eval,
+                async_eval=not args.sync_eval,
+                metrics=metrics,
+                sentinel=sentinel,
+                preemption=preemption,
+                fault_plan=fault_plan,
+            )
     finally:
         # every record is already flushed; close releases the handle
         # even when training crashes mid-run
@@ -323,11 +374,22 @@ def run(args) -> dict:
 
 
 def cli_entry() -> None:
+    import sys
+
+    from ..resilience import EXIT_PREEMPTED, Preempted
     from .parser import create_parser
 
     args = create_parser().parse_args()
     print(args)
-    run(args)
+    try:
+        run(args)
+    except Preempted as p:
+        # distinct resumable status (EX_TEMPFAIL): a supervisor retries
+        # with --resume on 75, treats anything else as a real failure
+        print(f"preempted at epoch {p.epoch} ({p.reason}); resumable — "
+              f"rerun with --resume --checkpoint-dir "
+              f"{args.checkpoint_dir!r} [exit {EXIT_PREEMPTED}]")
+        sys.exit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

@@ -176,8 +176,62 @@ def create_parser() -> argparse.ArgumentParser:
                         help="enable periodic checkpointing to this dir")
     parser.add_argument("--checkpoint-every", "--checkpoint_every", type=int,
                         default=100)
+    parser.add_argument("--checkpoint-keep", "--checkpoint_keep", type=int,
+                        default=3,
+                        help="checkpoint generations retained "
+                             "(keep-last-N rotation with a 'latest' "
+                             "pointer and digest-verified fallback, "
+                             "docs/RESILIENCE.md; 0 keeps all)")
     parser.add_argument("--resume", action="store_true",
-                        help="resume from --checkpoint-dir")
+                        help="resume from --checkpoint-dir (errors "
+                             "without one; warns loudly when the dir "
+                             "holds no checkpoint yet)")
+    # ---- fault tolerance (docs/RESILIENCE.md) ----
+    parser.add_argument("--no-sentinel", "--no_sentinel",
+                        action="store_false", dest="sentinel",
+                        help="disable the divergence sentinel "
+                             "(non-finite/exploding loss detection with "
+                             "rollback + LR backoff + bounded retries)")
+    parser.set_defaults(sentinel=True)
+    parser.add_argument("--sentinel-loss-factor", "--sentinel_loss_factor",
+                        type=float, default=10.0,
+                        help="trip when loss exceeds this multiple of "
+                             "the recent healthy median (0 disables the "
+                             "relative check; non-finite always trips)")
+    parser.add_argument("--sentinel-grad-max", "--sentinel_grad_max",
+                        type=float, default=0.0,
+                        help="absolute grad-norm trip threshold "
+                             "(0 disables)")
+    parser.add_argument("--sentinel-max-retries", "--sentinel_max_retries",
+                        type=int, default=3,
+                        help="consecutive rollback retries before the "
+                             "run fails with DivergenceError")
+    parser.add_argument("--sentinel-lr-backoff", "--sentinel_lr_backoff",
+                        type=float, default=0.5,
+                        help="LR multiplier applied on every sentinel "
+                             "trip (1.0 = no backoff)")
+    parser.add_argument("--sentinel-snapshot-every",
+                        "--sentinel_snapshot_every", type=int, default=25,
+                        help="epochs between in-memory last-good "
+                             "snapshots the sentinel rolls back to")
+    parser.add_argument("--sentinel-no-flush", "--sentinel_no_flush",
+                        action="store_false", dest="sentinel_flush",
+                        help="keep the stale pipelined halo carry on "
+                             "rollback instead of flushing it to zeros")
+    parser.set_defaults(sentinel_flush=True)
+    parser.add_argument("--fault-plan", "--fault_plan", type=str,
+                        default="",
+                        help="deterministic chaos injection: comma-"
+                             "separated kind@epoch entries (nan-loss, "
+                             "nan-grad, sigterm, crash, corrupt-ckpt), "
+                             "e.g. 'nan-loss@5,sigterm@8'; each fires "
+                             "once, host-side only")
+    parser.add_argument("--no-signal-handlers", "--no_signal_handlers",
+                        action="store_true",
+                        help="do not install SIGTERM/SIGINT handlers "
+                             "(nested launchers that own their signals; "
+                             "PIPEGCN_NO_SIGNAL_HANDLERS=1 does the "
+                             "same)")
     parser.add_argument("--profile-dir", "--profile_dir", type=str,
                         default="",
                         help="write a jax.profiler trace of a few epochs "

@@ -99,6 +99,17 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     if peaks:
         out["memory_peak_bytes"] = max(peaks)
 
+    faults = [r for r in records if r.get("event") == "fault"]
+    recoveries = [r for r in records if r.get("event") == "recovery"]
+    if faults:
+        kinds: Dict[str, int] = {}
+        for r in faults:
+            k = str(r.get("kind"))
+            kinds[k] = kinds.get(k, 0) + 1
+        out["n_faults"] = len(faults)
+        out["fault_kinds"] = kinds
+        out["n_recoveries"] = len(recoveries)
+
     accs = [r["val_acc"] for r in evals
             if isinstance(r.get("val_acc"), (int, float))]
     if accs:
@@ -174,6 +185,12 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
     row("overlapped comm fraction", "overlapped_comm_fraction",
         "{:.2%}")
     row("MFU", "mfu_pct", "{:.2f} %")
+    if s.get("n_faults"):
+        kinds = ", ".join(f"{k}x{n}" for k, n in
+                          sorted(s.get("fault_kinds", {}).items()))
+        lines.append(f"  {'faults / recoveries':<26} "
+                     f"{s['n_faults']} / {s.get('n_recoveries', 0)}"
+                     f" ({kinds})")
     row("best val", "best_val", "{:.4f}")
     row("best epoch", "best_epoch")
     row("test acc", "test_acc", "{:.4f}")

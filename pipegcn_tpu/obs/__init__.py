@@ -36,6 +36,8 @@ from .metrics import (
 from .schema import (
     EPOCH_FIELDS,
     EVAL_FIELDS,
+    FAULT_FIELDS,
+    RECOVERY_FIELDS,
     RUN_FIELDS,
     SCHEMA_VERSION,
     SUMMARY_FIELDS,
@@ -49,6 +51,8 @@ __all__ = [
     "EPOCH_FIELDS",
     "EVAL_FIELDS",
     "SUMMARY_FIELDS",
+    "FAULT_FIELDS",
+    "RECOVERY_FIELDS",
     "validate_record",
     "MetricsLogger",
     "read_metrics",

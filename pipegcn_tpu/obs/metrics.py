@@ -130,6 +130,27 @@ class MetricsLogger:
             **extra,
         })
 
+    def fault(self, kind: str, epoch: int, **extra) -> Dict[str, Any]:
+        """A detected fault: divergence trip, preemption request,
+        injected chaos fault, corrupt checkpoint generation. Extras
+        carry the kind-specific detail (reason, retry, trip values)."""
+        return self.write({
+            "event": "fault",
+            "kind": str(kind),
+            "epoch": int(epoch),
+            **extra,
+        })
+
+    def recovery(self, kind: str, epoch: int, **extra) -> Dict[str, Any]:
+        """A completed recovery from the matching fault kind (training
+        progressed past the faulted epoch, or a resume restored)."""
+        return self.write({
+            "event": "recovery",
+            "kind": str(kind),
+            "epoch": int(epoch),
+            **extra,
+        })
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""

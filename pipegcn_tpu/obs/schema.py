@@ -57,11 +57,30 @@ SUMMARY_FIELDS: Dict[str, str] = {
     "best_val": "number",
 }
 
+# one record per detected fault (divergence trip, preemption request,
+# injected fault, corrupt checkpoint generation); extras carry the
+# kind-specific detail (reason, retry count, trip values)
+FAULT_FIELDS: Dict[str, str] = {
+    "event": "string",           # "fault"
+    "kind": "string",            # divergence | preemption | injected | ...
+    "epoch": "integer",          # epoch the fault surfaced at
+}
+
+# one record per completed recovery (training progressed past the
+# faulted epoch after rollback/backoff, or a resume restored state)
+RECOVERY_FIELDS: Dict[str, str] = {
+    "event": "string",           # "recovery"
+    "kind": "string",            # matches the fault it recovers from
+    "epoch": "integer",          # epoch training had reached on recovery
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
     "eval": EVAL_FIELDS,
     "summary": SUMMARY_FIELDS,
+    "fault": FAULT_FIELDS,
+    "recovery": RECOVERY_FIELDS,
 }
 
 _JSON_TYPES = {

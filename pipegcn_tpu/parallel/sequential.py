@@ -143,7 +143,9 @@ class SequentialRunner:
                  compact_halo: bool = False,
                  keep_carry: bool = True,
                  log: Callable[[str], None] = lambda s: None,
-                 metrics=None):
+                 metrics=None,
+                 check_finite: bool = True,
+                 fault_plan=None):
         if not tcfg.enable_pipeline:
             raise ValueError("SequentialRunner implements the pipelined "
                              "(staleness-1) step; vanilla mode has "
@@ -178,6 +180,15 @@ class SequentialRunner:
         # emits, obs/schema.py), so full-scale sequential validation
         # runs feed the same report CLI
         self._metrics = metrics
+        # resilience wiring (docs/RESILIENCE.md): a multi-hour
+        # sequential epoch must not keep burning ranks after the loss
+        # went non-finite — run_epoch raises DivergenceError (emitting
+        # a fault record) and the caller decides rollback; the host
+        # holds params/opt, so any checkpoint discipline works.
+        # fault_plan (resilience.FaultPlan) supports nan-loss injection
+        # for chaos-testing that path.
+        self._check_finite = check_finite
+        self._fault_plan = fault_plan
 
         self._glayers = [str(i) for i in range(cfg.n_graph_layers)]
         self._widths = {k: cfg.layer_sizes[int(k)] for k in self._glayers}
@@ -495,12 +506,17 @@ class SequentialRunner:
                             + (1 - m) * bgrad_next.astype(np.float32))
         self.last_epoch = epoch + 1
         mean_loss = loss_sum / self.n_train
+        # grad norm over the reduced (psum'd / n_train) gradient —
+        # telemetry AND the finiteness guard below
+        gnorm = float(np.sqrt(sum(
+            float(np.sum(np.square(np.asarray(g, np.float64))))
+            for g in jax.tree_util.tree_leaves(pgrads))))
+        if self._fault_plan is not None and \
+                self._fault_plan.due("nan-loss", epoch):
+            self._log(f"fault-injected nan loss at epoch {epoch}")
+            mean_loss = float("nan")
         if self._metrics is not None:
-            # same record shape as the mesh trainer's (obs/schema.py);
-            # grad norm over the reduced (psum'd / n_train) gradient
-            gnorm = float(np.sqrt(sum(
-                float(np.sum(np.square(np.asarray(g, np.float64))))
-                for g in jax.tree_util.tree_leaves(pgrads))))
+            # same record shape as the mesh trainer's (obs/schema.py)
             self._metrics.epoch(
                 epoch=epoch,
                 step_time_s=time.perf_counter() - t_start,
@@ -510,4 +526,17 @@ class SequentialRunner:
                 staleness_age=int(1 if epoch > 0 else 0),
                 memory=memory_snapshot(),
             )
+        if self._check_finite and not (np.isfinite(mean_loss)
+                                       and np.isfinite(gnorm)):
+            from ..resilience import DivergenceError
+
+            reason = (f"non-finite loss {mean_loss!r}"
+                      if not np.isfinite(mean_loss)
+                      else f"non-finite grad norm {gnorm!r}")
+            if self._metrics is not None:
+                self._metrics.fault(kind="divergence", epoch=epoch,
+                                    reason=reason)
+            raise DivergenceError(
+                f"sequential epoch {epoch}: {reason}; the caller holds "
+                f"the host-side state and decides rollback")
         return mean_loss

@@ -44,6 +44,7 @@ from ..obs.metrics import device_info, memory_snapshot, mesh_info
 from ..obs.trace import PhaseTimer, named_phase
 from ..ops.spmm import spmm_mean
 from ..partition.halo import ShardedGraph
+from ..resilience import DivergenceError, Preempted
 from ..train.losses import bce_logits_sum, cross_entropy_sum
 from ..train.metrics import calc_acc
 from ..train.optim import adam_init, adam_update
@@ -934,6 +935,36 @@ class Trainer:
         self.last_epoch = start_epoch + k  # see train_epoch
         return np.asarray(ms["loss"])
 
+    def restore_state(self, host_state: Dict[str, Any]) -> None:
+        """Device-place a host-side state pytree (a checkpoint load or
+        a sentinel last-good snapshot) with the trainer's shardings —
+        the one way to put external state back under the donated-buffer
+        step. Works identically for emulated trainers (their stacked
+        [P, ...] replicas ride the single-device shardings)."""
+        self.state = {
+            "params": jax.device_put(host_state["params"], self._repl),
+            "opt": jax.device_put(host_state["opt"], self._repl),
+            "norm": jax.device_put(host_state["norm"], self._repl),
+            "comm": jax.device_put(host_state["comm"], self._shard),
+        }
+
+    def reset_comm(self) -> None:
+        """Zero the pipelined comm carry: the next epoch consumes zero
+        halos exactly like epoch 0, restarting the staleness-1 warmup.
+        The sentinel's 'flush' action — stale boundary data produced by
+        a divergent trajectory never re-enters the retried epochs."""
+        self.state = dict(self.state)
+        self.state["comm"] = jax.device_put(self._init_comm(), self._shard)
+
+    def set_lr(self, lr: float) -> None:
+        """Change the learning rate mid-run. The LR is a trace-time
+        constant of the jitted step, so this rebuilds the step (one
+        recompile per change — the sentinel's backoff path, where a
+        recompile per rare trip is the right trade against threading a
+        traced scalar through every healthy epoch)."""
+        self.tcfg = dataclasses.replace(self.tcfg, lr=float(lr))
+        self._step = self._build_step()
+
     def fit(
         self,
         eval_graphs: Optional[Dict[str, Tuple[Graph, str]]] = None,
@@ -945,11 +976,15 @@ class Trainer:
         inductive: bool = False,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 100,
+        checkpoint_keep: int = 3,
         profile_dir: Optional[str] = None,
         measure_comm_cost: bool = False,
         sharded_eval: bool = False,
         async_eval: bool = True,
         metrics=None,
+        sentinel=None,
+        preemption=None,
+        fault_plan=None,
     ) -> Dict[str, Any]:
         """The single epoch loop (reference train.py:327-400): periodic
         evaluation, best-val/BN-stats tracking, timing with <5-epoch
@@ -981,7 +1016,29 @@ class Trainer:
         watermarks), one record per harvested evaluation, and a final
         run summary — the schema in obs/schema.py and
         docs/OBSERVABILITY.md. The sink never changes the log_fn
-        stream: --reference-logs output stays byte-identical."""
+        stream: --reference-logs output stays byte-identical.
+
+        Resilience (docs/RESILIENCE.md):
+
+        `sentinel` (resilience.DivergenceSentinel or None) checks every
+        dispatched block's loss/grad-norm; on trip, fit restores the
+        last good in-memory snapshot, scales the LR down, optionally
+        flushes the pipelined comm carry, and retries — bounded by the
+        sentinel's max_retries, then DivergenceError. Fault/recovery
+        records ride the metrics sink.
+
+        `preemption` (resilience.PreemptionHandler or None) is polled
+        at each dispatch boundary; a shutdown request checkpoints via
+        the crash handler (rank-0 save) and raises Preempted, which the
+        CLI maps to the resumable exit status EXIT_PREEMPTED.
+
+        `fault_plan` (resilience.FaultPlan or None) injects
+        deterministic host-side faults into the harvested metrics, the
+        epoch boundary, and the checkpoint path — chaos testing only;
+        the compiled device program is never altered.
+
+        `checkpoint_keep` bounds the on-disk checkpoint generations
+        (keep-last-N; utils/checkpoint.py rotation)."""
         from ..utils.checkpoint import save_checkpoint
 
         tcfg = self.tcfg
@@ -1057,7 +1114,10 @@ class Trainer:
                 # train.py:383)
                 best_params = jax.device_get(p["snap_p"])
                 best_norm = jax.device_get(p["snap_n"])
-        comm_cost = {"comm": 0.0, "reduce": 0.0}
+        # "bgrad" present from the start: a resumed run can hit its
+        # first reference-log boundary BEFORE the one-shot measurement
+        # (start_epoch + 5) and must print zeros, not KeyError
+        comm_cost = {"comm": 0.0, "reduce": 0.0, "bgrad": 0.0}
         comm_measured = False
         timer = PhaseTimer()
         profiling = False
@@ -1077,8 +1137,38 @@ class Trainer:
         # True while a dispatched-but-unfinished eval occupies the device
         # stream (its time would contaminate the next block's timing)
         eval_in_stream = False
+        # ---- resilience state (docs/RESILIENCE.md) ----
+        retries = 0          # consecutive sentinel rollbacks
+        trip_horizon = None  # first epoch past the last trip: passing it
+        #                      healthy = recovered (resets the counter)
+        last_good = None     # (epoch, host snapshot) rollback target
+        if sentinel is not None:
+            last_good = (start_epoch, jax.device_get(self.state))
+        if fault_plan is not None:
+            # a resumed run gets the same --fault-plan; entries it
+            # already lived through must not re-fire
+            fault_plan.skip_before(start_epoch)
         try:
             while epoch < n_epochs:
+                # ---- boundary faults / preemption: the one point where
+                # the donated state is consistent and labeled ----
+                if fault_plan is not None and fault_plan.due("crash", epoch):
+                    raise RuntimeError(
+                        f"fault-injected crash at epoch {epoch}")
+                preempt_reason = (preemption.reason
+                                  if preemption is not None
+                                  and preemption.requested else None)
+                if fault_plan is not None and \
+                        fault_plan.due("sigterm", epoch):
+                    preempt_reason = preempt_reason or "fault-plan sigterm"
+                if preempt_reason is not None:
+                    log_fn(f"preemption requested ({preempt_reason}); "
+                           f"checkpointing at epoch boundary {epoch}")
+                    if metrics is not None:
+                        metrics.fault(kind="preemption", epoch=epoch,
+                                      reason=preempt_reason)
+                    # the crash handler below does the rank-0 save
+                    raise Preempted(epoch, preempt_reason)
                 if profile_dir and not profiling and \
                         epoch >= min(start_epoch + 6, n_epochs - 1):
                     jax.profiler.start_trace(profile_dir)
@@ -1121,12 +1211,28 @@ class Trainer:
                         and not eval_in_stream:
                     durs.extend([dur] * chunk)
                 eval_in_stream = False
+                # grad norms ride the step output ([k] arrays for fused
+                # blocks) — harvested here for the metrics records AND
+                # the sentinel check
+                gn = np.atleast_1d(np.asarray(
+                    self._last_metrics["grad_norm"], np.float64))
+                # ---- injected metric faults (host-side only: the
+                # compiled device program is what production runs) ----
+                if fault_plan is not None:
+                    j = fault_plan.due_in("nan-loss", epoch, epoch + chunk)
+                    if j is not None:
+                        blk_losses = np.array(blk_losses, np.float64)
+                        blk_losses[j - epoch] = np.nan
+                        loss = float(blk_losses[-1])
+                        log_fn(f"fault-injected nan loss at epoch {j}")
+                    j = fault_plan.due_in("nan-grad", epoch, epoch + chunk)
+                    if j is not None:
+                        gn = np.array(gn, np.float64)
+                        gn[min(j - epoch, gn.size - 1)] = np.nan
+                        log_fn(f"fault-injected nan grad norm at epoch {j}")
                 if metrics is not None:
-                    # one record per epoch in the block; grad norms ride
-                    # the step output ([k] arrays for fused blocks), the
-                    # HBM watermark is sampled once per dispatch
-                    gn = np.atleast_1d(np.asarray(
-                        self._last_metrics["grad_norm"], np.float64))
+                    # one record per epoch in the block; the HBM
+                    # watermark is sampled once per dispatch
                     mem = memory_snapshot()
                     for j in range(chunk):
                         e_j = epoch + j
@@ -1145,6 +1251,64 @@ class Trainer:
                                 else 0),
                             memory=mem,
                         )
+                # ---- divergence sentinel: check the block, roll back
+                # on trip (restore last good snapshot, back the LR off,
+                # flush the stale halo carry), bounded retries ----
+                if sentinel is not None:
+                    reason = sentinel.check(epoch, blk_losses, gn)
+                    if reason is not None:
+                        scfg = sentinel.cfg
+                        retries += 1
+                        rollback_to, good_state = last_good
+                        new_lr = (self.tcfg.lr * scfg.lr_backoff
+                                  if scfg.lr_backoff < 1.0 else self.tcfg.lr)
+                        log_fn(f"divergence sentinel tripped ({reason}); "
+                               f"retry {retries}/{scfg.max_retries}: "
+                               f"rollback to epoch {rollback_to}, "
+                               f"lr -> {new_lr:g}")
+                        if metrics is not None:
+                            metrics.fault(
+                                kind="divergence", epoch=epoch,
+                                reason=reason, retry=retries,
+                                rollback_epoch=rollback_to, lr=new_lr)
+                        # restore BEFORE a possible give-up so the crash
+                        # handler checkpoints the healthy state, not the
+                        # divergent one
+                        self.restore_state(good_state)
+                        self.last_epoch = rollback_to
+                        if retries > scfg.max_retries:
+                            raise DivergenceError(
+                                f"training diverged and "
+                                f"{scfg.max_retries} recovery retries "
+                                f"were exhausted: {reason}")
+                        if scfg.lr_backoff < 1.0:
+                            self.set_lr(new_lr)
+                            # the rebuilt step recompiles once per scan
+                            # length; exclude those blocks from timing
+                            seen_chunks.clear()
+                        if scfg.flush_on_trip and tcfg.enable_pipeline:
+                            self.reset_comm()
+                        trip_horizon = epoch + chunk
+                        pending = None  # in-flight eval snapshot is
+                        #                 from the rolled-back timeline
+                        eval_in_stream = False
+                        epoch = rollback_to
+                        continue
+                    if trip_horizon is not None and \
+                            epoch + chunk >= trip_horizon:
+                        log_fn(f"recovered past epoch {trip_horizon - 1} "
+                               f"after rollback")
+                        if metrics is not None:
+                            metrics.recovery(kind="divergence",
+                                             epoch=epoch + chunk - 1,
+                                             retries=retries)
+                        retries = 0
+                        trip_horizon = None
+                    # healthy: refresh the rollback snapshot on cadence
+                    if epoch + chunk - last_good[0] >= max(
+                            int(sentinel.cfg.snapshot_every), 1):
+                        last_good = (epoch + chunk,
+                                     jax.device_get(self.state))
                 epoch += chunk - 1  # body below sees the block's last epoch
                 if measure_comm_cost and not comm_measured and \
                         epoch >= min(start_epoch + 5, n_epochs - 1):
@@ -1203,28 +1367,44 @@ class Trainer:
                     # (reference semantics, and N-1 fewer multi-GB
                     # writes to the shared filesystem)
                     save_checkpoint(checkpoint_dir,
-                                    jax.device_get(self.state), epoch + 1)
+                                    jax.device_get(self.state), epoch + 1,
+                                    keep=checkpoint_keep)
+                    if fault_plan is not None and \
+                            fault_plan.due("corrupt-ckpt", epoch + 1):
+                        from ..resilience.faults import \
+                            corrupt_latest_checkpoint
+
+                        p = corrupt_latest_checkpoint(checkpoint_dir)
+                        log_fn(f"fault-injected checkpoint corruption: {p}")
+                        if metrics is not None:
+                            metrics.fault(kind="injected", epoch=epoch + 1,
+                                          reason="corrupt-ckpt")
                 epoch += 1
 
-        except BaseException:
+        except BaseException as exc:
             # crash-resilient training (the reference's collectives
             # hang on any rank failure, SURVEY §5): best-effort save of
             # the last good state so --resume restarts from it, not
-            # epoch 0. last_epoch labels self.state's buffers (see
-            # train_epoch); if those buffers come from a FAILED
-            # dispatch, device_get below raises and the save is
-            # skipped — the previous periodic checkpoint survives
-            # (saves are atomic).
+            # epoch 0. Preemption rides the same path — the boundary
+            # check above raises Preempted with the state consistent.
+            # last_epoch labels self.state's buffers (see train_epoch);
+            # if those buffers come from a FAILED dispatch, device_get
+            # below raises and the save is skipped — the previous
+            # periodic checkpoint survives (saves are atomic, and the
+            # generation rotation keeps the older good ones).
             if checkpoint_dir and jax.process_index() == 0:
+                tag = ("preemption" if isinstance(exc, Preempted)
+                       else "crash")
                 try:
                     done = int(getattr(self, "last_epoch",
                                        start_epoch))
                     save_checkpoint(checkpoint_dir,
-                                    jax.device_get(self.state), done)
-                    log_fn(f"crash checkpoint saved to "
+                                    jax.device_get(self.state), done,
+                                    keep=checkpoint_keep)
+                    log_fn(f"{tag} checkpoint saved to "
                            f"{checkpoint_dir} (epoch {done})")
                 except Exception as save_exc:  # noqa: BLE001
-                    log_fn(f"crash checkpoint failed: {save_exc!r}")
+                    log_fn(f"{tag} checkpoint failed: {save_exc!r}")
             raise
 
         if pending is not None:

@@ -1,0 +1,39 @@
+"""Fault tolerance for long-running training jobs.
+
+The paper's convergence guarantee (PAPER.md) holds only while training
+stays in a healthy regime; on real pods, bf16 + staleness-1 can produce
+non-finite losses, machines get preempted, and checkpoints rot on
+shared filesystems. This package makes the trainer detect and survive
+all three (docs/RESILIENCE.md):
+
+  sentinel.py    DivergenceSentinel — trips on non-finite / exploding
+                 loss or grad-norm (the telemetry the jitted step
+                 already harvests) and drives rollback + backoff
+  preemption.py  SIGTERM/SIGINT → checkpoint at the next epoch boundary
+                 and exit with a distinct resumable status code
+  faults.py      deterministic fault-injection plans
+                 ("nan-loss@5,sigterm@8,corrupt-ckpt@10") for chaos
+                 testing the recovery paths
+
+Checkpoint hardening (per-leaf digests, keep-last-N generations,
+corrupt-generation fallback) lives in utils/checkpoint.py; the fault /
+recovery telemetry records it emits are contracted in obs/schema.py.
+
+No reference counterpart: the reference's gloo collectives simply hang
+when any rank dies (SURVEY.md §5).
+"""
+
+from .faults import FaultPlan, corrupt_latest_checkpoint
+from .preemption import EXIT_PREEMPTED, Preempted, PreemptionHandler
+from .sentinel import DivergenceError, DivergenceSentinel, SentinelConfig
+
+__all__ = [
+    "DivergenceError",
+    "DivergenceSentinel",
+    "SentinelConfig",
+    "EXIT_PREEMPTED",
+    "Preempted",
+    "PreemptionHandler",
+    "FaultPlan",
+    "corrupt_latest_checkpoint",
+]

@@ -1,0 +1,138 @@
+"""Deterministic fault injection for chaos-testing the recovery paths.
+
+A fault plan is a comma-separated list of ``kind@epoch`` entries, e.g.
+``--fault-plan nan-loss@5,sigterm@8,corrupt-ckpt@10``. Kinds:
+
+  nan-loss      the harvested loss of that epoch reads NaN (what a
+                diverged bf16 step reports) — exercises the sentinel's
+                rollback/backoff/retry loop
+  nan-grad      same, for the harvested grad norm
+  sigterm       a shutdown request at that epoch boundary, exactly as
+                if SIGTERM had been delivered — exercises the
+                preemption checkpoint + resumable exit path
+  crash         an uncaught exception at that epoch boundary —
+                exercises the crash-checkpoint handler
+  corrupt-ckpt  after the first checkpoint save at-or-after that
+                epoch, the newest generation's bytes are scribbled —
+                exercises digest verification + generation fallback
+
+Every entry fires AT MOST ONCE (otherwise a recovered retry of the same
+epoch would re-trip forever), and :meth:`skip_before` retires entries a
+resumed run has already lived through, so the same ``--fault-plan`` can
+be passed verbatim to the resume invocation. Epoch semantics: boundary
+kinds (sigterm/crash) fire at the START of epoch E, so the resumable
+checkpoint they produce says E completed and ``skip_before(E)`` retires
+them; injection kinds poison epoch E itself and survive a resume that
+starts at E (the epoch is re-run).
+
+Injection is host-side only — device programs are never altered, so a
+fault-injected run compiles byte-identical XLA to a production run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import List, Optional
+
+KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt")
+# kinds that fire at the start of an epoch boundary: a resume whose
+# start_epoch equals the scheduled epoch has already seen them fire
+_BOUNDARY_KINDS = ("sigterm", "crash")
+
+_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)$")
+
+
+@dataclasses.dataclass
+class _Entry:
+    kind: str
+    epoch: int
+    consumed: bool = False
+
+
+class FaultPlan:
+    """Parsed, single-shot fault schedule."""
+
+    def __init__(self, entries: List[_Entry]):
+        self._entries = sorted(entries, key=lambda e: e.epoch)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse ``kind@epoch[,kind@epoch...]``; raises ValueError with
+        the grammar on any malformed entry or unknown kind."""
+        entries = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _ENTRY_RE.match(raw)
+            if not m:
+                raise ValueError(
+                    f"bad fault-plan entry {raw!r}: expected kind@epoch "
+                    f"(e.g. nan-loss@5,sigterm@8,corrupt-ckpt@10)")
+            kind, epoch = m.group(1), int(m.group(2))
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; known: "
+                    f"{', '.join(KINDS)}")
+            entries.append(_Entry(kind, epoch))
+        return cls(entries)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __bool__(self) -> bool:
+        return bool(self._entries)
+
+    def remaining(self) -> List[str]:
+        return [f"{e.kind}@{e.epoch}" for e in self._entries
+                if not e.consumed]
+
+    def skip_before(self, start_epoch: int) -> None:
+        """Retire entries a resume starting at `start_epoch` has already
+        lived through (see module docstring for the boundary-kind
+        off-by-one)."""
+        for e in self._entries:
+            if e.epoch < start_epoch or (
+                    e.kind in _BOUNDARY_KINDS and e.epoch <= start_epoch
+                    and start_epoch > 0):
+                e.consumed = True
+
+    def due(self, kind: str, epoch: int) -> bool:
+        """True (and consumes the entry) when a `kind` fault is
+        scheduled at-or-before `epoch`. The <= comparison keeps faults
+        from being silently skipped when the loop only visits block
+        boundaries (fused_epochs > 1)."""
+        for e in self._entries:
+            if not e.consumed and e.kind == kind and e.epoch <= epoch:
+                e.consumed = True
+                return True
+        return False
+
+    def due_in(self, kind: str, lo: int, hi: int) -> Optional[int]:
+        """Epoch (clamped into [lo, hi)) of a `kind` fault scheduled
+        before `hi`, consuming it; None otherwise. For injection into a
+        fused block's harvested [k]-metrics."""
+        for e in self._entries:
+            if not e.consumed and e.kind == kind and e.epoch < hi:
+                e.consumed = True
+                return min(max(e.epoch, lo), hi - 1)
+        return None
+
+
+def corrupt_latest_checkpoint(directory: str) -> str:
+    """Scribble over the middle of the newest checkpoint generation
+    (the file the `latest` pointer names), returning its path. The
+    damage lands inside the zip payload, so digest verification — not
+    just the zip CRC — is what the loader must survive by."""
+    from ..utils.checkpoint import latest_checkpoint_path
+
+    path = latest_checkpoint_path(directory)
+    if path is None:
+        raise FileNotFoundError(f"no checkpoint generation in {directory}")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(max(0, size // 2 - 32))
+        f.write(b"\xde\xad\xbe\xef" * 16)
+    return path

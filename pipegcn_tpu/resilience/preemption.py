@@ -1,0 +1,106 @@
+"""Preemption-aware shutdown.
+
+TPU pods get preempted routinely; a SIGTERM that kills the process
+mid-epoch loses everything since the last periodic checkpoint, and a
+run supervisor cannot tell "crashed, don't retry" from "preempted,
+resume me" without a distinct exit status.
+
+The handler turns SIGTERM/SIGINT into a *request* flag; the epoch loop
+checks it at each dispatch boundary (the only point where the donated
+device state is consistent and labeled) and raises :class:`Preempted`,
+which rides the trainer's existing crash-checkpoint path — process 0
+saves, every process exits. The CLI maps :class:`Preempted` to
+:data:`EXIT_PREEMPTED` (75, EX_TEMPFAIL: "transient failure, retry"),
+so ``run.sh || [ $? -eq 75 ] && rerun --resume`` is all a supervisor
+needs.
+
+Handler installation is opt-in and guarded: only the CLI installs, only
+in the main thread (signal.signal raises elsewhere), never when
+``PIPEGCN_NO_SIGNAL_HANDLERS=1`` (nested launchers / test harnesses
+that own their signals), and the previous handlers are restored on
+exit. A second SIGINT raises KeyboardInterrupt immediately so an
+impatient Ctrl-C Ctrl-C still kills the run the normal way.
+
+Multi-host SPMD: the platform delivers SIGTERM to every host; each
+process trips its own flag at the same epoch boundary (the SPMD loop is
+lockstep), process 0 writes the checkpoint (trainer crash-handler
+guard), and all ranks exit 75 — no collective is entered one-sided.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+from typing import Optional
+
+# EX_TEMPFAIL: the conventional "transient, please retry" status
+EXIT_PREEMPTED = 75
+
+
+class Preempted(Exception):
+    """Raised at an epoch boundary after a shutdown request.
+
+    `epoch` is the number of completed epochs — the resumable
+    checkpoint (when a checkpoint dir is configured) carries the same
+    value, so `--resume` continues exactly where the run stopped.
+    """
+
+    def __init__(self, epoch: int, reason: str = "signal"):
+        super().__init__(f"preempted at epoch {epoch} ({reason})")
+        self.epoch = int(epoch)
+        self.reason = reason
+
+
+class PreemptionHandler:
+    """Shutdown-request flag + optional signal installation."""
+
+    def __init__(self):
+        self._reason: Optional[str] = None
+
+    @property
+    def requested(self) -> bool:
+        return self._reason is not None
+
+    @property
+    def reason(self) -> Optional[str]:
+        return self._reason
+
+    def request(self, reason: str) -> None:
+        """Ask for a checkpoint + exit at the next epoch boundary.
+        Idempotent; callable from signal context (only sets a flag)."""
+        if self._reason is None:
+            self._reason = reason
+
+    @contextlib.contextmanager
+    def installed(self, enabled: bool = True):
+        """Context manager installing SIGTERM/SIGINT handlers around a
+        training run, restoring the previous handlers on exit. A no-op
+        (flag-only operation still works) when `enabled` is False, when
+        not in the main thread, or under PIPEGCN_NO_SIGNAL_HANDLERS=1."""
+        if (not enabled
+                or os.environ.get("PIPEGCN_NO_SIGNAL_HANDLERS") == "1"
+                or threading.current_thread() is not threading.main_thread()):
+            yield self
+            return
+
+        def _on_signal(signum, frame):
+            if self.requested and signum == signal.SIGINT:
+                # second Ctrl-C: the user wants out NOW
+                raise KeyboardInterrupt
+            try:
+                name = signal.Signals(signum).name
+            except ValueError:
+                name = str(signum)
+            self.request(name)
+
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.getsignal(sig)
+            signal.signal(sig, _on_signal)
+        try:
+            yield self
+        finally:
+            for sig, h in prev.items():
+                signal.signal(sig, h)

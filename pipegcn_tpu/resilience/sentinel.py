@@ -1,0 +1,112 @@
+"""Divergence sentinel: host-side health checks over per-epoch metrics.
+
+The jitted step already returns the global mean loss and the l2 norm of
+the reduced gradient (trainer.py step metrics, PR 1), so detection is
+free — no extra device work, just float comparisons on scalars the
+epoch loop was going to harvest anyway. The sentinel is a pure host
+object: fit() asks it to `check` each dispatched block and performs the
+rollback itself (restore last good state, scale the LR down, optionally
+flush the stale halo carry), bounded by `max_retries` consecutive
+failed attempts.
+
+Trip conditions, in order:
+  - non-finite loss or grad norm (always on)
+  - grad norm above `grad_norm_max` (absolute cap; 0 disables)
+  - loss above `loss_factor` x the median of the recent healthy-loss
+    window (relative explosion; needs `warmup` healthy epochs first so
+    the noisy first epochs never trip it; 0 disables)
+
+Only healthy blocks feed the baseline window, so a slow upward drift
+into divergence cannot drag the baseline up with it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged and the bounded retries were exhausted."""
+
+
+@dataclasses.dataclass
+class SentinelConfig:
+    # relative explosion threshold: loss > loss_factor * median(recent
+    # healthy losses); 0 disables the relative check
+    loss_factor: float = 10.0
+    # absolute grad-norm cap; 0 disables
+    grad_norm_max: float = 0.0
+    # consecutive failed recovery attempts before giving up
+    max_retries: int = 3
+    # LR multiplier applied on every trip (1.0 = no backoff)
+    lr_backoff: float = 0.5
+    # zero the pipelined comm carry on rollback: the retried epoch then
+    # consumes zero halos exactly like epoch 0 — the staleness-1
+    # pipeline restarts its warmup instead of re-ingesting boundary
+    # data produced by the divergent trajectory
+    flush_on_trip: bool = True
+    # epochs between in-memory last-good snapshots (a host copy of the
+    # full state; cadence bounds both the copy cost and the work lost
+    # to a rollback)
+    snapshot_every: int = 25
+    # healthy epochs required before the relative loss check arms
+    warmup: int = 5
+    # healthy-loss window the baseline median is taken over
+    window: int = 32
+
+
+class DivergenceSentinel:
+    """Stateful checker; one instance per fit() run."""
+
+    def __init__(self, cfg: Optional[SentinelConfig] = None):
+        self.cfg = cfg or SentinelConfig()
+        self._healthy = deque(maxlen=max(int(self.cfg.window), 1))
+        self.trips = 0
+
+    def baseline(self) -> Optional[float]:
+        """Median of the recent healthy losses, or None pre-warmup."""
+        if len(self._healthy) < max(int(self.cfg.warmup), 1):
+            return None
+        return float(np.median(np.asarray(self._healthy)))
+
+    def check(self, first_epoch: int, losses, grad_norms) -> Optional[str]:
+        """Inspect one dispatched block (epochs [first_epoch,
+        first_epoch + k)). Returns a human-readable trip reason, or
+        None when healthy — in which case the losses join the baseline
+        window."""
+        cfg = self.cfg
+        losses = np.atleast_1d(np.asarray(losses, np.float64))
+        gn = np.atleast_1d(np.asarray(grad_norms, np.float64))
+        bad = ~np.isfinite(losses)
+        if bad.any():
+            e = first_epoch + int(np.argmax(bad))
+            self.trips += 1
+            return f"non-finite loss {float(losses[np.argmax(bad)])} " \
+                   f"at epoch {e}"
+        bad = ~np.isfinite(gn)
+        if bad.any():
+            e = first_epoch + int(np.argmax(bad))
+            self.trips += 1
+            return f"non-finite grad norm at epoch {e}"
+        if cfg.grad_norm_max > 0:
+            bad = gn > cfg.grad_norm_max
+            if bad.any():
+                e = first_epoch + int(np.argmax(bad))
+                self.trips += 1
+                return (f"grad norm {gn[np.argmax(bad)]:.4g} > cap "
+                        f"{cfg.grad_norm_max:.4g} at epoch {e}")
+        base = self.baseline() if cfg.loss_factor > 0 else None
+        if base is not None and base > 1e-12:
+            bad = losses > cfg.loss_factor * base
+            if bad.any():
+                e = first_epoch + int(np.argmax(bad))
+                self.trips += 1
+                return (f"loss {losses[np.argmax(bad)]:.4g} > "
+                        f"{cfg.loss_factor:g}x healthy median "
+                        f"{base:.4g} at epoch {e}")
+        self._healthy.extend(losses.tolist())
+        return None

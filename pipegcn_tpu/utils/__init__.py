@@ -1,4 +1,12 @@
-from .checkpoint import save_pytree, load_pytree, save_checkpoint, load_checkpoint
+from .checkpoint import (
+    CheckpointCorrupt,
+    checkpoint_exists,
+    load_checkpoint,
+    load_pytree,
+    peek_epoch,
+    save_checkpoint,
+    save_pytree,
+)
 from .timer import CommTimer
 
 __all__ = [
@@ -6,5 +14,8 @@ __all__ = [
     "load_pytree",
     "save_checkpoint",
     "load_checkpoint",
+    "checkpoint_exists",
+    "peek_epoch",
+    "CheckpointCorrupt",
     "CommTimer",
 ]

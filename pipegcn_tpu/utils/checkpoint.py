@@ -1,4 +1,4 @@
-"""Checkpointing: pytrees <-> npz files.
+"""Checkpointing: pytrees <-> npz files, hardened for production runs.
 
 The reference only saves the best model's state_dict at the end of
 training (train.py:397) — and into a directory it never creates (latent
@@ -9,15 +9,41 @@ epoch) can be checkpointed and resumed, which the reference cannot do.
 Format: one .npz per pytree, leaves keyed by their tree path; loading
 restores into the structure of a caller-provided template pytree (shapes
 and paths must match).
+
+Hardening (docs/RESILIENCE.md):
+
+  - every stored array carries a CRC32 digest (over dtype+shape+bytes)
+    in a ``__digests__`` manifest inside the npz; loads verify what
+    they read, so silent bit-rot on a shared filesystem surfaces as
+    :class:`CheckpointCorrupt` instead of NaNs three epochs later
+  - a checkpoint directory holds keep-last-N *generations*
+    (``state-<epoch08d>.npz``) plus a ``latest`` pointer file;
+    :func:`load_checkpoint` falls back to the previous good generation
+    when the newest fails verification
+  - truncated / torn / scribbled archives (zipfile.BadZipFile, EOF,
+    zlib errors) raise :class:`CheckpointCorrupt` rather than escaping
+    raw, so the rotation fallback — and callers like ``peek_epoch`` —
+    can handle them
+  - the legacy single-file ``state.npz`` layout still loads (as the
+    oldest-priority candidate), so pre-rotation checkpoints resume
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict
+import re
+import warnings
+import zipfile
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file failed to open, read, or verify."""
 
 
 def _path_str(path) -> str:
@@ -36,6 +62,21 @@ _BF16 = np.dtype(jax.numpy.bfloat16.dtype)
 # np.savez round-trips ml_dtypes.bfloat16 as raw void ('|V2'); store such
 # leaves as a uint16 view under a tagged key instead
 _BF16_TAG = "__bf16__/"
+# JSON manifest {stored key: crc32} written alongside the arrays
+_DIGEST_KEY = "__digests__"
+
+# read-side failure modes of a truncated/scribbled npz: the zip central
+# directory (BadZipFile), a short member (EOFError/OSError), or the
+# member's deflate stream (zlib.error)
+_READ_ERRORS = (zipfile.BadZipFile, EOFError, OSError, zlib.error)
+
+
+def _crc(arr: np.ndarray) -> int:
+    """CRC32 over dtype + shape + raw bytes: a reinterpreted view or a
+    resized array must not collide with the original."""
+    arr = np.ascontiguousarray(arr)
+    h = zlib.crc32(f"{arr.dtype.str}|{arr.shape}|".encode())
+    return zlib.crc32(arr.tobytes(), h) & 0xFFFFFFFF
 
 
 def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
@@ -44,7 +85,7 @@ def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
     template's paths)."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    arrays = dict(extra or {})
+    arrays = {k: np.asarray(v) for k, v in (extra or {}).items()}
     for p, v in leaves:
         arr = np.asarray(v)
         key = _path_str(p)
@@ -52,6 +93,8 @@ def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
             arrays[_BF16_TAG + key] = arr.view(np.uint16)
         else:
             arrays[key] = arr
+    arrays[_DIGEST_KEY] = np.asarray(
+        json.dumps({k: _crc(v) for k, v in arrays.items()}))
     # temp + atomic rename: an interrupted save (disk full, SIGTERM,
     # crash-handler save racing a second failure) must never destroy
     # the previous good checkpoint at `path`. The pid in the temp name
@@ -71,24 +114,59 @@ def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
                 pass
 
 
-def load_pytree(path: str, template: Any, *, with_extras: bool = False):
+def load_pytree(path: str, template: Any, *, with_extras: bool = False,
+                verify: bool = True):
     """Load arrays saved by save_pytree into template's structure.
 
     With with_extras=True returns (tree, extras) where extras holds the
     non-leaf keys (the `extra=` dict passed to save_pytree), so callers
-    needing both never reopen the archive."""
+    needing both never reopen the archive.
+
+    verify=True (default) checks each array it reads against the
+    ``__digests__`` manifest when one is present (files written before
+    the manifest existed load unverified). Open/read failures and
+    digest mismatches raise :class:`CheckpointCorrupt`; a missing leaf
+    or shape mismatch still raises KeyError/ValueError — those are
+    template/config errors, not file corruption."""
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     leaf_keys = set()
     extras = {}
-    with np.load(path) as data:
+    try:
+        data = np.load(path)
+    except _READ_ERRORS as exc:
+        raise CheckpointCorrupt(
+            f"cannot open checkpoint {path}: {exc!r}") from exc
+    try:
+        digests = None
+        if verify and _DIGEST_KEY in data.files:
+            try:
+                digests = json.loads(str(data[_DIGEST_KEY][()]))
+            except (*_READ_ERRORS, ValueError) as exc:
+                raise CheckpointCorrupt(
+                    f"unreadable digest manifest in {path}: {exc!r}"
+                ) from exc
+
+        def read(key: str) -> np.ndarray:
+            try:
+                arr = data[key]
+            except _READ_ERRORS as exc:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: member {key!r} unreadable "
+                    f"({exc!r})") from exc
+            if digests is not None and key in digests \
+                    and _crc(arr) != digests[key]:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: digest mismatch for {key!r}")
+            return arr
+
         for p, tmpl in paths:
             key = _path_str(p)
             if _BF16_TAG + key in data:
-                arr = data[_BF16_TAG + key].view(_BF16)
+                arr = read(_BF16_TAG + key).view(_BF16)
                 leaf_keys.add(_BF16_TAG + key)
             elif key in data:
-                arr = data[key]
+                arr = read(key)
                 leaf_keys.add(key)
             else:
                 raise KeyError(f"checkpoint {path} missing leaf {key}")
@@ -106,73 +184,209 @@ def load_pytree(path: str, template: Any, *, with_extras: bool = False):
             leaves.append(arr)
         if with_extras:
             for key in data.files:
-                if key not in leaf_keys:
-                    extras[key] = data[key]
+                if key not in leaf_keys and key != _DIGEST_KEY:
+                    extras[key] = read(key)
+    finally:
+        data.close()
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
     return (tree, extras) if with_extras else tree
 
 
-def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int) -> None:
-    """Save full training state for resume.
+# ---------------- generations + latest pointer -------------------------
 
-    The epoch rides INSIDE state.npz (one atomic os.replace), so a
-    crash between writes can never pair a new state with an old epoch
-    number — which would double-step the optimizer on resume."""
+_GEN_RE = re.compile(r"^state-(\d{8})\.npz$")
+_LATEST = "latest"
+
+
+def _gen_name(epoch: int) -> str:
+    return f"state-{epoch:08d}.npz"
+
+
+def _generations(directory: str) -> List[Tuple[int, str]]:
+    """[(epoch, path)] of on-disk generations, newest first; the legacy
+    single-file ``state.npz`` (if any) rides last with epoch -1 so it
+    is always the final fallback."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for n in names:
+        m = _GEN_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, n)))
+    out.sort(reverse=True)
+    legacy = os.path.join(directory, "state.npz")
+    if os.path.exists(legacy):
+        out.append((-1, legacy))
+    return out
+
+
+def latest_checkpoint_path(directory: str) -> Optional[str]:
+    """Path the ``latest`` pointer names — or the newest generation by
+    filename when the pointer is missing/stale. None when the directory
+    holds no checkpoint at all."""
+    lp = os.path.join(directory, _LATEST)
+    try:
+        with open(lp) as f:
+            name = os.path.basename(f.read().strip())
+        p = os.path.join(directory, name)
+        if name and os.path.exists(p):
+            return p
+    except OSError:
+        pass
+    gens = _generations(directory)
+    return gens[0][1] if gens else None
+
+
+def _candidates(directory: str) -> List[str]:
+    """Load order: the latest pointer's target, then remaining
+    generations newest-first, then the legacy state.npz."""
+    first = latest_checkpoint_path(directory)
+    out = [first] if first else []
+    for _, p in _generations(directory):
+        if p not in out:
+            out.append(p)
+    return out
+
+
+def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
+                    keep: int = 3) -> str:
+    """Save full training state for resume; returns the generation path.
+
+    The epoch rides INSIDE the npz (one atomic os.replace), so a crash
+    between writes can never pair a new state with an old epoch number
+    — which would double-step the optimizer on resume. Each save writes
+    a new ``state-<epoch>.npz`` generation, repoints ``latest``
+    atomically, and prunes generations beyond the newest `keep`
+    (keep <= 0 keeps everything; the legacy state.npz is never
+    pruned — it may be the only pre-rotation fallback)."""
     os.makedirs(directory, exist_ok=True)
     _sweep_stale_tmps(directory)
-    save_pytree(os.path.join(directory, "state.npz"), state,
+    path = os.path.join(directory, _gen_name(epoch))
+    save_pytree(path, state,
                 extra={"__epoch__": np.asarray(epoch, np.int64)})
+    lp = os.path.join(directory, _LATEST)
+    tmp = f"{lp}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(path) + "\n")
+        os.replace(tmp, lp)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    if keep and keep > 0:
+        gens = [g for g in _generations(directory) if g[0] >= 0]
+        for _, p in gens[keep:]:
+            if os.path.abspath(p) == os.path.abspath(path):
+                continue
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    return path
 
 
 def _sweep_stale_tmps(directory: str, min_age_s: float = 3600.0) -> None:
-    """Remove orphaned pid-named *.tmp.npz left by a hard kill
-    mid-save. Age-gated so a live peer process's in-flight temp (the
-    multi-host concurrent-save case the pid naming exists for) is never
-    touched."""
+    """Remove orphaned pid-named temps (*.tmp.npz, latest.*.tmp) left
+    by a hard kill mid-save. Age-gated so a live peer process's
+    in-flight temp (the multi-host concurrent-save case the pid naming
+    exists for) is never touched."""
     import glob
     import time
 
     now = time.time()
-    for tmp in glob.glob(os.path.join(directory, "*.tmp.npz")):
-        try:
-            if now - os.path.getmtime(tmp) > min_age_s:
-                os.remove(tmp)
-        except OSError:
-            pass
+    for pat in ("*.tmp.npz", f"{_LATEST}.*.tmp"):
+        for tmp in glob.glob(os.path.join(directory, pat)):
+            try:
+                if now - os.path.getmtime(tmp) > min_age_s:
+                    os.remove(tmp)
+            except OSError:
+                pass
 
 
 def _legacy_epoch(directory: str) -> int:
     """Epoch of a pre-__epoch__ checkpoint layout (epoch.txt alongside
-    state.npz). Raises if unreadable — a silent default would let
-    callers resume from the wrong epoch."""
-    with open(os.path.join(directory, "epoch.txt")) as f:
-        return int(f.read().strip())
+    state.npz). Raises CheckpointCorrupt if unreadable — a silent
+    default would let callers resume from the wrong epoch."""
+    try:
+        with open(os.path.join(directory, "epoch.txt")) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError) as exc:
+        raise CheckpointCorrupt(
+            f"legacy checkpoint in {directory} has no readable "
+            f"epoch.txt ({exc!r})") from exc
+
+
+def _epoch_of(path: str, directory: str) -> int:
+    """Epoch recorded inside generation `path` (reads only the scalar;
+    npz members load lazily)."""
+    try:
+        with np.load(path) as data:
+            if "__epoch__" in data.files:
+                return int(data["__epoch__"])
+    except _READ_ERRORS as exc:
+        raise CheckpointCorrupt(
+            f"cannot read epoch from {path}: {exc!r}") from exc
+    return _legacy_epoch(directory)
 
 
 def load_checkpoint(directory: str, template: Dict[str, Any]):
-    """Returns (state, next_epoch) restored from save_checkpoint."""
-    state, extras = load_pytree(os.path.join(directory, "state.npz"),
-                                template, with_extras=True)
-    if "__epoch__" in extras:
-        epoch = int(extras["__epoch__"])
-    else:
-        epoch = _legacy_epoch(directory)
-    return state, epoch
+    """Returns (state, next_epoch) restored from save_checkpoint.
+
+    Tries the ``latest`` generation first and falls back through older
+    generations (warning on each corrupt one) — a torn or bit-rotted
+    newest file costs the epochs since the previous save, not the run.
+    Raises :class:`CheckpointCorrupt` when every candidate fails, and
+    FileNotFoundError when there is no checkpoint at all."""
+    cands = _candidates(directory)
+    if not cands:
+        raise FileNotFoundError(f"no checkpoint in {directory}")
+    last_exc: Optional[CheckpointCorrupt] = None
+    for path in cands:
+        try:
+            state, extras = load_pytree(path, template, with_extras=True)
+            epoch = (int(extras["__epoch__"]) if "__epoch__" in extras
+                     else _legacy_epoch(directory))
+            if last_exc is not None:
+                warnings.warn(
+                    f"restored previous good checkpoint generation "
+                    f"{os.path.basename(path)} (epoch {epoch})")
+            return state, epoch
+        except CheckpointCorrupt as exc:
+            last_exc = exc
+            warnings.warn(
+                f"checkpoint generation {os.path.basename(path)} failed "
+                f"verification ({exc}); falling back")
+    raise CheckpointCorrupt(
+        f"every checkpoint generation in {directory} failed "
+        f"verification; last error: {last_exc}")
 
 
 def checkpoint_exists(directory: str) -> bool:
-    return os.path.exists(os.path.join(directory, "state.npz"))
+    return bool(_candidates(directory))
 
 
 def peek_epoch(directory: str):
-    """Epoch of the checkpoint in `directory` without a state template
-    (npz members load lazily, so only the scalar is read). Returns None
-    if no checkpoint exists. Lets callers decide completed-vs-resume
-    before paying full state construction (e.g. Trainer build at 114M
-    edges, scripts/convergence_study.py)."""
-    if not checkpoint_exists(directory):
+    """Epoch of the newest readable checkpoint in `directory` without a
+    state template (npz members load lazily, so only the scalar is
+    read). Returns None if no checkpoint exists; raises
+    :class:`CheckpointCorrupt` when checkpoints exist but none is
+    readable. Lets callers decide completed-vs-resume before paying
+    full state construction (e.g. Trainer build at 114M edges,
+    scripts/convergence_study.py)."""
+    cands = _candidates(directory)
+    if not cands:
         return None
-    with np.load(os.path.join(directory, "state.npz")) as data:
-        if "__epoch__" in data.files:
-            return int(data["__epoch__"])
-    return _legacy_epoch(directory)
+    last_exc: Optional[CheckpointCorrupt] = None
+    for path in cands:
+        try:
+            return _epoch_of(path, directory)
+        except CheckpointCorrupt as exc:
+            last_exc = exc
+    raise CheckpointCorrupt(
+        f"every checkpoint generation in {directory} is unreadable; "
+        f"last error: {last_exc}")

@@ -81,11 +81,15 @@ def test_cli_inductive_and_skip_partition(tmp_path):
 
 
 def test_cli_checkpoint_resume(tmp_path):
+    from pipegcn_tpu.utils.checkpoint import checkpoint_exists
+
     ckpt = str(tmp_path / "ckpt")
     args = _args(tmp_path, ["--checkpoint-dir", ckpt,
                             "--checkpoint-every", "10"])
     run(args)
-    assert os.path.exists(os.path.join(ckpt, "state.npz"))
+    assert checkpoint_exists(ckpt)
+    # rotation layout: epoch-stamped generations + a latest pointer
+    assert os.path.exists(os.path.join(ckpt, "latest"))
     # resume picks up at the saved epoch and trains further
     args2 = _args(tmp_path, ["--checkpoint-dir", ckpt, "--resume",
                              "--skip-partition", "--n-epochs", "45"])
@@ -122,10 +126,12 @@ def test_cli_gat_end_to_end(tmp_path):
 def test_cli_checkpoint_resume_gat(tmp_path):
     """Checkpoint/resume is model-family agnostic (pytree npz): a GAT
     run resumes from its own attention-param state."""
+    from pipegcn_tpu.utils.checkpoint import checkpoint_exists
+
     ckpt = str(tmp_path / "ckpt_gat")
     run(_args(tmp_path, ["--model", "gat", "--checkpoint-dir", ckpt,
                          "--checkpoint-every", "10"]))
-    assert os.path.exists(os.path.join(ckpt, "state.npz"))
+    assert checkpoint_exists(ckpt)
     res = run(_args(tmp_path, ["--model", "gat", "--checkpoint-dir",
                                ckpt, "--resume", "--skip-partition",
                                "--n-epochs", "40"]))
@@ -150,7 +156,9 @@ def test_cli_crash_checkpoint(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="injected"):
         run(_args(tmp_path, ["--checkpoint-dir", ckpt,
                              "--checkpoint-every", "100"]))
-    assert os.path.exists(os.path.join(ckpt, "state.npz"))
+    from pipegcn_tpu.utils.checkpoint import checkpoint_exists
+
+    assert checkpoint_exists(ckpt)
     monkeypatch.setattr(Trainer, "train_epoch", orig)
     res = run(_args(tmp_path, ["--checkpoint-dir", ckpt, "--resume",
                                "--skip-partition"]))

@@ -1,0 +1,596 @@
+"""Fault-tolerance tests: divergence sentinel rollback, preemption,
+hardened checkpoints (digests / generations / fallback), and the
+fault-injection harness (docs/RESILIENCE.md).
+
+Everything here is marked `faults`; the in-process tests keep tier-1
+cheap (one tiny shared graph, P=2), the subprocess kill/resume matrix
+is additionally marked `slow`.
+"""
+
+import glob
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.obs import MetricsLogger, read_metrics, validate_record
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+from pipegcn_tpu.resilience import (
+    EXIT_PREEMPTED,
+    DivergenceError,
+    DivergenceSentinel,
+    FaultPlan,
+    Preempted,
+    PreemptionHandler,
+    SentinelConfig,
+    corrupt_latest_checkpoint,
+)
+from pipegcn_tpu.utils.checkpoint import (
+    CheckpointCorrupt,
+    checkpoint_exists,
+    latest_checkpoint_path,
+    load_checkpoint,
+    peek_epoch,
+    save_checkpoint,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(scope="module")
+def sharded():
+    g = synthetic_graph(num_nodes=300, avg_degree=6, n_feat=8, n_class=3,
+                        seed=1)
+    parts = partition_graph(g, 2, seed=0)
+    return ShardedGraph.build(g, parts, n_parts=2)
+
+
+def _trainer(sg, **tkw):
+    cfg = ModelConfig(layer_sizes=(sg.n_feat, 16, sg.n_class),
+                      dropout=0.0, train_size=sg.n_train_global)
+    tkw.setdefault("n_epochs", 12)
+    tkw.setdefault("log_every", 50)
+    return Trainer(sg, cfg, TrainConfig(**tkw))
+
+
+# ---------------- fault plan ------------------------------------------
+
+
+def test_fault_plan_grammar_and_single_shot():
+    p = FaultPlan.parse("nan-loss@5, sigterm@8,corrupt-ckpt@10")
+    assert len(p) == 3
+    assert p.remaining() == ["nan-loss@5", "sigterm@8", "corrupt-ckpt@10"]
+    # in-block injection consumes the entry exactly once
+    assert p.due_in("nan-loss", 4, 8) == 5
+    assert p.due_in("nan-loss", 4, 8) is None
+    # boundary faults fire at-or-after their epoch (fused blocks may
+    # never visit the exact boundary)
+    assert not p.due("sigterm", 7)
+    assert p.due("sigterm", 9)
+    assert not p.due("sigterm", 9)
+    with pytest.raises(ValueError, match="kind@epoch"):
+        FaultPlan.parse("nan-loss5")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("meteor@3")
+
+
+def test_fault_plan_resume_skip():
+    """A resumed run passes the same --fault-plan; lived-through entries
+    must not re-fire (sigterm@8 fired at the START of epoch 8, so a
+    resume at start_epoch=8 retires it — else it would preempt in a
+    loop forever)."""
+    p = FaultPlan.parse("nan-loss@5,sigterm@8,nan-loss@9")
+    p.skip_before(8)
+    assert p.remaining() == ["nan-loss@9"]
+    # fresh runs (start_epoch 0) keep everything
+    q = FaultPlan.parse("sigterm@0")
+    q.skip_before(0)
+    assert q.remaining() == ["sigterm@0"]
+
+
+# ---------------- sentinel (unit) -------------------------------------
+
+
+def test_sentinel_trip_conditions():
+    s = DivergenceSentinel(SentinelConfig(warmup=3, loss_factor=10.0,
+                                          grad_norm_max=100.0))
+    for e in range(3):
+        assert s.check(e, [1.0 - 0.1 * e], [1.0]) is None
+    assert "non-finite loss" in s.check(3, [float("nan")], [1.0])
+    assert "non-finite grad" in s.check(4, [0.5], [float("inf")])
+    assert "grad norm" in s.check(5, [0.5], [250.0])
+    # relative explosion against the healthy median (~0.9)
+    assert "healthy median" in s.check(6, [50.0], [1.0])
+    # tripped blocks never polluted the baseline; healthy ones pass
+    assert s.check(7, [0.8], [1.0]) is None
+    assert s.trips == 4
+
+
+def test_sentinel_pre_warmup_never_trips_relative():
+    s = DivergenceSentinel(SentinelConfig(warmup=5))
+    # wild but finite early losses are warmup noise, not divergence
+    assert s.check(0, [1e6], [1.0]) is None
+    assert s.check(1, [3.0], [1.0]) is None
+
+
+# ---------------- hardened checkpoints --------------------------------
+
+
+def test_checkpoint_rotation_and_latest_pointer(tmp_path):
+    d = str(tmp_path / "ck")
+    state = {"params": {"w": np.ones((3, 4), np.float32)}}
+    for ep in (10, 20, 30, 40):
+        save_checkpoint(d, state, ep, keep=2)
+    gens = sorted(os.path.basename(p)
+                  for p in glob.glob(os.path.join(d, "state-*.npz")))
+    assert gens == ["state-00000030.npz", "state-00000040.npz"]
+    assert os.path.basename(latest_checkpoint_path(d)) == \
+        "state-00000040.npz"
+    assert peek_epoch(d) == 40
+    _, ep = load_checkpoint(d, state)
+    assert ep == 40
+    # keep=0 disables pruning
+    save_checkpoint(d, state, 50, keep=0)
+    assert len(glob.glob(os.path.join(d, "state-*.npz"))) == 3
+
+
+def test_corrupt_newest_generation_falls_back(tmp_path):
+    """Acceptance: a corrupt newest generation is detected and load
+    falls back to the previous good one."""
+    d = str(tmp_path / "ck")
+    state = {"params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}}
+    save_checkpoint(d, {"params": {"w": state["params"]["w"] * 0}}, 10)
+    save_checkpoint(d, state, 20)
+    corrupt_latest_checkpoint(d)
+    with pytest.warns(UserWarning, match="falling back"):
+        back, ep = load_checkpoint(d, state)
+    assert ep == 10
+    np.testing.assert_array_equal(back["params"]["w"],
+                                  np.zeros((3, 4), np.float32))
+    # peek_epoch lazily reads ONLY the epoch scalar, so a scribble in
+    # another member may not surface there — it must not raise, and
+    # full loads (above) are what verify
+    assert peek_epoch(d) in (10, 20)
+
+
+def test_digest_detects_silent_tamper(tmp_path):
+    """A structurally valid npz whose leaf bytes changed (bit-rot,
+    partial overwrite) must fail the per-leaf digest, not load."""
+    d = str(tmp_path / "ck")
+    state = {"params": {"w": np.arange(6, dtype=np.float32)}}
+    save_checkpoint(d, state, 7)
+    path = latest_checkpoint_path(d)
+    z = dict(np.load(path))
+    z["params/w"] = z["params/w"] + 1.0  # rewrite WITHOUT digest update
+    np.savez_compressed(path, **z)
+    with pytest.raises(CheckpointCorrupt, match="digest mismatch"):
+        load_checkpoint(d, state)
+
+
+def test_truncated_checkpoint_raises_checkpoint_corrupt(tmp_path):
+    """Satellite: truncated/corrupt archives raise CheckpointCorrupt
+    from peek_epoch/load_checkpoint instead of escaping as raw
+    zipfile.BadZipFile/EOFError."""
+    d = str(tmp_path / "ck")
+    state = {"params": {"w": np.ones(4, np.float32)}}
+    save_checkpoint(d, state, 5)
+    for p in glob.glob(os.path.join(d, "state-*.npz")):
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(CheckpointCorrupt):
+        peek_epoch(d)
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint(d, state)
+    # legacy single-file layout gets the same treatment
+    d2 = str(tmp_path / "legacy")
+    os.makedirs(d2)
+    with open(os.path.join(d2, "state.npz"), "wb") as f:
+        f.write(b"PK\x03\x04 not a real zip")
+    assert checkpoint_exists(d2)
+    with pytest.raises(CheckpointCorrupt):
+        peek_epoch(d2)
+
+
+def test_fault_recovery_schema_records():
+    validate_record({"event": "fault", "kind": "divergence", "epoch": 5,
+                     "reason": "nan", "retry": 1})
+    validate_record({"event": "recovery", "kind": "divergence",
+                     "epoch": 7, "retries": 2})
+    with pytest.raises(ValueError, match="missing field 'kind'"):
+        validate_record({"event": "fault", "epoch": 5})
+    with pytest.raises(ValueError, match="expected integer"):
+        validate_record({"event": "recovery", "kind": "x",
+                         "epoch": "seven"})
+
+
+# ---------------- sentinel in the trainer loop ------------------------
+
+
+def test_sentinel_rollback_recovers_in_fit(sharded):
+    t = _trainer(sharded, enable_pipeline=True)
+    lr0 = t.tcfg.lr
+    buf = io.StringIO()
+    logs = []
+    res = t.fit(eval_graphs=None, log_fn=logs.append,
+                metrics=MetricsLogger(buf),
+                sentinel=DivergenceSentinel(SentinelConfig(
+                    snapshot_every=3, lr_backoff=0.5)),
+                fault_plan=FaultPlan.parse("nan-loss@5"))
+    recs = [json.loads(line) for line in buf.getvalue().splitlines()]
+    faults = [r for r in recs if r["event"] == "fault"]
+    assert [f["kind"] for f in faults] == ["divergence"]
+    assert faults[0]["epoch"] == 5 and faults[0]["retry"] == 1
+    assert any(r["event"] == "recovery" and r["kind"] == "divergence"
+               for r in recs)
+    # rollback target was an earlier snapshot, LR was backed off once
+    assert faults[0]["rollback_epoch"] < 5
+    assert abs(t.tcfg.lr - lr0 * 0.5) < 1e-12
+    # the run still completed every epoch (the faulted one re-ran)
+    epochs = [r["epoch"] for r in recs if r["event"] == "epoch"]
+    assert max(epochs) == t.tcfg.n_epochs - 1
+    assert epochs.count(5) == 2  # faulted + healthy retry
+    assert t.last_epoch == t.tcfg.n_epochs
+    # the healthy retry's loss is finite (the nan never re-fired)
+    retried = [r["loss"] for r in recs
+               if r["event"] == "epoch" and r["epoch"] == 5]
+    assert not np.isfinite(retried[0]) and np.isfinite(retried[1])
+    assert res is not None
+    assert any("sentinel tripped" in line for line in logs)
+
+
+def test_sentinel_gives_up_after_max_retries(sharded):
+    t = _trainer(sharded)
+    with pytest.raises(DivergenceError, match="retries were exhausted"):
+        t.fit(eval_graphs=None, log_fn=lambda s: None,
+              sentinel=DivergenceSentinel(SentinelConfig(
+                  max_retries=1, snapshot_every=100)),
+              fault_plan=FaultPlan.parse("nan-loss@4,nan-loss@4"))
+
+
+# ---------------- preemption ------------------------------------------
+
+
+def test_preemption_checkpoints_and_resumes(sharded, tmp_path):
+    ck = str(tmp_path / "ck")
+    t = _trainer(sharded)
+    pre = PreemptionHandler()
+    buf = io.StringIO()
+    with pytest.raises(Preempted) as ei:
+        t.fit(eval_graphs=None, log_fn=lambda s: None,
+              metrics=MetricsLogger(buf), checkpoint_dir=ck,
+              preemption=pre, fault_plan=FaultPlan.parse("sigterm@8"))
+    assert ei.value.epoch == 8
+    assert peek_epoch(ck) == 8
+    recs = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert any(r["event"] == "fault" and r["kind"] == "preemption"
+               and r["epoch"] == 8 for r in recs)
+    # resume with the SAME fault plan: skip_before retires sigterm@8
+    import jax
+
+    t2 = _trainer(sharded)
+    host, start = load_checkpoint(ck, jax.device_get(t2.state))
+    t2.restore_state(host)
+    plan = FaultPlan.parse("sigterm@8")
+    res = t2.fit(eval_graphs=None, log_fn=lambda s: None,
+                 start_epoch=start, checkpoint_dir=ck,
+                 preemption=PreemptionHandler(), fault_plan=plan)
+    assert np.isfinite(res["history"][-1][1]) if res["history"] else True
+    assert t2.last_epoch == t2.tcfg.n_epochs
+
+
+def test_preemption_handler_request_flag():
+    pre = PreemptionHandler()
+    assert not pre.requested
+    pre.request("SIGTERM")
+    pre.request("SIGINT")  # first reason wins
+    assert pre.requested and pre.reason == "SIGTERM"
+    # flag-only use never needs signal installation; disabled install
+    # is a no-op context
+    with pre.installed(enabled=False) as h:
+        assert h is pre
+
+
+# ---------------- crash checkpoint path (satellite) -------------------
+
+
+def test_crash_checkpoint_saves_and_resumes(sharded, tmp_path):
+    """A mid-fit exception saves the last good state (on top of the
+    periodic generations) and the run resumes from it."""
+    ck = str(tmp_path / "ck")
+    t = _trainer(sharded)
+    logs = []
+    with pytest.raises(RuntimeError, match="fault-injected crash"):
+        t.fit(eval_graphs=None, log_fn=logs.append, checkpoint_dir=ck,
+              checkpoint_every=4, fault_plan=FaultPlan.parse("crash@9"))
+    assert any("crash checkpoint saved" in line for line in logs)
+    # crash at the start of epoch 9 -> 9 epochs completed; the periodic
+    # generations at 4 and 8 are still on disk beneath it
+    assert peek_epoch(ck) == 9
+    import jax
+
+    t2 = _trainer(sharded)
+    host, start = load_checkpoint(ck, jax.device_get(t2.state))
+    assert start == 9
+    t2.restore_state(host)
+    res = t2.fit(eval_graphs=None, log_fn=lambda s: None,
+                 start_epoch=start)
+    assert t2.last_epoch == t2.tcfg.n_epochs
+    assert res is not None
+
+
+def test_crash_checkpoint_poisoned_buffer_skip(sharded, tmp_path,
+                                               monkeypatch):
+    """When the state cannot be materialized/saved (failed dispatch
+    poisoned the donated buffers), the crash handler must skip the save
+    — leaving the previous good checkpoint intact — and re-raise."""
+    import jax
+
+    import pipegcn_tpu.utils.checkpoint as ckpt_mod
+
+    ck = str(tmp_path / "ck")
+    t = _trainer(sharded)
+    # a known-good generation that must survive the failed crash-save
+    save_checkpoint(ck, jax.device_get(t.state), 2)
+
+    def poisoned(*a, **k):
+        raise RuntimeError("device buffers poisoned")
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", poisoned)
+    logs = []
+    with pytest.raises(RuntimeError, match="fault-injected crash"):
+        t.fit(eval_graphs=None, log_fn=logs.append, checkpoint_dir=ck,
+              checkpoint_every=100, fault_plan=FaultPlan.parse("crash@5"))
+    assert any("crash checkpoint failed" in line for line in logs)
+    monkeypatch.undo()
+    assert peek_epoch(ck) == 2  # the good generation survived
+
+
+# ---------------- sequential runner guard -----------------------------
+
+
+def test_sequential_divergence_guard(sharded):
+    cfg = ModelConfig(layer_sizes=(sharded.n_feat, 16, sharded.n_class),
+                      dropout=0.0, norm="layer",
+                      train_size=sharded.n_train_global,
+                      spmm_impl="bucket")
+    tcfg = TrainConfig(n_epochs=2, enable_pipeline=True, eval=False)
+    from pipegcn_tpu.parallel import SequentialRunner
+
+    buf = io.StringIO()
+    run = SequentialRunner(sharded, cfg, tcfg,
+                           metrics=MetricsLogger(buf),
+                           fault_plan=FaultPlan.parse("nan-loss@1"))
+    assert np.isfinite(run.run_epoch(0))
+    with pytest.raises(DivergenceError, match="non-finite loss"):
+        run.run_epoch(1)
+    recs = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert any(r["event"] == "fault" and r["kind"] == "divergence"
+               for r in recs)
+
+
+# ---------------- CLI wiring ------------------------------------------
+
+
+def _cli_args(tmp_path, extra):
+    from pipegcn_tpu.cli.parser import create_parser
+
+    base = [
+        "--dataset", "synthetic:400:6:8:3",
+        "--n-partitions", "2",
+        "--n-epochs", "12",
+        "--n-hidden", "16",
+        "--dropout", "0.0",
+        "--log-every", "50",
+        "--fix-seed", "--seed", "7",
+        "--no-eval",
+        "--partition-dir", str(tmp_path / "partitions"),
+        "--model-dir", str(tmp_path / "model"),
+        "--results-dir", str(tmp_path / "results"),
+    ]
+    return create_parser().parse_args(base + extra)
+
+
+def test_cli_resume_requires_checkpoint_dir(tmp_path):
+    """Satellite: --resume without --checkpoint-dir errors; --resume
+    with an empty checkpoint dir warns loudly and trains fresh."""
+    from pipegcn_tpu.cli.main import run
+
+    with pytest.raises(ValueError, match="--resume requires"):
+        run(_cli_args(tmp_path, ["--resume"]))
+    with pytest.warns(UserWarning, match="no checkpoint found"):
+        res = run(_cli_args(tmp_path, [
+            "--resume", "--checkpoint-dir", str(tmp_path / "empty_ck"),
+            "--n-epochs", "3"]))
+    assert res is not None
+
+
+def test_cli_fault_plan_recovery_and_preemption(tmp_path):
+    """Acceptance: --fault-plan nan-loss@5,sigterm@8 — the sentinel
+    recovers epoch 5, the preemption produces a resumable checkpoint at
+    8, and the resumed run completes the SAME total epoch count, all
+    visible as fault/recovery events in the metrics JSONL."""
+    from pipegcn_tpu.cli.main import run
+
+    ck = str(tmp_path / "ck")
+    mfile = str(tmp_path / "metrics.jsonl")
+    flags = ["--checkpoint-dir", ck, "--checkpoint-every", "10",
+             "--metrics-out", mfile, "--no-signal-handlers",
+             "--sentinel-snapshot-every", "3",
+             "--fault-plan", "nan-loss@5,sigterm@8"]
+    with pytest.raises(Preempted):
+        run(_cli_args(tmp_path, flags))
+    assert peek_epoch(ck) == 8
+    # resume (same plan, already-fired entries retire)
+    run(_cli_args(tmp_path, flags + ["--resume", "--skip-partition"]))
+    recs = read_metrics(mfile)
+    kinds = [r["kind"] for r in recs if r["event"] == "fault"]
+    assert "divergence" in kinds and "preemption" in kinds
+    assert any(r["event"] == "recovery" for r in recs)
+    # every epoch of the nominal schedule ran exactly once in the
+    # final timeline (the faulted epoch appears once extra, pre-trip)
+    epochs = [r["epoch"] for r in recs if r["event"] == "epoch"]
+    assert set(epochs) == set(range(12))
+    assert epochs.count(5) == 2
+
+
+def test_cli_corrupt_ckpt_fault_then_fallback(tmp_path):
+    """--fault-plan corrupt-ckpt@12: the NEWEST generation (the one
+    `latest` points to) is scribbled after its save; the resume detects
+    it via verification and falls back to the previous good generation
+    (epoch 8), re-running 8..14."""
+    from pipegcn_tpu.cli.main import run
+
+    ck = str(tmp_path / "ck")
+    run(_cli_args(tmp_path, [
+        "--checkpoint-dir", ck, "--checkpoint-every", "4",
+        "--fault-plan", "corrupt-ckpt@12"]))
+    # generations at 4, 8, 12 exist; 12 (= latest) is scribbled
+    assert len(glob.glob(os.path.join(ck, "state-*.npz"))) == 3
+    with pytest.warns(UserWarning, match="falling back"):
+        res = run(_cli_args(tmp_path, [
+            "--checkpoint-dir", ck, "--resume", "--skip-partition",
+            "--n-epochs", "14"]))
+    assert res is not None
+
+
+def test_await_partition_backoff(monkeypatch, capsys):
+    """Satellite: the artifact wait polls with exponential backoff +
+    jitter and logs progress."""
+    import time as time_mod
+
+    import pipegcn_tpu.cli.main as cli_main
+
+    sleeps = []
+    calls = {"n": 0}
+
+    class FakeSG:
+        num_parts = 4
+
+    class FakeShardedGraph:
+        @staticmethod
+        def exists(path):
+            calls["n"] += 1
+            return calls["n"] > 4
+
+        @staticmethod
+        def load(path):
+            return FakeSG()
+
+    monkeypatch.setattr(cli_main, "ShardedGraph", FakeShardedGraph)
+    monkeypatch.setattr(time_mod, "sleep", lambda s: sleeps.append(s))
+    sg = cli_main._await_partition_artifact("/nonexistent/p", 4,
+                                            timeout_s=300.0, poll_s=2.0)
+    assert sg.num_parts == 4
+    assert len(sleeps) == 4
+    # strictly growing (jitter never shrinks below the base) and capped
+    assert sleeps[1] > sleeps[0] and sleeps[2] > sleeps[1]
+    assert all(s <= 30.0 * 1.25 for s in sleeps)
+    assert "waiting for partition artifact" in capsys.readouterr().out
+
+
+# ---------------- subprocess chaos (exit codes) -----------------------
+
+
+def _spawn_cli(tmp_path, extra, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2")
+    cmd = [sys.executable, "-m", "pipegcn_tpu.cli.main",
+           "--dataset", "synthetic:400:6:8:3",
+           "--n-partitions", "2", "--n-epochs", "12",
+           "--n-hidden", "16", "--dropout", "0.0",
+           "--log-every", "50", "--fix-seed", "--seed", "7", "--no-eval",
+           "--partition-dir", str(tmp_path / "partitions"),
+           "--model-dir", str(tmp_path / "model"),
+           "--results-dir", str(tmp_path / "results")] + extra
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+
+
+def test_cli_preemption_exit_code_subprocess(tmp_path):
+    """Acceptance: a fault-injected SIGTERM exits with the distinct
+    resumable status (75/EX_TEMPFAIL) after saving a checkpoint; the
+    resumed process finishes the schedule and exits 0."""
+    ck = str(tmp_path / "ck")
+    mfile = str(tmp_path / "metrics.jsonl")
+    flags = ["--checkpoint-dir", ck, "--metrics-out", mfile,
+             "--fault-plan", "nan-loss@5,sigterm@8",
+             "--sentinel-snapshot-every", "3"]
+    r1 = _spawn_cli(tmp_path, flags)
+    assert r1.returncode == EXIT_PREEMPTED, (r1.stdout, r1.stderr)
+    assert "preempted at epoch 8" in r1.stdout
+    assert peek_epoch(ck) == 8
+    r2 = _spawn_cli(tmp_path, flags + ["--resume", "--skip-partition"])
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    recs = read_metrics(mfile)
+    kinds = [r["kind"] for r in recs if r["event"] == "fault"]
+    assert "divergence" in kinds and "preemption" in kinds
+    epochs = [r["epoch"] for r in recs if r["event"] == "epoch"]
+    assert set(epochs) == set(range(12))
+
+
+@pytest.mark.slow
+def test_cli_real_sigterm_kill_resume_matrix(tmp_path):
+    """Chaos: deliver a REAL SIGTERM to a running trainer subprocess,
+    assert the resumable exit, then resume and check the completed
+    epoch schedule and finite numerics."""
+    import signal
+    import time
+
+    ck = str(tmp_path / "ck")
+    mfile = str(tmp_path / "metrics.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2")
+    cmd = [sys.executable, "-m", "pipegcn_tpu.cli.main",
+           "--dataset", "synthetic:400:6:8:3",
+           "--n-partitions", "2", "--n-epochs", "4000",
+           "--n-hidden", "16", "--dropout", "0.0",
+           "--log-every", "1000", "--fix-seed", "--seed", "7", "--no-eval",
+           "--partition-dir", str(tmp_path / "partitions"),
+           "--model-dir", str(tmp_path / "model"),
+           "--results-dir", str(tmp_path / "results"),
+           "--checkpoint-dir", ck, "--metrics-out", mfile]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    # wait until epochs are flowing (metrics file grows), then SIGTERM
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if os.path.exists(mfile) and sum(
+                1 for r in read_metrics(mfile)
+                if r["event"] == "epoch") >= 5:
+            break
+        time.sleep(0.5)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == EXIT_PREEMPTED, out
+    saved = peek_epoch(ck)
+    assert saved is not None and saved >= 1
+    recs = read_metrics(mfile)
+    assert any(r["event"] == "fault" and r["kind"] == "preemption"
+               for r in recs)
+    # resume for a short remainder
+    r2 = _spawn_cli(tmp_path, ["--checkpoint-dir", ck, "--resume",
+                               "--skip-partition", "--metrics-out", mfile,
+                               "--n-epochs", str(saved + 5)],
+                    timeout=300)
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    epochs = sorted(set(r["epoch"] for r in read_metrics(mfile)
+                        if r["event"] == "epoch"))
+    assert epochs == list(range(saved + 5))
+    losses = [r["loss"] for r in read_metrics(mfile)
+              if r["event"] == "epoch"]
+    assert np.isfinite(losses).all()
