@@ -61,12 +61,41 @@ def _maybe_init_distributed(args) -> None:
     import jax
 
     n_nodes = math.ceil(args.n_partitions / args.parts_per_node)
-    if n_nodes > 1:
+    if n_nodes <= 1:
+        return
+    plat = (os.environ.get("PIPEGCN_PLATFORM")
+            or os.environ.get("JAX_PLATFORMS") or "")
+    if "cpu" in plat.lower():
+        # cross-process collectives on the CPU backend need an explicit
+        # implementation (jax >= 0.4.34 raises "Multiprocess
+        # computations aren't implemented on the CPU backend" without
+        # one); gloo is the bundled choice. Must be set BEFORE
+        # initialize(). Harmless if this jaxlib predates the option.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — older jax: no such config
+            pass
+    addr = f"{args.master_addr}:{args.port}"
+    timeout = int(getattr(args, "coordinator_timeout", 300))
+    try:
         jax.distributed.initialize(
-            coordinator_address=f"{args.master_addr}:{args.port}",
+            coordinator_address=addr,
             num_processes=n_nodes,
             process_id=args.node_rank,
+            initialization_timeout=timeout,
         )
+    except Exception as exc:
+        # without this, an unreachable coordinator used to hang the
+        # process forever (or die with a bare RPC error no operator
+        # could act on)
+        raise RuntimeError(
+            f"could not join the multi-host coordination service at "
+            f"{addr} as process {args.node_rank}/{n_nodes} within "
+            f"{timeout}s ({exc}). Check --master-addr/--port, that the "
+            f"rank-0 process is up and the port is reachable from this "
+            f"host, and raise --coordinator-timeout for slow pod "
+            f"bring-up.") from exc
 
 
 def prepare(args):
@@ -223,6 +252,30 @@ def run(args) -> dict:
     _maybe_init_distributed(args)
 
     from ..parallel.trainer import TrainConfig, Trainer
+    from ..resilience import CoordConfig, Coordinator
+
+    # cross-rank coordination: inactive (pure no-ops) in single-process
+    # runs, so fit() keeps one code path. Built BEFORE the partition
+    # build and started immediately: heartbeats must flow while this
+    # rank spends minutes partitioning / compiling, or its
+    # already-training-blocked peers would mistake the silence for
+    # death. The shared coordination dir (heartbeats + desync resync)
+    # defaults under the partition dir — the filesystem multi-host runs
+    # already share — keyed by the rendezvous endpoint so concurrent
+    # runs never cross-talk. The consensus channel itself needs the
+    # training mesh and is attached after the trainer build.
+    coord_dir = args.watchdog_dir or os.path.join(
+        args.partition_dir,
+        f"coord-{args.master_addr}-{args.port}")
+    coord = Coordinator(
+        cfg=CoordConfig(
+            dir=coord_dir,
+            watchdog_timeout=args.watchdog_timeout,
+            desync_every=args.desync_check_every,
+            desync_resync=args.desync_resync,
+        ),
+        log=print)
+    coord.start()
 
     sg, eval_graphs = prepare(args)
     # partition-size report (reference prints each rank's node count at
@@ -275,8 +328,11 @@ def run(args) -> dict:
     start_epoch = 0
     if args.resume:
         if checkpoint_exists(args.checkpoint_dir):
+            # host_state() (not device_get): the sharded comm carry is
+            # not process-addressable in multi-host runs; every process
+            # resumes together, so the allgather inside is lockstep
             host_state, start_epoch = load_checkpoint(
-                args.checkpoint_dir, jax.device_get(trainer.state)
+                args.checkpoint_dir, trainer.host_state()
             )
             trainer.restore_state(host_state)
             print(f"resumed from {args.checkpoint_dir} "
@@ -317,9 +373,14 @@ def run(args) -> dict:
             snapshot_every=args.sentinel_snapshot_every,
             flush_on_trip=args.sentinel_flush,
         ))
-    fault_plan = FaultPlan.parse(args.fault_plan) if args.fault_plan \
-        else None
+    fault_plan = FaultPlan.parse(args.fault_plan,
+                                 rank=jax.process_index()) \
+        if args.fault_plan else None
     preemption = PreemptionHandler()
+    # the coordinator has been heartbeating since before the partition
+    # build; now that the mesh and metrics sink exist, complete it
+    coord.attach_mesh(trainer.mesh)
+    coord.metrics = metrics
 
     try:
         with preemption.installed(enabled=not args.no_signal_handlers):
@@ -340,8 +401,10 @@ def run(args) -> dict:
                 sentinel=sentinel,
                 preemption=preemption,
                 fault_plan=fault_plan,
+                coord=coord,
             )
     finally:
+        coord.stop()
         # every record is already flushed; close releases the handle
         # even when training crashes mid-run
         if metrics is not None:
@@ -376,7 +439,7 @@ def run(args) -> dict:
 def cli_entry() -> None:
     import sys
 
-    from ..resilience import EXIT_PREEMPTED, Preempted
+    from ..resilience import EXIT_PREEMPTED, PeerLost, Preempted
     from .parser import create_parser
 
     args = create_parser().parse_args()
@@ -390,6 +453,20 @@ def cli_entry() -> None:
               f"rerun with --resume --checkpoint-dir "
               f"{args.checkpoint_dir!r} [exit {EXIT_PREEMPTED}]")
         sys.exit(EXIT_PREEMPTED)
+    except PeerLost as p:
+        # a dead peer is the platform's problem, not this state's: the
+        # crash checkpoint is valid, so the supervisor reschedules the
+        # whole pod and resumes — same contract as preemption. Exit via
+        # os._exit: a graceful sys.exit runs jax's atexit distributed
+        # shutdown, whose barrier can never complete with a dead peer —
+        # the coordination client then hard-aborts the process (SIGABRT)
+        # and the resumable status is lost.
+        print(f"peer lost ({p}); resumable — restart the pod with "
+              f"--resume --checkpoint-dir {args.checkpoint_dir!r} "
+              f"[exit {EXIT_PREEMPTED}]")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

@@ -85,6 +85,13 @@ def create_parser() -> argparse.ArgumentParser:
     parser.add_argument("--node-rank", "--node_rank", type=int, default=0)
     parser.add_argument("--parts-per-node", "--parts_per_node", type=int,
                         default=10)
+    parser.add_argument("--coordinator-timeout", "--coordinator_timeout",
+                        type=int, default=300,
+                        help="seconds to wait for the jax.distributed "
+                             "coordinator at --master-addr:--port before "
+                             "failing with an actionable error instead "
+                             "of hanging forever (single-host runs "
+                             "never connect)")
 
     parser.add_argument("--eval", action="store_true",
                         help="enable evaluation")
@@ -222,10 +229,43 @@ def create_parser() -> argparse.ArgumentParser:
     parser.add_argument("--fault-plan", "--fault_plan", type=str,
                         default="",
                         help="deterministic chaos injection: comma-"
-                             "separated kind@epoch entries (nan-loss, "
-                             "nan-grad, sigterm, crash, corrupt-ckpt), "
-                             "e.g. 'nan-loss@5,sigterm@8'; each fires "
-                             "once, host-side only")
+                             "separated kind@epoch[:rN] entries "
+                             "(nan-loss, nan-grad, sigterm, crash, "
+                             "corrupt-ckpt, desync, hang), e.g. "
+                             "'nan-loss@5:r1,sigterm@8'; each fires "
+                             "once, host-side only; :rN targets one "
+                             "rank (process index) in multi-host runs")
+    # ---- cross-rank coordination (docs/RESILIENCE.md multi-host) ----
+    parser.add_argument("--watchdog-timeout", "--watchdog_timeout",
+                        type=float, default=60.0,
+                        help="multi-host heartbeat watchdog: a peer "
+                             "rank silent on the shared partition "
+                             "filesystem for this many seconds raises "
+                             "PeerLost -> crash checkpoint -> resumable "
+                             "exit 75 instead of hanging the pod in a "
+                             "collective (0 disables; single-process "
+                             "runs never arm it)")
+    parser.add_argument("--watchdog-dir", "--watchdog_dir", type=str,
+                        default="",
+                        help="shared directory for heartbeat files and "
+                             "desync resync states (default: "
+                             "<partition-dir>/coord-<master-addr>-"
+                             "<port>, the filesystem multi-host runs "
+                             "already share)")
+    parser.add_argument("--desync-check-every", "--desync_check_every",
+                        type=int, default=0,
+                        help="epochs between cross-rank agreement "
+                             "checks of per-leaf CRC32 param digests "
+                             "through the consensus channel "
+                             "(0 disables; mismatch emits a 'desync' "
+                             "fault and aborts resumably unless "
+                             "--desync-resync)")
+    parser.add_argument("--desync-resync", "--desync_resync",
+                        action="store_true",
+                        help="on a detected cross-rank desync, resync "
+                             "every rank from rank 0's state (via the "
+                             "shared coordination dir) instead of "
+                             "aborting with the resumable exit 75")
     parser.add_argument("--no-signal-handlers", "--no_signal_handlers",
                         action="store_true",
                         help="do not install SIGTERM/SIGINT handlers "

@@ -109,6 +109,26 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         out["n_faults"] = len(faults)
         out["fault_kinds"] = kinds
         out["n_recoveries"] = len(recoveries)
+        # multi-host attribution: which rank observed each fault, and
+        # which raised the consensus-propagated ones (several ranks'
+        # JSONL streams may be concatenated into one file)
+        ranks: Dict[str, int] = {}
+        sources: Dict[str, int] = {}
+        agreed = 0
+        for r in faults:
+            if isinstance(r.get("rank"), int):
+                ranks[f"r{r['rank']}"] = ranks.get(f"r{r['rank']}", 0) + 1
+            if r.get("agreed"):
+                agreed += 1
+            src = r.get("source_rank")
+            if isinstance(src, int) and src >= 0:
+                sources[f"r{src}"] = sources.get(f"r{src}", 0) + 1
+        if ranks:
+            out["fault_ranks"] = ranks
+        if sources:
+            out["fault_source_ranks"] = sources
+        if agreed:
+            out["n_agreed_faults"] = agreed
 
     accs = [r["val_acc"] for r in evals
             if isinstance(r.get("val_acc"), (int, float))]
@@ -191,6 +211,15 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
         lines.append(f"  {'faults / recoveries':<26} "
                      f"{s['n_faults']} / {s.get('n_recoveries', 0)}"
                      f" ({kinds})")
+        if s.get("fault_ranks"):
+            by_rank = ", ".join(f"{k}x{n}" for k, n in
+                                sorted(s["fault_ranks"].items()))
+            lines.append(f"  {'faults by rank':<26} {by_rank}")
+        if s.get("fault_source_ranks"):
+            by_src = ", ".join(f"{k}x{n}" for k, n in
+                               sorted(s["fault_source_ranks"].items()))
+            lines.append(f"  {'consensus source ranks':<26} {by_src} "
+                         f"({s.get('n_agreed_faults', 0)} agreed)")
     row("best val", "best_val", "{:.4f}")
     row("best epoch", "best_epoch")
     row("test acc", "test_acc", "{:.4f}")

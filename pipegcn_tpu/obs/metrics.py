@@ -130,24 +130,31 @@ class MetricsLogger:
             **extra,
         })
 
-    def fault(self, kind: str, epoch: int, **extra) -> Dict[str, Any]:
+    def fault(self, kind: str, epoch: int, rank: Optional[int] = None,
+              **extra) -> Dict[str, Any]:
         """A detected fault: divergence trip, preemption request,
-        injected chaos fault, corrupt checkpoint generation. Extras
-        carry the kind-specific detail (reason, retry, trip values)."""
+        injected chaos fault, corrupt checkpoint generation, cross-rank
+        desync, lost peer. Extras carry the kind-specific detail
+        (reason, retry, trip values, source_rank/agreed for
+        consensus-driven actions). `rank` defaults to this process's
+        rank so multi-host JSONL streams stay attributable when merged."""
         return self.write({
             "event": "fault",
             "kind": str(kind),
             "epoch": int(epoch),
+            "rank": _local_rank() if rank is None else int(rank),
             **extra,
         })
 
-    def recovery(self, kind: str, epoch: int, **extra) -> Dict[str, Any]:
+    def recovery(self, kind: str, epoch: int, rank: Optional[int] = None,
+                 **extra) -> Dict[str, Any]:
         """A completed recovery from the matching fault kind (training
         progressed past the faulted epoch, or a resume restored)."""
         return self.write({
             "event": "recovery",
             "kind": str(kind),
             "epoch": int(epoch),
+            "rank": _local_rank() if rank is None else int(rank),
             **extra,
         })
 
@@ -188,6 +195,18 @@ def read_metrics(path: Union[str, "os.PathLike"]) -> List[Dict[str, Any]]:
 
 
 # ---------------- host probes (lazy jax) ------------------------------
+
+
+def _local_rank() -> int:
+    """This process's rank (jax.process_index) for fault/recovery
+    attribution; 0 in jax-free or uninitialized-backend contexts so the
+    logger itself stays importable without jax."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
 
 
 def device_info() -> Dict[str, Any]:

@@ -58,16 +58,26 @@ SUMMARY_FIELDS: Dict[str, str] = {
 }
 
 # one record per detected fault (divergence trip, preemption request,
-# injected fault, corrupt checkpoint generation); extras carry the
-# kind-specific detail (reason, retry count, trip values)
+# injected fault, corrupt checkpoint generation, cross-rank desync,
+# lost peer); extras carry the kind-specific detail (reason, retry
+# count, trip values). Multi-host extras the MetricsLogger always adds
+# (optional in the contract so v1 files stay valid):
+#   rank         integer — process that wrote the record
+#   source_rank  integer — rank that raised a consensus-propagated
+#                fault (-1 when several raised at once)
+#   agreed       boolean — the action executed in cross-rank lockstep
+#   peer_rank    integer — the silent peer of a peer-lost fault
 FAULT_FIELDS: Dict[str, str] = {
     "event": "string",           # "fault"
-    "kind": "string",            # divergence | preemption | injected | ...
+    "kind": "string",            # divergence | preemption | injected
+    #                              | desync | peer-lost | ...
     "epoch": "integer",          # epoch the fault surfaced at
 }
 
 # one record per completed recovery (training progressed past the
-# faulted epoch after rollback/backoff, or a resume restored state)
+# faulted epoch after rollback/backoff, a resume restored state, or a
+# desync resync adopted rank 0's state); carries the same optional
+# rank/agreement extras as fault records
 RECOVERY_FIELDS: Dict[str, str] = {
     "event": "string",           # "recovery"
     "kind": "string",            # matches the fault it recovers from

@@ -44,7 +44,7 @@ from ..obs.metrics import device_info, memory_snapshot, mesh_info
 from ..obs.trace import PhaseTimer, named_phase
 from ..ops.spmm import spmm_mean
 from ..partition.halo import ShardedGraph
-from ..resilience import DivergenceError, Preempted
+from ..resilience import DivergenceError, PeerLost, Preempted, SentinelConfig
 from ..train.losses import bce_logits_sum, cross_entropy_sum
 from ..train.metrics import calc_acc
 from ..train.optim import adam_init, adam_update
@@ -935,6 +935,29 @@ class Trainer:
         self.last_epoch = start_epoch + k  # see train_epoch
         return np.asarray(ms["loss"])
 
+    def host_state(self) -> Dict[str, Any]:
+        """Host-side copy of the full training state — the form the
+        sentinel snapshots, checkpoints and resume templates use.
+        Single-process: a plain device_get. Multi-process: the sharded
+        comm carry spans non-addressable devices (device_get raises),
+        so its global value is reassembled with an allgather — which
+        makes this a COLLECTIVE there: call it at the same program
+        point on every process (fit() only does so at lockstep
+        dispatch boundaries)."""
+        if jax.process_count() == 1:
+            return jax.device_get(self.state)
+        out = {k: jax.device_get(self.state[k])
+               for k in ("params", "opt", "norm")}
+        comm = self.state["comm"]
+        if comm:
+            from jax.experimental import multihost_utils
+
+            out["comm"] = jax.tree_util.tree_map(
+                np.asarray, multihost_utils.process_allgather(comm))
+        else:
+            out["comm"] = {}
+        return out
+
     def restore_state(self, host_state: Dict[str, Any]) -> None:
         """Device-place a host-side state pytree (a checkpoint load or
         a sentinel last-good snapshot) with the trainer's shardings —
@@ -985,6 +1008,7 @@ class Trainer:
         sentinel=None,
         preemption=None,
         fault_plan=None,
+        coord=None,
     ) -> Dict[str, Any]:
         """The single epoch loop (reference train.py:327-400): periodic
         evaluation, best-val/BN-stats tracking, timing with <5-epoch
@@ -1036,6 +1060,18 @@ class Trainer:
         deterministic host-side faults into the harvested metrics, the
         epoch boundary, and the checkpoint path — chaos testing only;
         the compiled device program is never altered.
+
+        `coord` (resilience.Coordinator or None) makes every recovery
+        decision above a cross-rank AGREEMENT in jax.distributed runs:
+        at each dispatch boundary the ranks OR-reduce a small fault
+        word (one tiny jitted psum), so a sentinel trip or preemption
+        request on ANY rank executes its rollback / checkpoint+exit on
+        ALL ranks in lockstep — a unilateral action would deadlock the
+        next collective. The coordinator also arms the heartbeat
+        watchdog (silent peers raise PeerLost instead of hanging the
+        pod) and the param-digest desync detector. An inactive
+        (single-process) coordinator degenerates to no-ops, so this
+        path is identical to coord=None.
 
         `checkpoint_keep` bounds the on-disk checkpoint generations
         (keep-last-N; utils/checkpoint.py rotation)."""
@@ -1142,31 +1178,113 @@ class Trainer:
         trip_horizon = None  # first epoch past the last trip: passing it
         #                      healthy = recovered (resets the counter)
         last_good = None     # (epoch, host snapshot) rollback target
-        if sentinel is not None:
-            last_good = (start_epoch, jax.device_get(self.state))
+        coord_on = coord is not None and coord.active
+        # a consensus-propagated peer trip needs the same rollback
+        # machinery whether or not the LOCAL sentinel is armed
+        if sentinel is not None or coord_on:
+            last_good = (start_epoch, self.host_state())
+        snap_every = max(int((sentinel.cfg if sentinel is not None
+                              else SentinelConfig()).snapshot_every), 1)
         if fault_plan is not None:
             # a resumed run gets the same --fault-plan; entries it
             # already lived through must not re-fire
             fault_plan.skip_before(start_epoch)
+        if coord is not None:
+            coord.start()
+            coord.set_checkpoint(checkpoint_dir, checkpoint_keep)
+            coord.note_progress(start_epoch)
+            if last_good is not None:
+                coord.note_snapshot(*last_good)
+            if coord_on and coord.cfg.desync_every > 0:
+                # digest agreement is a collective: every rank must
+                # reach it at the same epoch, so fused blocks must not
+                # straddle the cadence boundary
+                periods.append(coord.cfg.desync_every)
         try:
             while epoch < n_epochs:
                 # ---- boundary faults / preemption: the one point where
                 # the donated state is consistent and labeled ----
+                if coord is not None:
+                    coord.note_progress(epoch)
+                    # a dead peer can never complete a collective:
+                    # raise PeerLost BEFORE dispatching anything
+                    coord.check_peers()
                 if fault_plan is not None and fault_plan.due("crash", epoch):
                     raise RuntimeError(
                         f"fault-injected crash at epoch {epoch}")
+                if fault_plan is not None and fault_plan.due("hang", epoch):
+                    # simulate a wedged process: heartbeats stop too, so
+                    # the PEERS' watchdogs — not this rank — must act
+                    log_fn(f"fault-injected hang at epoch {epoch}")
+                    if coord is not None:
+                        coord.suspend_heartbeat()
+                    time.sleep(3600)
+                    raise RuntimeError("fault-injected hang expired")
+                if fault_plan is not None and \
+                        fault_plan.due("desync", epoch):
+                    # silently perturb THIS rank's replicated params —
+                    # the cross-rank divergence the desync detector
+                    # exists to catch. Rebuilt from LOCAL single-device
+                    # arrays: a device_put onto the global replicated
+                    # sharding is a cross-process collective, which
+                    # only this rank would run — the injection must
+                    # desynchronize the STATE, not the program
+                    host_p = jax.device_get(self.state["params"])
+                    host_p = jax.tree_util.tree_map(
+                        lambda a: (np.asarray(a)
+                                   * np.asarray(1.001, np.asarray(a).dtype)),
+                        host_p)
+                    local_devs = [d for d in self.mesh.devices.flat
+                                  if d.process_index == jax.process_index()]
+
+                    def _replicate_local(arr):
+                        shards = [jax.device_put(arr, d)
+                                  for d in local_devs]
+                        return jax.make_array_from_single_device_arrays(
+                            arr.shape, self._repl, shards)
+
+                    self.state = dict(self.state)
+                    self.state["params"] = jax.tree_util.tree_map(
+                        _replicate_local, host_p)
+                    log_fn(f"fault-injected param desync at epoch {epoch}")
                 preempt_reason = (preemption.reason
                                   if preemption is not None
                                   and preemption.requested else None)
                 if fault_plan is not None and \
                         fault_plan.due("sigterm", epoch):
                     preempt_reason = preempt_reason or "fault-plan sigterm"
+                preempt_extra = {}
+                if coord_on:
+                    # boundary consensus: a shutdown request on ANY rank
+                    # checkpoints + exits 75 on ALL ranks, in lockstep —
+                    # one rank leaving unilaterally deadlocks the rest
+                    agreed = coord.agree_boundary(
+                        preempt=preempt_reason is not None)
+                    if agreed.preempt:
+                        preempt_extra = {"agreed": True,
+                                         "source_rank": agreed.preempt_rank}
+                        if preempt_reason is None:
+                            preempt_reason = (
+                                f"peer preemption (rank "
+                                f"{agreed.preempt_rank})"
+                                if agreed.preempt_rank >= 0 else
+                                "peer preemption (multiple ranks)")
                 if preempt_reason is not None:
                     log_fn(f"preemption requested ({preempt_reason}); "
                            f"checkpointing at epoch boundary {epoch}")
                     if metrics is not None:
                         metrics.fault(kind="preemption", epoch=epoch,
-                                      reason=preempt_reason)
+                                      reason=preempt_reason,
+                                      **preempt_extra)
+                    if jax.process_count() > 1 and last_good is not None:
+                        # multi-process: the crash handler cannot fetch
+                        # the sharded comm carry directly; materialize
+                        # the boundary state HERE (every rank reaches
+                        # this point — the allgather is lockstep) so
+                        # the fallback save is exact, not stale
+                        last_good = (epoch, self.host_state())
+                        if coord is not None:
+                            coord.note_snapshot(*last_good)
                     # the crash handler below does the rank-0 save
                     raise Preempted(epoch, preempt_reason)
                 if profile_dir and not profiling and \
@@ -1253,47 +1371,102 @@ class Trainer:
                         )
                 # ---- divergence sentinel: check the block, roll back
                 # on trip (restore last good snapshot, back the LR off,
-                # flush the stale halo carry), bounded retries ----
+                # flush the stale halo carry), bounded retries. With an
+                # active coordinator the trip VERDICT is agreed across
+                # ranks first, so the rollback below runs in lockstep
+                # on the whole pod whichever rank tripped. ----
+                reason = None
+                trip_extra = {}
                 if sentinel is not None:
                     reason = sentinel.check(epoch, blk_losses, gn)
-                    if reason is not None:
-                        scfg = sentinel.cfg
-                        retries += 1
-                        rollback_to, good_state = last_good
-                        new_lr = (self.tcfg.lr * scfg.lr_backoff
-                                  if scfg.lr_backoff < 1.0 else self.tcfg.lr)
-                        log_fn(f"divergence sentinel tripped ({reason}); "
-                               f"retry {retries}/{scfg.max_retries}: "
-                               f"rollback to epoch {rollback_to}, "
-                               f"lr -> {new_lr:g}")
+                if coord_on:
+                    desync_local = False
+                    if coord.desync_due(epoch + chunk):
+                        desync_local = coord.desync_check(
+                            jax.device_get(self.state["params"]))
+                    agreed = coord.agree_step(trip_reason=reason,
+                                              desync=desync_local)
+                    if agreed.desync:
                         if metrics is not None:
                             metrics.fault(
-                                kind="divergence", epoch=epoch,
-                                reason=reason, retry=retries,
-                                rollback_epoch=rollback_to, lr=new_lr)
-                        # restore BEFORE a possible give-up so the crash
-                        # handler checkpoints the healthy state, not the
-                        # divergent one
-                        self.restore_state(good_state)
-                        self.last_epoch = rollback_to
-                        if retries > scfg.max_retries:
-                            raise DivergenceError(
-                                f"training diverged and "
-                                f"{scfg.max_retries} recovery retries "
-                                f"were exhausted: {reason}")
-                        if scfg.lr_backoff < 1.0:
-                            self.set_lr(new_lr)
-                            # the rebuilt step recompiles once per scan
-                            # length; exclude those blocks from timing
-                            seen_chunks.clear()
-                        if scfg.flush_on_trip and tcfg.enable_pipeline:
-                            self.reset_comm()
-                        trip_horizon = epoch + chunk
-                        pending = None  # in-flight eval snapshot is
-                        #                 from the rolled-back timeline
-                        eval_in_stream = False
-                        epoch = rollback_to
-                        continue
+                                kind="desync", epoch=epoch + chunk - 1,
+                                local_mismatch=bool(desync_local),
+                                mismatched_leaves=int(
+                                    coord.last_desync_mismatch),
+                                source_rank=agreed.desync_rank,
+                                agreed=True)
+                        if coord.cfg.desync_resync:
+                            log_fn(f"cross-rank param desync detected "
+                                   f"(source rank {agreed.desync_rank}); "
+                                   f"resyncing every rank from rank 0")
+                            coord.resync(self, epoch + chunk)
+                            if metrics is not None:
+                                metrics.recovery(kind="desync",
+                                                 epoch=epoch + chunk - 1,
+                                                 agreed=True)
+                        else:
+                            log_fn("cross-rank param desync detected; "
+                                   "aborting resumably (rank 0's state "
+                                   "rides the crash checkpoint)")
+                            if jax.process_count() > 1 \
+                                    and last_good is not None:
+                                # lockstep materialization, as in the
+                                # preemption branch
+                                last_good = (epoch + chunk,
+                                             self.host_state())
+                                if coord is not None:
+                                    coord.note_snapshot(*last_good)
+                            raise Preempted(
+                                epoch + chunk,
+                                "cross-rank parameter desync")
+                    if agreed.trip:
+                        trip_extra = {"agreed": True,
+                                      "source_rank": agreed.trip_rank}
+                        if reason is None:
+                            # a PEER tripped: execute the identical
+                            # rollback here or the pod desynchronizes
+                            reason = agreed.trip_reason()
+                if reason is not None:
+                    scfg = (sentinel.cfg if sentinel is not None
+                            else SentinelConfig())
+                    retries += 1
+                    rollback_to, good_state = last_good
+                    new_lr = (self.tcfg.lr * scfg.lr_backoff
+                              if scfg.lr_backoff < 1.0 else self.tcfg.lr)
+                    log_fn(f"divergence sentinel tripped ({reason}); "
+                           f"retry {retries}/{scfg.max_retries}: "
+                           f"rollback to epoch {rollback_to}, "
+                           f"lr -> {new_lr:g}")
+                    if metrics is not None:
+                        metrics.fault(
+                            kind="divergence", epoch=epoch,
+                            reason=reason, retry=retries,
+                            rollback_epoch=rollback_to, lr=new_lr,
+                            **trip_extra)
+                    # restore BEFORE a possible give-up so the crash
+                    # handler checkpoints the healthy state, not the
+                    # divergent one
+                    self.restore_state(good_state)
+                    self.last_epoch = rollback_to
+                    if retries > scfg.max_retries:
+                        raise DivergenceError(
+                            f"training diverged and "
+                            f"{scfg.max_retries} recovery retries "
+                            f"were exhausted: {reason}")
+                    if scfg.lr_backoff < 1.0:
+                        self.set_lr(new_lr)
+                        # the rebuilt step recompiles once per scan
+                        # length; exclude those blocks from timing
+                        seen_chunks.clear()
+                    if scfg.flush_on_trip and tcfg.enable_pipeline:
+                        self.reset_comm()
+                    trip_horizon = epoch + chunk
+                    pending = None  # in-flight eval snapshot is
+                    #                 from the rolled-back timeline
+                    eval_in_stream = False
+                    epoch = rollback_to
+                    continue
+                if last_good is not None:
                     if trip_horizon is not None and \
                             epoch + chunk >= trip_horizon:
                         log_fn(f"recovered past epoch {trip_horizon - 1} "
@@ -1305,10 +1478,10 @@ class Trainer:
                         retries = 0
                         trip_horizon = None
                     # healthy: refresh the rollback snapshot on cadence
-                    if epoch + chunk - last_good[0] >= max(
-                            int(sentinel.cfg.snapshot_every), 1):
-                        last_good = (epoch + chunk,
-                                     jax.device_get(self.state))
+                    if epoch + chunk - last_good[0] >= snap_every:
+                        last_good = (epoch + chunk, self.host_state())
+                        if coord is not None:
+                            coord.note_snapshot(*last_good)
                 epoch += chunk - 1  # body below sees the block's last epoch
                 if measure_comm_cost and not comm_measured and \
                         epoch >= min(start_epoch + 5, n_epochs - 1):
@@ -1360,25 +1533,28 @@ class Trainer:
                                 epoch + 1,
                                 float(np.mean(durs or [dur])), loss))
 
-                if checkpoint_dir and (epoch + 1) % checkpoint_every == 0 \
-                        and jax.process_index() == 0:
-                    # multi-host: every process holds identical state
-                    # (SPMD + replicated params); only process 0 writes
-                    # (reference semantics, and N-1 fewer multi-GB
-                    # writes to the shared filesystem)
-                    save_checkpoint(checkpoint_dir,
-                                    jax.device_get(self.state), epoch + 1,
-                                    keep=checkpoint_keep)
-                    if fault_plan is not None and \
-                            fault_plan.due("corrupt-ckpt", epoch + 1):
-                        from ..resilience.faults import \
-                            corrupt_latest_checkpoint
+                if checkpoint_dir and (epoch + 1) % checkpoint_every == 0:
+                    # every process materializes (host_state is a
+                    # lockstep allgather when the comm carry spans
+                    # processes); only process 0 writes (reference
+                    # semantics, and N-1 fewer multi-GB writes to the
+                    # shared filesystem)
+                    host = self.host_state()
+                    if jax.process_index() == 0:
+                        save_checkpoint(checkpoint_dir, host, epoch + 1,
+                                        keep=checkpoint_keep)
+                        if fault_plan is not None and \
+                                fault_plan.due("corrupt-ckpt", epoch + 1):
+                            from ..resilience.faults import \
+                                corrupt_latest_checkpoint
 
-                        p = corrupt_latest_checkpoint(checkpoint_dir)
-                        log_fn(f"fault-injected checkpoint corruption: {p}")
-                        if metrics is not None:
-                            metrics.fault(kind="injected", epoch=epoch + 1,
-                                          reason="corrupt-ckpt")
+                            p = corrupt_latest_checkpoint(checkpoint_dir)
+                            log_fn(f"fault-injected checkpoint "
+                                   f"corruption: {p}")
+                            if metrics is not None:
+                                metrics.fault(kind="injected",
+                                              epoch=epoch + 1,
+                                              reason="corrupt-ckpt")
                 epoch += 1
 
         except BaseException as exc:
@@ -1389,11 +1565,40 @@ class Trainer:
             # check above raises Preempted with the state consistent.
             # last_epoch labels self.state's buffers (see train_epoch);
             # if those buffers come from a FAILED dispatch, device_get
-            # below raises and the save is skipped — the previous
-            # periodic checkpoint survives (saves are atomic, and the
+            # below raises and the save falls back to the last host
+            # snapshot when one exists — the previous periodic
+            # checkpoint survives either way (saves are atomic, and the
             # generation rotation keeps the older good ones).
-            if checkpoint_dir and jax.process_index() == 0:
-                tag = ("preemption" if isinstance(exc, Preempted)
+            converted = None
+            if (coord is not None and coord.active
+                    and not isinstance(exc, (Preempted, PeerLost,
+                                             DivergenceError,
+                                             KeyboardInterrupt))):
+                # a failed collective looks like a generic runtime
+                # error; ask the watchdog whether a peer actually died
+                # before reporting it as a local crash
+                lost = coord.await_peer_verdict()
+                if lost is not None:
+                    log_fn(f"dispatch failed and peer rank {lost[0]} "
+                           f"stopped heartbeating ({lost[1]:.0f}s); "
+                           f"reporting PeerLost instead of a crash")
+                    converted = PeerLost(*lost)
+            eff = converted if converted is not None else exc
+            if metrics is not None and isinstance(eff, PeerLost):
+                try:
+                    metrics.fault(kind="peer-lost",
+                                  epoch=int(getattr(self, "last_epoch",
+                                                    start_epoch)),
+                                  peer_rank=eff.rank,
+                                  silent_s=eff.silent_s)
+                except Exception:  # noqa: BLE001 — still checkpoint
+                    pass
+            # every surviving rank saves on PeerLost (rank 0 may be the
+            # dead one); otherwise rank 0 only, as before
+            if checkpoint_dir and (jax.process_index() == 0
+                                   or isinstance(eff, PeerLost)):
+                tag = ("preemption" if isinstance(eff, Preempted)
+                       else "peer-lost" if isinstance(eff, PeerLost)
                        else "crash")
                 try:
                     done = int(getattr(self, "last_epoch",
@@ -1404,7 +1609,23 @@ class Trainer:
                     log_fn(f"{tag} checkpoint saved to "
                            f"{checkpoint_dir} (epoch {done})")
                 except Exception as save_exc:  # noqa: BLE001
-                    log_fn(f"{tag} checkpoint failed: {save_exc!r}")
+                    if last_good is not None:
+                        # poisoned buffers: the host-side snapshot is
+                        # still a valid, older resume point
+                        try:
+                            save_checkpoint(checkpoint_dir,
+                                            last_good[1], last_good[0],
+                                            keep=checkpoint_keep)
+                            log_fn(f"{tag} checkpoint fell back to the "
+                                   f"epoch-{last_good[0]} snapshot "
+                                   f"({save_exc!r})")
+                        except Exception as snap_exc:  # noqa: BLE001
+                            log_fn(f"{tag} checkpoint failed: "
+                                   f"{snap_exc!r}")
+                    else:
+                        log_fn(f"{tag} checkpoint failed: {save_exc!r}")
+            if converted is not None:
+                raise converted from exc
             raise
 
         if pending is not None:

@@ -12,8 +12,14 @@ all three (docs/RESILIENCE.md):
   preemption.py  SIGTERM/SIGINT → checkpoint at the next epoch boundary
                  and exit with a distinct resumable status code
   faults.py      deterministic fault-injection plans
-                 ("nan-loss@5,sigterm@8,corrupt-ckpt@10") for chaos
-                 testing the recovery paths
+                 ("nan-loss@5:r1,sigterm@8,corrupt-ckpt@10") for chaos
+                 testing the recovery paths; :rN targets one rank
+  coord.py       cross-rank coordination for jax.distributed runs —
+                 fault consensus (one tiny psum per dispatch boundary
+                 makes every recovery action lockstep across ranks),
+                 heartbeat watchdog (dead peers become PeerLost →
+                 resumable exit 75 instead of an infinite collective
+                 hang), and the param-digest desync detector
 
 Checkpoint hardening (per-leaf digests, keep-last-N generations,
 corrupt-generation fallback) lives in utils/checkpoint.py; the fault /
@@ -23,6 +29,15 @@ No reference counterpart: the reference's gloo collectives simply hang
 when any rank dies (SURVEY.md §5).
 """
 
+from .coord import (
+    Agreed,
+    CoordConfig,
+    Coordinator,
+    FaultConsensus,
+    HeartbeatWatchdog,
+    PeerLost,
+    digest_leaves,
+)
 from .faults import FaultPlan, corrupt_latest_checkpoint
 from .preemption import EXIT_PREEMPTED, Preempted, PreemptionHandler
 from .sentinel import DivergenceError, DivergenceSentinel, SentinelConfig
@@ -36,4 +51,11 @@ __all__ = [
     "PreemptionHandler",
     "FaultPlan",
     "corrupt_latest_checkpoint",
+    "Agreed",
+    "CoordConfig",
+    "Coordinator",
+    "FaultConsensus",
+    "HeartbeatWatchdog",
+    "PeerLost",
+    "digest_leaves",
 ]

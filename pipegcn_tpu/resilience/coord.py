@@ -1,0 +1,593 @@
+"""Cross-rank fault coordination for multi-process (multi-host) runs.
+
+PR 2's recovery machinery (docs/RESILIENCE.md) is *unilateral*: the
+sentinel rolls back, the preemption handler checkpoints and exits, the
+carry flushes — all decisions one process takes alone. In a
+``jax.distributed`` run every rank blocks in a collective every layer
+of every epoch, so a unilateral decision desynchronizes the SPMD
+program and the next ``halo_exchange`` deadlocks (the exact reference
+failure mode, SURVEY.md §5 — gloo collectives hang when any rank dies).
+This module makes every recovery decision *agreed* across ranks, makes
+dead peers *detected* instead of waited on, and catches silent
+cross-rank state divergence:
+
+  FaultConsensus    OR-reduces a small host-side fault word (sentinel
+                    trip + reason code, preemption request, desync bit)
+                    with one tiny jitted psum over the training mesh at
+                    each dispatch boundary; any rank raising a bit makes
+                    ALL ranks execute the matching recovery in lockstep
+  HeartbeatWatchdog each rank touches ``heartbeat-r<k>`` on the shared
+                    partition filesystem (the same out-of-band channel
+                    the partition-artifact wait uses) and watches peer
+                    mtimes; a silent peer raises :class:`PeerLost` at
+                    the next dispatch boundary — and a daemon-thread
+                    hard deadline converts "blocked forever inside a
+                    collective" into snapshot checkpoint + exit 75
+  desync detector   per-leaf CRC32 digests of the replicated params
+                    (utils/checkpoint.py's digest code) broadcast from
+                    rank 0 and compared on every rank at a configured
+                    cadence; mismatch is agreed through the consensus
+                    word and either resynced from rank 0's state or
+                    aborted resumably
+
+A single-process Coordinator is *inactive*: every method degenerates to
+a local no-op (no collectives, no watchdog), so ``fit()`` keeps one
+code path whether or not the run is distributed. "rank" throughout
+means ``jax.process_index()`` — the unit that can die independently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .preemption import EXIT_PREEMPTED
+
+# ---------------- fault word ------------------------------------------
+# One int32 vector per rank, summed across ranks by a single psum.
+# Bit slots sum to the number of raisers; rank slots carry (rank + 1)
+# so the source rank is recoverable when exactly one rank raised.
+WORD_LEN = 8
+IDX_TRIP, IDX_TRIP_CODE, IDX_TRIP_RANK = 0, 1, 2
+IDX_PREEMPT, IDX_PREEMPT_RANK = 3, 4
+IDX_DESYNC, IDX_DESYNC_RANK = 5, 6
+IDX_COUNT = 7
+
+# sentinel trip reasons compressed into a code (free text cannot ride a
+# psum); decoded best-effort on the receiving ranks
+TRIP_CODES = {
+    1: "non-finite loss",
+    2: "non-finite grad norm",
+    3: "grad-norm cap exceeded",
+    4: "loss explosion vs healthy median",
+    5: "divergence (unclassified)",
+}
+
+
+def trip_code_of(reason: Optional[str]) -> int:
+    """Compress a DivergenceSentinel trip reason into a wire code."""
+    if not reason:
+        return 0
+    if "non-finite loss" in reason:
+        return 1
+    if "non-finite grad" in reason:
+        return 2
+    if "cap" in reason or "grad norm" in reason:
+        return 3
+    if "healthy median" in reason:
+        return 4
+    return 5
+
+
+class PeerLost(RuntimeError):
+    """A peer rank stopped heartbeating: the pod cannot complete its
+    collectives. Raised at a dispatch boundary (or synthesized from a
+    failed collective); rides the trainer's crash-checkpoint path and
+    maps to the resumable exit status 75 in the CLI."""
+
+    def __init__(self, rank: int, silent_s: float):
+        super().__init__(
+            f"peer rank {rank} silent for {silent_s:.0f}s "
+            f"(heartbeat watchdog)")
+        self.rank = int(rank)
+        self.silent_s = float(silent_s)
+
+
+@dataclasses.dataclass
+class Agreed:
+    """Decoded OR-reduction of every rank's fault word. ``*_rank`` is
+    the raising rank when exactly one rank raised, else -1."""
+
+    trip: bool = False
+    trip_code: int = 0
+    trip_rank: int = -1
+    preempt: bool = False
+    preempt_rank: int = -1
+    desync: bool = False
+    desync_rank: int = -1
+    n_ranks: int = 1
+
+    def trip_reason(self) -> str:
+        what = TRIP_CODES.get(self.trip_code, "divergence")
+        who = (f"rank {self.trip_rank}" if self.trip_rank >= 0
+               else "multiple ranks")
+        return f"consensus: {who} tripped ({what})"
+
+
+def digest_leaves(tree: Any) -> np.ndarray:
+    """Per-leaf CRC32 digest vector (uint32, path-sorted) of a host
+    pytree — the same digest checkpoint verification uses, so a desync
+    report and a checkpoint manifest disagree on nothing."""
+    import jax
+
+    from ..utils.checkpoint import _crc, _path_str
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    leaves = sorted(leaves, key=lambda kv: _path_str(kv[0]))
+    return np.asarray([_crc(np.asarray(v)) for _, v in leaves], np.uint32)
+
+
+class FaultConsensus:
+    """One tiny jitted psum over the training mesh.
+
+    Each process contributes its word on its FIRST local device (zeros
+    on the rest), so the psum's result is the exact per-rank sum — no
+    normalization by local device count. ``broadcast0`` instead places
+    the vector on every local device and masks to mesh device 0, which
+    belongs to process 0, so every rank receives rank 0's vector."""
+
+    def __init__(self, mesh):
+        import jax
+
+        self._mesh = mesh
+        self._axis = mesh.axis_names[0]
+        self._pid = jax.process_index()  # fixed for the process's life
+        self._fns: Dict[str, Any] = {}
+
+    def _fn(self, mode: str):
+        if mode in self._fns:
+            return self._fns[mode]
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
+
+        axis = self._axis
+        if mode == "sum":
+            def body(w):
+                return jax.lax.psum(w, axis)
+        else:  # "bcast0": rank 0's row to everyone
+            def body(w):
+                idx = jax.lax.axis_index(axis)
+                return jax.lax.psum(
+                    jnp.where(idx == 0, w, jnp.zeros_like(w)), axis)
+        fn = jax.jit(jax.shard_map(
+            body, mesh=self._mesh,
+            in_specs=PartitionSpec(self._axis),
+            out_specs=PartitionSpec()))
+        self._fns[mode] = fn
+        return fn
+
+    def _scatter(self, vec: np.ndarray, every_device: bool):
+        """Build the [n_devices, len(vec)] global array whose local
+        shards carry this process's vector."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        devs = list(self._mesh.devices.flat)
+        sharding = NamedSharding(self._mesh, PartitionSpec(self._axis))
+        pid = self._pid
+        shards = []
+        first = True
+        zero = np.zeros_like(vec)
+        for d in devs:
+            if d.process_index != pid:
+                continue
+            row = vec if (every_device or first) else zero
+            first = False
+            shards.append(jax.device_put(row[None, :], d))
+        return jax.make_array_from_single_device_arrays(
+            (len(devs),) + vec.shape, sharding, shards)
+
+    def reduce(self, word: np.ndarray) -> np.ndarray:
+        """Element-wise sum of every rank's word (the OR-reduce: bit
+        slots become raiser counts)."""
+        import jax
+
+        word = np.asarray(word)
+        out = self._fn("sum")(self._scatter(word, every_device=False))
+        return np.asarray(jax.device_get(out))[0]
+
+    def broadcast0(self, vec: np.ndarray) -> np.ndarray:
+        """Rank 0's vector, delivered to every rank."""
+        import jax
+
+        vec = np.asarray(vec)
+        out = self._fn("bcast0")(self._scatter(vec, every_device=True))
+        return np.asarray(jax.device_get(out))[0]
+
+
+# tests monkeypatch this to observe the hard-deadline path without
+# killing the test process
+_hard_exit: Callable[[int], None] = os._exit
+
+
+class HeartbeatWatchdog:
+    """Heartbeat files + peer staleness detection on a shared dir.
+
+    A monitor thread touches ``heartbeat-r<rank>`` every ``interval_s``
+    and stats the peers'. A peer whose file is older than ``timeout_s``
+    (measured from this watchdog's start, so stale files from a
+    previous run never false-trip) marks it lost; ``check()`` then
+    raises :class:`PeerLost` from the main thread. If the main thread
+    never gets there — blocked inside a collective that can no longer
+    complete — the monitor thread itself fires ``on_deadline`` after a
+    further ``grace_s`` (the hard deadline)."""
+
+    def __init__(self, directory: str, rank: int, n_ranks: int,
+                 timeout_s: float, interval_s: Optional[float] = None,
+                 grace_s: Optional[float] = None,
+                 on_deadline: Optional[Callable[[int, float], None]] = None,
+                 log: Callable[[str], None] = print):
+        self.dir = directory
+        self.rank = int(rank)
+        self.n_ranks = int(n_ranks)
+        self.timeout = float(timeout_s)
+        self.interval = (float(interval_s) if interval_s
+                         else max(self.timeout / 4.0, 0.2))
+        self.grace = (float(grace_s) if grace_s is not None
+                      else max(self.timeout / 2.0, 2.0))
+        self.on_deadline = on_deadline
+        self.log = log
+        self._lost: Optional[Tuple[int, float]] = None
+        self._deadline: Optional[float] = None
+        self._handled = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._suspended = False
+        self._start_time = 0.0
+
+    def path_for(self, rank: int) -> str:
+        return os.path.join(self.dir, f"heartbeat-r{rank}")
+
+    @property
+    def lost(self) -> Optional[Tuple[int, float]]:
+        return self._lost
+
+    def start(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        self._start_time = time.time()
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"pipegcn-watchdog-r{self.rank}",
+            daemon=True)
+        self._thread.start()
+
+    def beat(self) -> None:
+        p = self.path_for(self.rank)
+        try:
+            with open(p, "a"):
+                os.utime(p, None)
+        except OSError:
+            pass  # a missed beat is survivable; a raise here is not
+
+    def suspend(self) -> None:
+        """Stop beating (the ``hang`` chaos fault: simulate a frozen
+        process so the PEERS' watchdogs trip)."""
+        self._suspended = True
+
+    def disarm(self) -> None:
+        """Main thread took responsibility for a detected loss: cancel
+        the hard deadline so the emergency exit never races a clean
+        PeerLost checkpoint."""
+        self._handled = True
+        self._deadline = None
+
+    def check(self) -> None:
+        """Raise PeerLost if a peer is flagged (dispatch-boundary call;
+        must happen BEFORE entering any collective — a dead peer can
+        never complete one)."""
+        if self._lost is not None:
+            self.disarm()
+            raise PeerLost(*self._lost)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4)
+        try:
+            os.remove(self.path_for(self.rank))
+        except OSError:
+            pass
+
+    # ---------------- monitor thread ----------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            if not self._suspended:
+                self.beat()
+            now = time.time()
+            self._scan(now)
+            if (self._lost is not None and not self._handled
+                    and self._deadline is not None
+                    and now > self._deadline
+                    and self.on_deadline is not None):
+                self._handled = True
+                self.on_deadline(*self._lost)
+
+    def _scan(self, now: float) -> None:
+        if self._lost is not None:
+            return
+        for k in range(self.n_ranks):
+            if k == self.rank:
+                continue
+            try:
+                m = os.path.getmtime(self.path_for(k))
+            except OSError:
+                m = 0.0  # never seen: age runs from watchdog start
+            age = now - max(m, self._start_time)
+            if age > self.timeout:
+                self._lost = (k, age)
+                self._deadline = now + self.grace
+                self.log(
+                    f"heartbeat watchdog: peer rank {k} silent for "
+                    f"{age:.0f}s (> {self.timeout:.0f}s); raising "
+                    f"PeerLost at the next dispatch boundary (hard "
+                    f"exit {EXIT_PREEMPTED} in {self.grace:.0f}s if "
+                    f"blocked in a collective)")
+                return
+
+
+@dataclasses.dataclass
+class CoordConfig:
+    # shared-filesystem channel: heartbeat files + desync resync states
+    # (the CLI defaults it under the partition dir — the one directory
+    # multi-host runs already share)
+    dir: str = ""
+    # a peer silent this long is lost; 0 disables the watchdog
+    watchdog_timeout: float = 0.0
+    # epochs between cross-rank param-digest agreement checks;
+    # 0 disables
+    desync_every: int = 0
+    # on agreed desync: resync every rank from rank 0's state instead
+    # of aborting resumably
+    desync_resync: bool = False
+
+
+class Coordinator:
+    """The per-rank handle fit() drives: consensus + watchdog + desync.
+
+    Inactive (single-process) coordinators are pure no-ops — no
+    collectives, no watchdog — so the trainer keeps one code path.
+    ``force_active=True`` lets single-process tests exercise the
+    consensus machinery (the psum degenerates to identity)."""
+
+    def __init__(self, mesh=None, cfg: Optional[CoordConfig] = None,
+                 rank: Optional[int] = None,
+                 n_ranks: Optional[int] = None,
+                 metrics=None, log: Callable[[str], None] = print,
+                 force_active: bool = False):
+        import jax
+
+        self.rank = jax.process_index() if rank is None else int(rank)
+        self.n_ranks = jax.process_count() if n_ranks is None \
+            else int(n_ranks)
+        self.cfg = cfg or CoordConfig()
+        self.active = force_active or self.n_ranks > 1
+        # mesh=None defers the consensus channel (attach_mesh) so the
+        # CLI can start HEARTBEATS before the slow partition build /
+        # trainer compile — a rank silently partitioning for minutes
+        # must not look dead to its already-training-blocked peers
+        self._consensus = FaultConsensus(mesh) \
+            if (self.active and mesh is not None) else None
+        self.watchdog: Optional[HeartbeatWatchdog] = None
+        self.metrics = metrics
+        self.log = log
+        self.last_desync_mismatch = 0
+        self._started = False
+        # emergency context for the hard-deadline path: the freshest
+        # host-side snapshot (device state may be unreachable while the
+        # main thread is blocked inside a dead collective)
+        self._snapshot: Optional[Tuple[int, Any]] = None
+        self._ckpt_dir: Optional[str] = None
+        self._ckpt_keep = 3
+        self._progress_epoch = 0
+
+    # ---------------- lifecycle ---------------------------------------
+
+    def attach_mesh(self, mesh) -> None:
+        """Late-bind the consensus channel to the training mesh (the
+        heartbeat watchdog needs no mesh and may already be running)."""
+        if self.active and self._consensus is None:
+            self._consensus = FaultConsensus(mesh)
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        if (self.active and self.n_ranks > 1 and self.cfg.dir
+                and self.cfg.watchdog_timeout > 0):
+            self.watchdog = HeartbeatWatchdog(
+                self.cfg.dir, self.rank, self.n_ranks,
+                self.cfg.watchdog_timeout,
+                on_deadline=self._on_hard_deadline, log=self.log)
+            self.watchdog.start()
+
+    def stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        self._started = False
+
+    # ---------------- fit() context -----------------------------------
+
+    def note_snapshot(self, epoch: int, host_state: Any) -> None:
+        """Freshest host-side last-good snapshot (the sentinel's
+        rollback target doubles as the emergency checkpoint source)."""
+        self._snapshot = (int(epoch), host_state)
+
+    def note_progress(self, epoch: int) -> None:
+        self._progress_epoch = int(epoch)
+
+    def set_checkpoint(self, directory: Optional[str], keep: int) -> None:
+        self._ckpt_dir = directory or None
+        self._ckpt_keep = int(keep)
+
+    def suspend_heartbeat(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.suspend()
+
+    # ---------------- peer liveness -----------------------------------
+
+    def check_peers(self) -> None:
+        """Dispatch-boundary liveness gate; raises PeerLost BEFORE any
+        collective a dead peer could never complete."""
+        if self.watchdog is not None:
+            self.watchdog.check()
+
+    def await_peer_verdict(self) -> Optional[Tuple[int, float]]:
+        """After a failed collective: block (up to the watchdog horizon)
+        for the watchdog's verdict. Returns (peer, silent_s) when a
+        peer died — the caller converts the failure into PeerLost — or
+        None when every peer kept beating (a real local crash)."""
+        if self.watchdog is None:
+            return None
+        deadline = time.time() + self.cfg.watchdog_timeout \
+            + self.watchdog.grace + 5.0
+        while time.time() < deadline:
+            lost = self.watchdog.lost
+            if lost is not None:
+                self.watchdog.disarm()
+                return lost
+            time.sleep(0.2)
+        return None
+
+    def _on_hard_deadline(self, peer: int, age: float) -> None:
+        """Monitor-thread emergency: the main thread is blocked inside
+        a collective that can never complete. Record the fault, save
+        the freshest HOST-side snapshot (touching the device could
+        block forever), exit with the resumable status."""
+        try:
+            self.log(
+                f"watchdog hard deadline: peer rank {peer} dead and the "
+                f"main thread is blocked; emergency checkpoint + exit "
+                f"{EXIT_PREEMPTED}")
+            if self.metrics is not None:
+                try:
+                    self.metrics.fault(
+                        kind="peer-lost", epoch=self._progress_epoch,
+                        peer_rank=int(peer), silent_s=float(age),
+                        hard_deadline=True)
+                except Exception:  # noqa: BLE001 — exit anyway
+                    pass
+            if self._ckpt_dir and self._snapshot is not None:
+                from ..utils.checkpoint import save_checkpoint
+
+                ep, host = self._snapshot
+                try:
+                    save_checkpoint(self._ckpt_dir, host, ep,
+                                    keep=self._ckpt_keep)
+                    self.log(f"emergency checkpoint saved to "
+                             f"{self._ckpt_dir} (epoch {ep})")
+                except Exception as exc:  # noqa: BLE001
+                    self.log(f"emergency checkpoint failed: {exc!r}")
+        finally:
+            _hard_exit(EXIT_PREEMPTED)
+
+    # ---------------- consensus ---------------------------------------
+
+    def _exchange(self, trip_code: int = 0, preempt: bool = False,
+                  desync: bool = False) -> Agreed:
+        word = np.zeros(WORD_LEN, np.int32)
+        if trip_code:
+            word[IDX_TRIP] = 1
+            word[IDX_TRIP_CODE] = trip_code
+            word[IDX_TRIP_RANK] = self.rank + 1
+        if preempt:
+            word[IDX_PREEMPT] = 1
+            word[IDX_PREEMPT_RANK] = self.rank + 1
+        if desync:
+            word[IDX_DESYNC] = 1
+            word[IDX_DESYNC_RANK] = self.rank + 1
+        word[IDX_COUNT] = 1
+        # no consensus channel yet (mesh not attached): decode locally
+        if self.active and self._consensus is not None:
+            word = self._consensus.reduce(word)
+
+        def _decode(bit_idx, code_idx, rank_idx):
+            n = int(word[bit_idx])
+            if n == 0:
+                return False, 0, -1
+            code = int(word[code_idx]) if (code_idx is not None
+                                           and n == 1) else 0
+            rank = int(word[rank_idx]) - 1 if n == 1 else -1
+            return True, code, rank
+
+        trip, tcode, trank = _decode(IDX_TRIP, IDX_TRIP_CODE,
+                                     IDX_TRIP_RANK)
+        pre, _, prank = _decode(IDX_PREEMPT, None, IDX_PREEMPT_RANK)
+        des, _, drank = _decode(IDX_DESYNC, None, IDX_DESYNC_RANK)
+        return Agreed(trip=trip, trip_code=tcode, trip_rank=trank,
+                      preempt=pre, preempt_rank=prank,
+                      desync=des, desync_rank=drank,
+                      n_ranks=int(word[IDX_COUNT]))
+
+    def agree_boundary(self, preempt: bool = False) -> Agreed:
+        """Epoch-boundary (pre-dispatch) consensus: preemption
+        requests. Every rank calls this at the same program point."""
+        return self._exchange(preempt=preempt)
+
+    def agree_step(self, trip_reason: Optional[str] = None,
+                   desync: bool = False) -> Agreed:
+        """Post-dispatch consensus: sentinel trips + desync verdicts."""
+        return self._exchange(trip_code=trip_code_of(trip_reason),
+                              desync=desync)
+
+    def barrier(self) -> None:
+        if self.active and self._consensus is not None:
+            self._consensus.reduce(np.zeros(WORD_LEN, np.int32))
+
+    # ---------------- desync detection / repair -----------------------
+
+    def desync_due(self, epoch: int) -> bool:
+        return (self.active and self._consensus is not None
+                and self.cfg.desync_every > 0 and epoch > 0
+                and epoch % self.cfg.desync_every == 0)
+
+    def desync_check(self, params_host: Any) -> bool:
+        """Compare this rank's per-leaf param digests against rank 0's
+        (broadcast through the consensus channel). Returns True on
+        local mismatch; the caller feeds it into agree_step so the
+        VERDICT — like every recovery decision — is agreed."""
+        digs = digest_leaves(params_host)
+        ref = self._consensus.broadcast0(digs)
+        mism = int(np.sum(digs != ref))
+        self.last_desync_mismatch = mism
+        return mism > 0
+
+    def resync(self, trainer, epoch: int) -> None:
+        """Adopt rank 0's full state everywhere: rank 0 writes a
+        digest-verified state to the shared coordination dir, a psum
+        barrier publishes it, and EVERY rank loads + restores it.
+        (Collectives can't repair a desync — XLA already believes the
+        replicated arrays are identical — so repair goes out-of-band
+        like the partition artifact does. Every step below is either
+        executed on all ranks or collective-free, so the ranks'
+        collective streams stay aligned: host_state's allgather and
+        restore_state's device_put broadcasts are lockstep, the save is
+        host-side, and loading on rank 0 too guarantees every rank
+        holds the byte-identical on-disk state.)"""
+        from ..utils.checkpoint import load_checkpoint, save_checkpoint
+
+        d = os.path.join(self.cfg.dir or ".", "resync")
+        host = trainer.host_state()  # collective: ALL ranks
+        if self.rank == 0:
+            save_checkpoint(d, host, epoch, keep=1)
+        self.barrier()  # peers must not read before rank 0 finished
+        state, _ = load_checkpoint(d, host)
+        trainer.restore_state(state)  # device_put broadcasts: ALL ranks
+        self.barrier()  # nobody races ahead of slow loaders

@@ -1,7 +1,7 @@
 """Deterministic fault injection for chaos-testing the recovery paths.
 
-A fault plan is a comma-separated list of ``kind@epoch`` entries, e.g.
-``--fault-plan nan-loss@5,sigterm@8,corrupt-ckpt@10``. Kinds:
+A fault plan is a comma-separated list of ``kind@epoch[:rN]`` entries,
+e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
 
   nan-loss      the harvested loss of that epoch reads NaN (what a
                 diverged bf16 step reports) — exercises the sentinel's
@@ -15,15 +15,28 @@ A fault plan is a comma-separated list of ``kind@epoch`` entries, e.g.
   corrupt-ckpt  after the first checkpoint save at-or-after that
                 epoch, the newest generation's bytes are scribbled —
                 exercises digest verification + generation fallback
+  desync        this rank's replicated params are silently perturbed at
+                that epoch boundary — exercises the cross-rank desync
+                detector (docs/RESILIENCE.md multi-host section)
+  hang          the rank freezes at that epoch boundary (heartbeats
+                stop too, like a truly wedged process) — exercises the
+                PEERS' heartbeat watchdog / PeerLost path
+
+The optional ``:rN`` qualifier targets one rank (``jax.process_index``)
+so multi-process chaos drills can kill, desynchronize, or hang a single
+rank: ``nan-loss@5:r1`` trips ONLY rank 1's sentinel and the fault
+consensus must propagate the rollback to the rest of the pod.
+Unqualified entries fire on every rank (lockstep, the single-process
+behavior). Entries qualified for another rank are inert on this one.
 
 Every entry fires AT MOST ONCE (otherwise a recovered retry of the same
 epoch would re-trip forever), and :meth:`skip_before` retires entries a
 resumed run has already lived through, so the same ``--fault-plan`` can
 be passed verbatim to the resume invocation. Epoch semantics: boundary
-kinds (sigterm/crash) fire at the START of epoch E, so the resumable
-checkpoint they produce says E completed and ``skip_before(E)`` retires
-them; injection kinds poison epoch E itself and survive a resume that
-starts at E (the epoch is re-run).
+kinds (sigterm/crash/desync/hang) fire at the START of epoch E, so the
+resumable checkpoint they produce says E completed and
+``skip_before(E)`` retires them; injection kinds poison epoch E itself
+and survive a resume that starts at E (the epoch is re-run).
 
 Injection is host-side only — device programs are never altered, so a
 fault-injected run compiles byte-identical XLA to a production run.
@@ -36,31 +49,36 @@ import os
 import re
 from typing import List, Optional
 
-KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt")
+KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
+         "desync", "hang")
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire
-_BOUNDARY_KINDS = ("sigterm", "crash")
+_BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang")
 
-_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)$")
+_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::r(\d+))?$")
 
 
 @dataclasses.dataclass
 class _Entry:
     kind: str
     epoch: int
+    rank: Optional[int] = None  # None = every rank
     consumed: bool = False
 
 
 class FaultPlan:
-    """Parsed, single-shot fault schedule."""
+    """Parsed, single-shot fault schedule (for one rank's process)."""
 
-    def __init__(self, entries: List[_Entry]):
+    def __init__(self, entries: List[_Entry], rank: int = 0):
         self._entries = sorted(entries, key=lambda e: e.epoch)
+        self._rank = int(rank)
 
     @classmethod
-    def parse(cls, spec: str) -> "FaultPlan":
-        """Parse ``kind@epoch[,kind@epoch...]``; raises ValueError with
-        the grammar on any malformed entry or unknown kind."""
+    def parse(cls, spec: str, rank: int = 0) -> "FaultPlan":
+        """Parse ``kind@epoch[:rN][,kind@epoch[:rN]...]``; raises
+        ValueError with the grammar on any malformed entry or unknown
+        kind. ``rank`` is THIS process's rank — entries qualified for
+        another rank parse but never fire here."""
         entries = []
         for raw in spec.split(","):
             raw = raw.strip()
@@ -69,15 +87,20 @@ class FaultPlan:
             m = _ENTRY_RE.match(raw)
             if not m:
                 raise ValueError(
-                    f"bad fault-plan entry {raw!r}: expected kind@epoch "
-                    f"(e.g. nan-loss@5,sigterm@8,corrupt-ckpt@10)")
+                    f"bad fault-plan entry {raw!r}: expected "
+                    f"kind@epoch[:rN] (e.g. nan-loss@5:r1,sigterm@8,"
+                    f"corrupt-ckpt@10)")
             kind, epoch = m.group(1), int(m.group(2))
+            erank = int(m.group(3)) if m.group(3) is not None else None
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r}; known: "
                     f"{', '.join(KINDS)}")
-            entries.append(_Entry(kind, epoch))
-        return cls(entries)
+            entries.append(_Entry(kind, epoch, erank))
+        return cls(entries, rank=rank)
+
+    def _mine(self, e: _Entry) -> bool:
+        return e.rank is None or e.rank == self._rank
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -86,8 +109,9 @@ class FaultPlan:
         return bool(self._entries)
 
     def remaining(self) -> List[str]:
-        return [f"{e.kind}@{e.epoch}" for e in self._entries
-                if not e.consumed]
+        return [f"{e.kind}@{e.epoch}"
+                + (f":r{e.rank}" if e.rank is not None else "")
+                for e in self._entries if not e.consumed]
 
     def skip_before(self, start_epoch: int) -> None:
         """Retire entries a resume starting at `start_epoch` has already
@@ -100,22 +124,24 @@ class FaultPlan:
                 e.consumed = True
 
     def due(self, kind: str, epoch: int) -> bool:
-        """True (and consumes the entry) when a `kind` fault is
-        scheduled at-or-before `epoch`. The <= comparison keeps faults
-        from being silently skipped when the loop only visits block
-        boundaries (fused_epochs > 1)."""
+        """True (and consumes the entry) when a `kind` fault targeting
+        this rank is scheduled at-or-before `epoch`. The <= comparison
+        keeps faults from being silently skipped when the loop only
+        visits block boundaries (fused_epochs > 1)."""
         for e in self._entries:
-            if not e.consumed and e.kind == kind and e.epoch <= epoch:
+            if not e.consumed and e.kind == kind and e.epoch <= epoch \
+                    and self._mine(e):
                 e.consumed = True
                 return True
         return False
 
     def due_in(self, kind: str, lo: int, hi: int) -> Optional[int]:
-        """Epoch (clamped into [lo, hi)) of a `kind` fault scheduled
-        before `hi`, consuming it; None otherwise. For injection into a
-        fused block's harvested [k]-metrics."""
+        """Epoch (clamped into [lo, hi)) of a `kind` fault targeting
+        this rank scheduled before `hi`, consuming it; None otherwise.
+        For injection into a fused block's harvested [k]-metrics."""
         for e in self._entries:
-            if not e.consumed and e.kind == kind and e.epoch < hi:
+            if not e.consumed and e.kind == kind and e.epoch < hi \
+                    and self._mine(e):
                 e.consumed = True
                 return min(max(e.epoch, lo), hi - 1)
         return None

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Chaos lane: every fault-injection / recovery test (pytest marker
+# `faults`), INCLUDING the multi-process drills tier-1 deselects (they
+# are additionally marked `slow`): the two-coordinated-process kill
+# drill (kill -9 one rank -> the survivor exits 75 with a loadable
+# crash checkpoint, then a two-process --resume completes) and the
+# cross-rank consensus drill (a rank-targeted nan trips one rank's
+# sentinel, the whole pod rolls back in lockstep, post-recovery digests
+# agree). See docs/RESILIENCE.md.
+#
+# A hard wall-clock cap (CHAOS_TIMEOUT_S, default 1800 s) guarantees a
+# wedged drill kills the lane instead of the CI runner: hangs are the
+# failure mode under test, so the harness itself must never hang.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults \
+    -p no:cacheprovider "$@"

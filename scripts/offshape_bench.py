@@ -145,6 +145,7 @@ def main():
         print(f"# block {r}: {dt:.2f}s -> {dt/blk:.3f} s/epoch "
               f"loss={float(losses[-1]):.4f}", file=sys.stderr)
 
+    final_loss = float(losses[-1])
     print(json.dumps({
         "metric": f"offshape_{args.shape}_{args.impl}_epoch_time"
                   + ("" if args.rem_dtype == "none"
@@ -156,7 +157,15 @@ def main():
         "hidden": hidden,
         "dispatch_epochs": blk,
         "backend": jax.default_backend(),
+        "loss": round(final_loss, 4) if np.isfinite(final_loss) else None,
     }))
+    if not np.isfinite(final_loss):
+        # the known products-shape NaN (VERDICT "Next round" item 1)
+        # must never again publish a green JSON: timing a diverged run
+        # measures nothing
+        print("# FINAL LOSS NON-FINITE — benchmark invalid; exiting 3",
+              file=sys.stderr)
+        sys.exit(3)
 
 
 if __name__ == "__main__":

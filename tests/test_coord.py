@@ -1,0 +1,512 @@
+"""Cross-rank coordination tests (resilience/coord.py).
+
+Everything here is tier-1 (single process): the consensus word runs its
+REAL jitted psum on the virtual-device mesh (a one-process reduction is
+the identity, so encode/decode and the fit() wiring are exercised
+without a pod), peer behavior is mocked at the Coordinator surface, and
+the watchdog runs against a tmp directory with sub-second timeouts.
+The real two-coordinated-process drills live in
+tests/test_chaos_multiproc.py (marked slow; scripts/chaos.sh lane).
+"""
+
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.obs import MetricsLogger
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+from pipegcn_tpu.resilience import (
+    EXIT_PREEMPTED,
+    Agreed,
+    CoordConfig,
+    Coordinator,
+    DivergenceSentinel,
+    FaultPlan,
+    HeartbeatWatchdog,
+    PeerLost,
+    Preempted,
+    SentinelConfig,
+    digest_leaves,
+)
+from pipegcn_tpu.resilience import coord as coord_mod
+from pipegcn_tpu.utils.checkpoint import load_checkpoint, peek_epoch
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(scope="module")
+def sharded():
+    g = synthetic_graph(num_nodes=300, avg_degree=6, n_feat=8, n_class=3,
+                        seed=2)
+    return ShardedGraph.build(g, partition_graph(g, 2, seed=0), n_parts=2)
+
+
+def _trainer(sg, **tkw):
+    cfg = ModelConfig(layer_sizes=(sg.n_feat, 16, sg.n_class),
+                      dropout=0.0, train_size=sg.n_train_global)
+    tkw.setdefault("n_epochs", 10)
+    tkw.setdefault("log_every", 50)
+    return Trainer(sg, cfg, TrainConfig(**tkw))
+
+
+def _records(buf):
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+# ---------------- rank-qualified fault plans ---------------------------
+
+
+def test_fault_plan_rank_grammar():
+    p = FaultPlan.parse("nan-loss@5:r1, sigterm@8:r0,hang@6:r1,desync@7:r1",
+                        rank=1)
+    assert p.remaining() == ["nan-loss@5:r1", "hang@6:r1", "desync@7:r1",
+                             "sigterm@8:r0"]
+    # rank-1 plan: its own entries fire, rank-0 ones are inert
+    assert p.due_in("nan-loss", 0, 100) == 5
+    assert p.due("hang", 6) and p.due("desync", 7)
+    assert not p.due("sigterm", 100)
+    # rank-0 plan: only the sigterm fires
+    q = FaultPlan.parse("nan-loss@5:r1,sigterm@8:r0", rank=0)
+    assert q.due_in("nan-loss", 0, 100) is None
+    assert q.due("sigterm", 8)
+    # unqualified entries fire on every rank
+    r = FaultPlan.parse("crash@3", rank=7)
+    assert r.due("crash", 3)
+    with pytest.raises(ValueError, match=r"kind@epoch\[:rN\]"):
+        FaultPlan.parse("nan-loss@5:x1")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("meteor@3:r0")
+
+
+def test_fault_plan_new_kinds_are_boundary_kinds():
+    # a resume at-or-past the epoch retires desync/hang like
+    # sigterm/crash (they fired at the start of that epoch)
+    p = FaultPlan.parse("desync@4:r1,hang@6:r1", rank=1)
+    p.skip_before(6)
+    assert p.remaining() == []
+
+
+# ---------------- consensus word (real psum, one process) --------------
+
+
+def test_consensus_word_roundtrip(sharded):
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh, cfg=CoordConfig(), force_active=True)
+    a = c.agree_step(trip_reason="non-finite loss nan at epoch 3")
+    assert a.trip and a.trip_code == 1 and a.trip_rank == 0
+    assert "rank 0" in a.trip_reason()
+    a = c.agree_boundary(preempt=True)
+    assert a.preempt and a.preempt_rank == 0 and not a.trip
+    a = c.agree_step()  # healthy word: every bit clear
+    assert not (a.trip or a.preempt or a.desync)
+    assert a.n_ranks == 1
+    c.barrier()  # no-op barrier completes
+
+
+def test_consensus_inactive_is_local_noop(sharded):
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh, cfg=CoordConfig(), rank=0, n_ranks=1)
+    assert not c.active
+    # no collective machinery is even built
+    assert c._consensus is None
+    a = c.agree_step(trip_reason="non-finite loss")
+    assert a.trip and a.trip_rank == 0  # local decode, zero collectives
+    c.check_peers()  # no watchdog, no raise
+    c.start()
+    assert c.watchdog is None
+    c.stop()
+
+
+def test_digest_leaves_and_desync_check(sharded):
+    t = _trainer(sharded)
+    host = jax.device_get(t.state["params"])
+    d1 = digest_leaves(host)
+    assert d1.dtype == np.uint32 and len(d1) > 0
+    # deterministic, and sensitive to a single-leaf perturbation
+    assert np.array_equal(d1, digest_leaves(host))
+    import jax.tree_util as jtu
+
+    bumped = jtu.tree_map(lambda a: np.asarray(a) * np.asarray(
+        1.001, np.asarray(a).dtype), host)
+    d2 = digest_leaves(bumped)
+    assert not np.array_equal(d1, d2)
+
+    c = Coordinator(t.mesh, cfg=CoordConfig(), force_active=True)
+    # one process: broadcast0 returns our own digests -> no mismatch
+    assert c.desync_check(host) is False
+    assert c.last_desync_mismatch == 0
+    # a diverged "rank 0 reference" surfaces as a local mismatch
+    c._consensus.broadcast0 = lambda v: d2
+    assert c.desync_check(host) is True
+    assert c.last_desync_mismatch > 0
+
+
+def test_resync_roundtrip(sharded, tmp_path):
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh, cfg=CoordConfig(dir=str(tmp_path / "coord")),
+                    force_active=True)
+    c.resync(t, epoch=7)  # rank 0: writes the canonical state
+    d = str(tmp_path / "coord" / "resync")
+    assert peek_epoch(d) == 7
+    host, ep = load_checkpoint(d, jax.device_get(t.state))
+    assert ep == 7  # digest-verified load succeeded
+
+
+# ---------------- heartbeat watchdog -----------------------------------
+
+
+def test_watchdog_detects_silent_peer(tmp_path):
+    wd = HeartbeatWatchdog(str(tmp_path), rank=0, n_ranks=2,
+                           timeout_s=0.4, interval_s=0.05, grace_s=30.0,
+                           log=lambda s: None)
+    wd.start()
+    try:
+        wd.check()  # peers get a startup grace from watchdog start
+        deadline = time.time() + 5.0
+        while wd.lost is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert wd.lost is not None and wd.lost[0] == 1
+        with pytest.raises(PeerLost, match="peer rank 1"):
+            wd.check()
+    finally:
+        wd.stop()
+    # own heartbeat file existed while running, removed on stop
+    assert not os.path.exists(wd.path_for(0))
+
+
+def test_watchdog_beating_peer_never_trips(tmp_path):
+    wd = HeartbeatWatchdog(str(tmp_path), rank=0, n_ranks=2,
+                           timeout_s=0.5, interval_s=0.05,
+                           log=lambda s: None)
+    wd.start()
+    try:
+        end = time.time() + 1.2
+        peer = wd.path_for(1)
+        while time.time() < end:
+            with open(peer, "a"):
+                os.utime(peer, None)
+            time.sleep(0.05)
+        assert wd.lost is None
+        wd.check()
+    finally:
+        wd.stop()
+
+
+def test_watchdog_hard_deadline_fires_when_unhandled(tmp_path):
+    fired = []
+    wd = HeartbeatWatchdog(str(tmp_path), rank=0, n_ranks=2,
+                           timeout_s=0.3, interval_s=0.05, grace_s=0.2,
+                           on_deadline=lambda peer, age: fired.append(peer),
+                           log=lambda s: None)
+    wd.start()
+    try:
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.05)
+        assert fired == [1]
+    finally:
+        wd.stop()
+
+
+def test_watchdog_disarm_blocks_hard_deadline(tmp_path):
+    fired = []
+    wd = HeartbeatWatchdog(str(tmp_path), rank=0, n_ranks=2,
+                           timeout_s=0.3, interval_s=0.05, grace_s=0.3,
+                           on_deadline=lambda *a: fired.append(a),
+                           log=lambda s: None)
+    wd.start()
+    try:
+        deadline = time.time() + 5.0
+        while wd.lost is None and time.time() < deadline:
+            time.sleep(0.05)
+        wd.disarm()  # main thread took responsibility (check()/verdict)
+        time.sleep(0.8)
+        assert fired == []
+    finally:
+        wd.stop()
+
+
+def test_coordinator_hard_deadline_emergency(sharded, tmp_path,
+                                             monkeypatch):
+    """The monitor-thread emergency: fault record + snapshot checkpoint
+    + exit 75, without touching the (possibly wedged) device."""
+    exits = []
+    monkeypatch.setattr(coord_mod, "_hard_exit",
+                        lambda code: exits.append(code))
+    t = _trainer(sharded)
+    buf = io.StringIO()
+    c = Coordinator(t.mesh, cfg=CoordConfig(dir=str(tmp_path)),
+                    metrics=MetricsLogger(buf), log=lambda s: None,
+                    force_active=True)
+    ck = str(tmp_path / "ck")
+    c.set_checkpoint(ck, keep=2)
+    c.note_snapshot(6, jax.device_get(t.state))
+    c.note_progress(8)
+    c._on_hard_deadline(1, 12.5)
+    assert exits == [EXIT_PREEMPTED]
+    assert peek_epoch(ck) == 6  # the HOST-side snapshot, digest-valid
+    load_checkpoint(ck, jax.device_get(t.state))
+    recs = _records(buf)
+    f = next(r for r in recs if r["event"] == "fault")
+    assert f["kind"] == "peer-lost" and f["peer_rank"] == 1
+    assert f["hard_deadline"] is True and f["epoch"] == 8
+
+
+# ---------------- consensus-driven lockstep actions in fit() -----------
+# (the mocked single-process variant of the pod drills: a word with the
+# trip/preempt bit set must invoke the SAME recovery actions a local
+# fault would — that is what keeps a real pod in lockstep)
+
+
+def test_consensus_trip_invokes_lockstep_rollback(sharded, monkeypatch):
+    """A trip bit raised by a PEER (this rank's sentinel saw nothing)
+    must roll back, back off the LR, and recover exactly like a local
+    trip."""
+    t = _trainer(sharded, enable_pipeline=True)
+    lr0 = t.tcfg.lr
+    c = Coordinator(t.mesh, cfg=CoordConfig(), force_active=True,
+                    log=lambda s: None)
+    orig = c.agree_step
+    state = {"fired": False}
+
+    def fake_agree_step(trip_reason=None, desync=False):
+        a = orig(trip_reason=trip_reason, desync=desync)
+        if not state["fired"] and c._progress_epoch >= 5 \
+                and trip_reason is None:
+            state["fired"] = True
+            return Agreed(trip=True, trip_code=1, trip_rank=1, n_ranks=2)
+        return a
+
+    monkeypatch.setattr(c, "agree_step", fake_agree_step)
+    buf = io.StringIO()
+    logs = []
+    t.fit(eval_graphs=None, log_fn=logs.append,
+          metrics=MetricsLogger(buf),
+          sentinel=DivergenceSentinel(SentinelConfig(snapshot_every=3)),
+          coord=c)
+    recs = _records(buf)
+    faults = [r for r in recs if r["event"] == "fault"]
+    assert [f["kind"] for f in faults] == ["divergence"]
+    assert faults[0]["agreed"] is True and faults[0]["source_rank"] == 1
+    assert faults[0]["rollback_epoch"] < 5
+    assert any(r["event"] == "recovery" for r in recs)
+    assert abs(t.tcfg.lr - lr0 * 0.5) < 1e-12  # backed off in lockstep
+    assert t.last_epoch == t.tcfg.n_epochs
+    assert any("consensus: rank 1 tripped" in line for line in logs)
+
+
+def test_consensus_trip_without_local_sentinel(sharded, monkeypatch):
+    """Mixed config safety: even with the LOCAL sentinel disabled, a
+    peer's agreed trip must execute the rollback (defaults) — skipping
+    it would desynchronize the pod."""
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh, cfg=CoordConfig(), force_active=True,
+                    log=lambda s: None)
+    orig = c.agree_step
+    state = {"fired": False}
+
+    def fake_agree_step(trip_reason=None, desync=False):
+        a = orig(trip_reason=trip_reason, desync=desync)
+        if not state["fired"] and c._progress_epoch >= 4:
+            state["fired"] = True
+            return Agreed(trip=True, trip_code=4, trip_rank=1, n_ranks=2)
+        return a
+
+    monkeypatch.setattr(c, "agree_step", fake_agree_step)
+    logs = []
+    t.fit(eval_graphs=None, log_fn=logs.append, sentinel=None, coord=c)
+    assert t.last_epoch == t.tcfg.n_epochs
+    assert any("sentinel tripped" in line for line in logs)
+
+
+def test_peer_preemption_propagates_and_checkpoints(sharded, tmp_path,
+                                                    monkeypatch):
+    """Satellite: the rank that RECEIVES a propagated preemption (never
+    saw a signal itself) checkpoints and raises Preempted — the CLI
+    maps it to exit 75 like a local one."""
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh, cfg=CoordConfig(), force_active=True,
+                    log=lambda s: None)
+    orig = c.agree_boundary
+    state = {"fired": False}
+
+    def fake_agree_boundary(preempt=False):
+        a = orig(preempt=preempt)
+        if not state["fired"] and c._progress_epoch >= 6 and not preempt:
+            state["fired"] = True
+            return Agreed(preempt=True, preempt_rank=1, n_ranks=2)
+        return a
+
+    monkeypatch.setattr(c, "agree_boundary", fake_agree_boundary)
+    ck = str(tmp_path / "ck")
+    buf = io.StringIO()
+    with pytest.raises(Preempted) as ei:
+        t.fit(eval_graphs=None, log_fn=lambda s: None,
+              metrics=MetricsLogger(buf), checkpoint_dir=ck, coord=c)
+    assert "peer preemption (rank 1)" in str(ei.value)
+    assert ei.value.epoch == 6
+    assert peek_epoch(ck) == 6
+    recs = _records(buf)
+    f = next(r for r in recs if r["event"] == "fault")
+    assert f["kind"] == "preemption" and f["agreed"] is True
+    assert f["source_rank"] == 1
+
+
+def test_peer_lost_nonzero_rank_saves_crash_checkpoint(sharded, tmp_path,
+                                                       monkeypatch):
+    """Satellite: on PeerLost, EVERY surviving rank saves (rank 0 may
+    be the dead one) — here the process pretends to be rank 1 and must
+    still write a digest-valid, loadable crash checkpoint."""
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh, cfg=CoordConfig(), force_active=True,
+                    log=lambda s: None)
+
+    def fake_check_peers():
+        if c._progress_epoch >= 4:
+            raise PeerLost(0, 33.0)
+
+    monkeypatch.setattr(c, "check_peers", fake_check_peers)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    ck = str(tmp_path / "ck")
+    buf = io.StringIO()
+    logs = []
+    with pytest.raises(PeerLost, match="peer rank 0"):
+        t.fit(eval_graphs=None, log_fn=logs.append,
+              metrics=MetricsLogger(buf), checkpoint_dir=ck, coord=c)
+    assert any("peer-lost checkpoint saved" in line for line in logs)
+    assert peek_epoch(ck) == 4
+    host, ep = load_checkpoint(ck, jax.device_get(t.state))
+    assert ep == 4  # digest-verified
+    recs = _records(buf)
+    f = next(r for r in recs if r["event"] == "fault")
+    assert f["kind"] == "peer-lost" and f["peer_rank"] == 0
+    assert f["rank"] == 1
+
+
+def test_cli_entry_maps_peer_lost_to_exit_75(monkeypatch):
+    import pipegcn_tpu.cli.main as cli_main
+
+    # PeerLost exits via os._exit (bypassing jax's atexit distributed
+    # shutdown, whose barrier aborts with a dead peer); intercept it
+    exits = []
+
+    def fake_exit(code):
+        exits.append(code)
+        raise SystemExit(code)
+
+    monkeypatch.setattr(cli_main.os, "_exit", fake_exit)
+    monkeypatch.setattr(cli_main, "run",
+                        lambda args: (_ for _ in ()).throw(
+                            PeerLost(2, 40.0)))
+    monkeypatch.setattr("sys.argv", ["prog", "--dataset", "x",
+                                     "--checkpoint-dir", "ck"])
+    with pytest.raises(SystemExit):
+        cli_main.cli_entry()
+    assert exits == [EXIT_PREEMPTED]
+
+
+def test_desync_abort_is_resumable(sharded, tmp_path, monkeypatch):
+    """Agreed desync without --desync-resync: fault record + Preempted
+    (resumable exit 75), rank 0's state rides the crash checkpoint."""
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh, cfg=CoordConfig(dir=str(tmp_path / "coord")),
+                    force_active=True, log=lambda s: None)
+    orig = c.agree_step
+    state = {"fired": False}
+
+    def fake_agree_step(trip_reason=None, desync=False):
+        a = orig(trip_reason=trip_reason, desync=desync)
+        if not state["fired"] and c._progress_epoch >= 3:
+            state["fired"] = True
+            return Agreed(desync=True, desync_rank=1, n_ranks=2)
+        return a
+
+    monkeypatch.setattr(c, "agree_step", fake_agree_step)
+    ck = str(tmp_path / "ck")
+    buf = io.StringIO()
+    with pytest.raises(Preempted, match="desync"):
+        t.fit(eval_graphs=None, log_fn=lambda s: None,
+              metrics=MetricsLogger(buf), checkpoint_dir=ck, coord=c)
+    assert peek_epoch(ck) is not None
+    recs = _records(buf)
+    kinds = [r["kind"] for r in recs if r["event"] == "fault"]
+    assert kinds == ["desync"]
+
+
+def test_desync_resync_recovers_in_fit(sharded, tmp_path, monkeypatch):
+    """Agreed desync with resync enabled: rank 0 publishes its state,
+    training continues to completion, recovery record emitted."""
+    t = _trainer(sharded)
+    c = Coordinator(t.mesh,
+                    cfg=CoordConfig(dir=str(tmp_path / "coord"),
+                                    desync_resync=True),
+                    force_active=True, log=lambda s: None)
+    orig = c.agree_step
+    state = {"fired": False}
+
+    def fake_agree_step(trip_reason=None, desync=False):
+        a = orig(trip_reason=trip_reason, desync=desync)
+        if not state["fired"] and c._progress_epoch >= 3:
+            state["fired"] = True
+            return Agreed(desync=True, desync_rank=1, n_ranks=2)
+        return a
+
+    monkeypatch.setattr(c, "agree_step", fake_agree_step)
+    buf = io.StringIO()
+    t.fit(eval_graphs=None, log_fn=lambda s: None,
+          metrics=MetricsLogger(buf), coord=c)
+    assert t.last_epoch == t.tcfg.n_epochs
+    recs = _records(buf)
+    assert any(r["event"] == "fault" and r["kind"] == "desync"
+               for r in recs)
+    assert any(r["event"] == "recovery" and r["kind"] == "desync"
+               for r in recs)
+    # rank 0 published the canonical state to the coordination dir
+    assert peek_epoch(str(tmp_path / "coord" / "resync")) is not None
+
+
+# ---------------- obs: rank fields + per-rank report -------------------
+
+
+def test_fault_records_carry_rank(tmp_path):
+    buf = io.StringIO()
+    ml = MetricsLogger(buf)
+    ml.fault(kind="divergence", epoch=3)
+    ml.fault(kind="desync", epoch=5, rank=2, source_rank=1, agreed=True)
+    ml.recovery(kind="divergence", epoch=7)
+    recs = _records(buf)
+    assert recs[0]["rank"] == 0  # autofilled (single process)
+    assert recs[1]["rank"] == 2  # explicit wins
+    assert recs[2]["rank"] == 0
+
+
+def test_report_aggregates_faults_per_rank():
+    from pipegcn_tpu.cli.report import format_summary, summarize_run
+
+    records = [
+        {"event": "fault", "kind": "divergence", "epoch": 5, "rank": 1,
+         "agreed": True, "source_rank": 1},
+        {"event": "fault", "kind": "divergence", "epoch": 5, "rank": 0,
+         "agreed": True, "source_rank": 1},
+        {"event": "fault", "kind": "peer-lost", "epoch": 9, "rank": 0,
+         "peer_rank": 1},
+        {"event": "recovery", "kind": "divergence", "epoch": 7,
+         "rank": 0},
+    ]
+    s = summarize_run(records)
+    assert s["n_faults"] == 3 and s["n_recoveries"] == 1
+    assert s["fault_ranks"] == {"r0": 2, "r1": 1}
+    assert s["fault_source_ranks"] == {"r1": 2}
+    assert s["n_agreed_faults"] == 2
+    text = format_summary("x.jsonl", s)
+    assert "faults by rank" in text and "r0x2" in text
+    assert "consensus source ranks" in text

@@ -66,7 +66,34 @@ def test_distributed_init_called_with_node_math(monkeypatch):
         "coordinator_address": "10.0.0.7:18118",
         "num_processes": 4,
         "process_id": 3,
+        "initialization_timeout": 300,
     }]
+
+
+def test_distributed_init_timeout_flag_and_clean_error(monkeypatch):
+    """Satellite: an unreachable coordinator must fail with an
+    actionable error naming the address — not hang forever or die with
+    a bare RPC error."""
+    calls = []
+
+    def failing_init(**kw):
+        calls.append(kw)
+        raise RuntimeError("DEADLINE_EXCEEDED: rpc timed out")
+
+    monkeypatch.setattr(jax.distributed, "initialize", failing_init)
+    args = create_parser().parse_args([
+        "--dataset", "reddit", "--n-partitions", "8",
+        "--parts-per-node", "4", "--node-rank", "1",
+        "--master-addr", "10.1.2.3", "--port", "9999",
+        "--coordinator-timeout", "7",
+    ])
+    with pytest.raises(RuntimeError) as ei:
+        _maybe_init_distributed(args)
+    assert calls[0]["initialization_timeout"] == 7
+    msg = str(ei.value)
+    assert "10.1.2.3:9999" in msg
+    assert "process 1/2" in msg
+    assert "--coordinator-timeout" in msg
 
 
 def test_distributed_init_skipped_single_host(monkeypatch):
