@@ -239,6 +239,17 @@ def run(args) -> dict:
         raise ValueError(
             "--resume requires --checkpoint-dir (there is nothing to "
             "resume from)")
+    profile_epochs = None
+    if getattr(args, "profile_epochs", ""):
+        # parse BEFORE the partition/trainer build: a malformed window
+        # must not burn a multi-minute setup
+        from ..obs.profiler import parse_profile_epochs
+
+        profile_epochs = parse_profile_epochs(args.profile_epochs)
+        if not args.profile_dir:
+            raise ValueError(
+                "--profile-epochs needs --profile-dir (there is "
+                "nowhere to write the trace)")
 
     # deferred jax import so the parser works without initializing backends
     import jax
@@ -382,6 +393,26 @@ def run(args) -> dict:
     coord.attach_mesh(trainer.mesh)
     coord.metrics = metrics
 
+    if getattr(args, "anatomy", False):
+        # compiled-step anatomy: FLOPs/bytes per phase from the
+        # optimized HLO (obs/anatomy.py). Costs one single-epoch
+        # compile up front — opt-in for that reason.
+        from ..obs.anatomy import step_anatomy
+
+        rec = step_anatomy(trainer)
+        frac = rec.get("attributed_flops_fraction")
+        print(f"epoch anatomy: {rec['n_ops']} HLO ops, "
+              f"{rec['est_flops']:.3e} est FLOPs"
+              + (f", {frac:.1%} attributed to named phases"
+                 if frac is not None else ""))
+        if metrics is not None:
+            extras = {k: v for k, v in rec.items()
+                      if k not in ("phases", "est_flops", "flops",
+                                   "attributed_flops_fraction")}
+            metrics.anatomy(rec["phases"], rec["est_flops"],
+                            rec["flops"],
+                            rec["attributed_flops_fraction"], **extras)
+
     try:
         with preemption.installed(enabled=not args.no_signal_handlers):
             fit_res = trainer.fit(
@@ -394,6 +425,8 @@ def run(args) -> dict:
                 checkpoint_every=args.checkpoint_every,
                 checkpoint_keep=args.checkpoint_keep,
                 profile_dir=args.profile_dir or None,
+                profile_epochs=profile_epochs,
+                staleness_probe_every=args.staleness_probe_every,
                 measure_comm_cost=True,
                 sharded_eval=args.sharded_eval,
                 async_eval=not args.sync_eval,
@@ -466,6 +499,10 @@ def cli_entry() -> None:
               f"[exit {EXIT_PREEMPTED}]")
         sys.stdout.flush()
         sys.stderr.flush()
+        # os._exit skips atexit AND io teardown; the metrics sink was
+        # closed (flushed) by run()'s finally, and fault records are
+        # fsynced at write time (MetricsLogger.hard_flush), so the
+        # final peer-lost record is already durable here
         os._exit(EXIT_PREEMPTED)
 
 

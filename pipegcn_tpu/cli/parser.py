@@ -275,7 +275,30 @@ def create_parser() -> argparse.ArgumentParser:
     parser.add_argument("--profile-dir", "--profile_dir", type=str,
                         default="",
                         help="write a jax.profiler trace of a few epochs "
-                             "to this directory (TensorBoard format)")
+                             "to this directory (TensorBoard format); "
+                             "the captured trace is folded into a "
+                             "'profile' metrics record with MEASURED "
+                             "per-phase device time and comm/compute "
+                             "overlap (docs/OBSERVABILITY.md)")
+    parser.add_argument("--profile-epochs", "--profile_epochs", type=str,
+                        default="",
+                        help="'A:B' — capture the device trace around "
+                             "epochs [A, B) instead of the default "
+                             "auto-window; requires --profile-dir")
+    parser.add_argument("--staleness-probe-every",
+                        "--staleness_probe_every", type=int, default=0,
+                        help="every N epochs measure the per-layer "
+                             "relative drift between the stale halo "
+                             "features the pipelined step consumed and "
+                             "the fresh ones it shipped (emits "
+                             "'staleness' records; pipelined mode "
+                             "only; 0 disables)")
+    parser.add_argument("--anatomy", action="store_true",
+                        help="emit an 'anatomy' record before training: "
+                             "the compiled step's FLOPs/bytes "
+                             "attributed per phase from the optimized "
+                             "HLO + XLA cost analysis "
+                             "(docs/OBSERVABILITY.md)")
     parser.add_argument("--metrics-out", "--metrics_out", type=str,
                         default="",
                         help="append structured JSONL telemetry (run "

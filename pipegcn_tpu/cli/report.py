@@ -167,6 +167,54 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         nd = out.get("n_devices") or 1
         if isinstance(fl, (int, float)) and fl and base and peak:
             out["mfu_pct"] = round(100.0 * fl / (base * peak * nd), 2)
+
+    # ---- measured profiling window (obs/profiler.py) ----
+    profiles = [r for r in records if r.get("event") == "profile"]
+    if profiles:
+        p = profiles[-1]  # the freshest capture wins
+        if isinstance(p.get("overlap_fraction"), (int, float)):
+            out["measured_overlap_fraction"] = round(
+                p["overlap_fraction"], 4)
+        if isinstance(p.get("phases"), dict):
+            out["profile_phases"] = p["phases"]
+        for k in ("comm_s", "compute_s"):
+            if isinstance(p.get(k), (int, float)):
+                out[f"profile_{k}"] = p[k]
+        win = (p.get("epoch_start"), p.get("epoch_end"))
+        if all(isinstance(x, int) for x in win):
+            out["profile_window"] = list(win)
+        # the host-side estimate and the measured fraction describe
+        # the same quantity; flag when they disagree materially so
+        # the estimate is never trusted past its error
+        est = out.get("overlapped_comm_fraction",
+                      out.get("comm_fraction"))
+        meas = out.get("measured_overlap_fraction")
+        if isinstance(est, (int, float)) and isinstance(meas,
+                                                        (int, float)):
+            out["overlap_divergence"] = bool(abs(meas - est) > 0.25)
+
+    # ---- staleness probes (--staleness-probe-every) ----
+    stale = [r for r in records if r.get("event") == "staleness"]
+    drifts = [r["max_rel_drift"] for r in stale
+              if isinstance(r.get("max_rel_drift"), (int, float))]
+    if drifts:
+        out["staleness_probes"] = len(drifts)
+        out["staleness_max_rel_drift"] = round(max(drifts), 6)
+        out["staleness_last_rel_drift"] = round(drifts[-1], 6)
+
+    # ---- compiled-step anatomy (obs/anatomy.py) ----
+    anatomies = [r for r in records if r.get("event") == "anatomy"]
+    if anatomies:
+        a = anatomies[-1]
+        if isinstance(a.get("attributed_flops_fraction"), (int, float)):
+            out["anatomy_attributed_flops_fraction"] = round(
+                a["attributed_flops_fraction"], 4)
+        ph = a.get("phases")
+        ef = a.get("est_flops")
+        if isinstance(ph, dict) and isinstance(ef, (int, float)) and ef:
+            out["anatomy_flop_shares"] = {
+                k: round(v.get("flops", 0.0) / ef, 4)
+                for k, v in ph.items() if isinstance(v, dict)}
     return out
 
 
@@ -202,8 +250,35 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
     row("memory peak", "memory_peak_bytes", "{:,} bytes")
     row("comm cost (standalone)", "comm_cost_s", "{:.4f} s")
     row("comm fraction of epoch", "comm_fraction", "{:.2%}")
-    row("overlapped comm fraction", "overlapped_comm_fraction",
-        "{:.2%}")
+    # estimated and measured side by side: the estimate is the
+    # host-derived comm_cost/epoch ratio, the measurement a folded
+    # device trace (obs/profiler.py) — divergence means the estimate
+    # can no longer be trusted at this config
+    row("overlap (estimated)", "overlapped_comm_fraction", "{:.2%}")
+    row("overlap (measured)", "measured_overlap_fraction", "{:.2%}")
+    if s.get("overlap_divergence"):
+        lines.append(f"  {'!! overlap divergence':<26} measured and "
+                     f"estimated overlap differ by > 0.25")
+    if s.get("profile_phases"):
+        top = sorted(s["profile_phases"].items(),
+                     key=lambda kv: -kv[1])[:4]
+        lines.append("  {:<26} {}".format(
+            "profiled device time", ", ".join(
+                f"{k} {v:.4f}s" for k, v in top)))
+    if s.get("staleness_probes"):
+        lines.append("  {:<26} {} probes, max {:.4f}, last {:.4f}"
+                     .format("staleness rel drift",
+                             s["staleness_probes"],
+                             s.get("staleness_max_rel_drift", 0.0),
+                             s.get("staleness_last_rel_drift", 0.0)))
+    if s.get("anatomy_flop_shares"):
+        top = sorted(s["anatomy_flop_shares"].items(),
+                     key=lambda kv: -kv[1])[:4]
+        lines.append("  {:<26} {}".format(
+            "anatomy flop shares", ", ".join(
+                f"{k} {v:.1%}" for k, v in top)))
+        row("anatomy attributed", "anatomy_attributed_flops_fraction",
+            "{:.1%}")
     row("MFU", "mfu_pct", "{:.2f} %")
     if s.get("n_faults"):
         kinds = ", ".join(f"{k}x{n}" for k, n in

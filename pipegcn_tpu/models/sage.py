@@ -303,9 +303,10 @@ def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
     segment). `chunk` (cfg.spmm_chunk) bounds the raw path's per-pass
     edge intermediates the way spmm_mean's chunking does."""
     h_ = n_heads
-    z = jnp.matmul(fbuf, lp["w"].astype(fbuf.dtype),
-                   preferred_element_type=jnp.float32 if is_last
-                   else fbuf.dtype)
+    with jax.named_scope("dense"):
+        z = jnp.matmul(fbuf, lp["w"].astype(fbuf.dtype),
+                       preferred_element_type=jnp.float32 if is_last
+                       else fbuf.dtype)
     dh = z.shape[-1] // h_
     z = z.reshape(-1, h_, dh)
     zf = z.astype(jnp.float32)
@@ -313,7 +314,8 @@ def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
     er = (zf[:n_dst] * lp["a_dst"]).sum(-1)            # [n_dst, H]
 
     if gat_fn is not None:
-        out = gat_fn(z, el, er)                        # [n_dst, H, dh]
+        with jax.named_scope("spmm"):
+            out = gat_fn(z, el, er)                    # [n_dst, H, dh]
         out = out.mean(axis=1) if is_last \
             else out.reshape(n_dst, h_ * dh)
         return out.astype(out_dtype) + lp["b"].astype(out_dtype)
@@ -378,8 +380,12 @@ def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
 def _dropout(rng, h, rate):
     if rate <= 0.0:
         return h
-    keep = jax.random.bernoulli(rng, 1.0 - rate, h.shape)
-    return jnp.where(keep, h / (1.0 - rate), 0.0)
+    # named scope: the RNG + mask traffic show up as their own phase in
+    # profiler traces / anatomy records (the floor term --rng-impl rbg
+    # targets)
+    with jax.named_scope("dropout"):
+        keep = jax.random.bernoulli(rng, 1.0 - rate, h.shape)
+        return jnp.where(keep, h / (1.0 - rate), 0.0)
 
 
 def forward(
@@ -438,9 +444,10 @@ def forward(
         # logits layer) accumulates AND emits f32 from the bf16 matmul
         # via preferred_element_type, then adds the f32 bias — the
         # product is never rounded to bf16.
-        y = jnp.matmul(x, w.astype(x.dtype),
-                       preferred_element_type=out_dtype)
-        return y + b.astype(out_dtype)
+        with jax.named_scope("dense"):
+            y = jnp.matmul(x, w.astype(x.dtype),
+                           preferred_element_type=out_dtype)
+            return y + b.astype(out_dtype)
 
     for i in range(cfg.n_layers):
       # named scope per layer: forward ops (and the backward ops XLA
@@ -482,12 +489,14 @@ def forward(
                 else:
                     # spmm_fn (e.g. the Pallas VMEM-resident kernel)
                     # returns the mean directly when injected
-                    if spmm_fn is not None:
-                        ah = spmm_fn(h)
-                    else:
-                        ah = spmm_mean(h, edge_src, edge_dst, in_deg,
-                                       n_dst, cfg.spmm_chunk,
-                                       cfg.sorted_edges)
+                    with jax.named_scope("spmm"):
+                        if spmm_fn is not None:
+                            ah = spmm_fn(h)
+                        else:
+                            ah = spmm_mean(h, edge_src, edge_dst,
+                                           in_deg, n_dst,
+                                           cfg.spmm_chunk,
+                                           cfg.sorted_edges)
                     if is_gcn:
                         # mean * sqrt(d_i) = (Σ_j h_j/sqrt(d_j))/sqrt(d_i)
                         ah = ah.astype(jnp.float32) * d_sqrt[:, None]
@@ -505,8 +514,9 @@ def forward(
                                chunk=cfg.spmm_chunk)
             else:
                 lp = params["layers"][i]
-                ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,
-                               cfg.spmm_chunk, cfg.sorted_edges)
+                with jax.named_scope("spmm"):
+                    ah = spmm_mean(h, edge_src, edge_dst, in_deg, n_dst,
+                                   cfg.spmm_chunk, cfg.sorted_edges)
                 if is_gcn:
                     ah = ah.astype(jnp.float32) * d_sqrt[:, None]
                     h = dense(ah.astype(cdt), lp["w"], lp["b"], out_dt)
@@ -528,6 +538,7 @@ def forward(
 
         if i < cfg.n_layers - 1:
             if use_norm:
+              with jax.named_scope("norm"):
                 np_ = params["norms"][i]
                 if cfg.norm == "layer":
                     h = _layer_norm(h, np_["scale"], np_["bias"])

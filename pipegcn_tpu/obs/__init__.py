@@ -16,9 +16,18 @@ Three pillars (docs/OBSERVABILITY.md):
               lines (train.py:369-371, :33-39, :54-60) are pinned
               byte-exact by tests/test_obs.py
   hw.py       public per-chip peak-FLOPs table (MFU reporting)
+  profiler.py device-trace profiling windows: fold a jax.profiler
+              capture into MEASURED per-phase device time + the
+              comm/compute overlap fraction (docs/OBSERVABILITY.md
+              "Profiling")
+  anatomy.py  compiled-step anatomy: per-phase FLOP/byte attribution
+              from the optimized HLO + the on-chip ablation clock
+  timeline.py cross-rank Perfetto/Chrome-trace timelines from merged
+              metrics JSONL streams (cli/timeline.py is the CLI)
 
 The reporting CLI lives in cli/report.py (`python -m
-pipegcn_tpu.cli.report metrics.jsonl`).
+pipegcn_tpu.cli.report metrics.jsonl`); the timeline CLI in
+cli/timeline.py (`python -m pipegcn_tpu.cli.timeline r0.jsonl ...`).
 
 No reference counterpart: the reference's only telemetry is stdout
 print lines and the result txt files; this subsystem is the
@@ -34,12 +43,15 @@ from .metrics import (
     read_metrics,
 )
 from .schema import (
+    ANATOMY_FIELDS,
     EPOCH_FIELDS,
     EVAL_FIELDS,
     FAULT_FIELDS,
+    PROFILE_FIELDS,
     RECOVERY_FIELDS,
     RUN_FIELDS,
     SCHEMA_VERSION,
+    STALENESS_FIELDS,
     SUMMARY_FIELDS,
     validate_record,
 )
@@ -53,6 +65,9 @@ __all__ = [
     "SUMMARY_FIELDS",
     "FAULT_FIELDS",
     "RECOVERY_FIELDS",
+    "PROFILE_FIELDS",
+    "ANATOMY_FIELDS",
+    "STALENESS_FIELDS",
     "validate_record",
     "MetricsLogger",
     "read_metrics",

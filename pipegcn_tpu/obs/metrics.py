@@ -97,6 +97,10 @@ class MetricsLogger:
     def epoch(self, epoch: int, step_time_s: float, loss: float,
               grad_norm: float, halo_bytes: int, staleness_age: int,
               memory: Optional[dict] = None, **extra) -> Dict[str, Any]:
+        # time_unix (record write time = dispatch end) is an optional
+        # extra: the timeline CLI uses it for real wall-clock alignment
+        # across ranks when every epoch record carries it
+        extra.setdefault("time_unix", time.time())
         return self.write({
             "event": "epoch",
             "epoch": int(epoch),
@@ -111,6 +115,7 @@ class MetricsLogger:
 
     def eval_record(self, epoch: int, eval_time_s: float, val_acc: float,
                     **extra) -> Dict[str, Any]:
+        extra.setdefault("time_unix", time.time())
         return self.write({
             "event": "eval",
             "epoch": int(epoch),
@@ -137,24 +142,82 @@ class MetricsLogger:
         desync, lost peer. Extras carry the kind-specific detail
         (reason, retry, trip values, source_rank/agreed for
         consensus-driven actions). `rank` defaults to this process's
-        rank so multi-host JSONL streams stay attributable when merged."""
-        return self.write({
+        rank so multi-host JSONL streams stay attributable when merged.
+
+        Fault records are durability-critical — they often explain a
+        death the process is about to execute via ``os._exit`` (which
+        skips atexit AND io buffers) — so every fault/recovery write is
+        followed by :meth:`hard_flush` (flush + fsync)."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
             "event": "fault",
             "kind": str(kind),
             "epoch": int(epoch),
             "rank": _local_rank() if rank is None else int(rank),
             **extra,
         })
+        self.hard_flush()
+        return rec
 
     def recovery(self, kind: str, epoch: int, rank: Optional[int] = None,
                  **extra) -> Dict[str, Any]:
         """A completed recovery from the matching fault kind (training
-        progressed past the faulted epoch, or a resume restored)."""
-        return self.write({
+        progressed past the faulted epoch, or a resume restored).
+        Hard-flushed like fault records (the recovery may immediately
+        precede a preemption exit)."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
             "event": "recovery",
             "kind": str(kind),
             "epoch": int(epoch),
             "rank": _local_rank() if rank is None else int(rank),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
+    def profile(self, phases: Dict[str, float], comm_s: float,
+                compute_s: float, overlap_fraction: float,
+                **extra) -> Dict[str, Any]:
+        """A captured profiling window's MEASURED device-time
+        decomposition (obs/profiler.py): per-phase seconds + the
+        comm/compute overlap fraction. Extras: epoch_start/epoch_end,
+        trace_files, parser coverage counters."""
+        return self.write({
+            "event": "profile",
+            "phases": dict(phases),
+            "comm_s": float(comm_s),
+            "compute_s": float(compute_s),
+            "overlap_fraction": float(overlap_fraction),
+            **extra,
+        })
+
+    def anatomy(self, phases: Dict[str, Any], est_flops: float,
+                flops: Optional[float] = None,
+                attributed_flops_fraction: Optional[float] = None,
+                **extra) -> Dict[str, Any]:
+        """A compiled-step anatomy (obs/anatomy.py): estimated
+        FLOPs/bytes per phase + XLA's own totals."""
+        return self.write({
+            "event": "anatomy",
+            "phases": dict(phases),
+            "est_flops": float(est_flops),
+            "flops": None if flops is None else float(flops),
+            "attributed_flops_fraction": (
+                None if attributed_flops_fraction is None
+                else float(attributed_flops_fraction)),
+            **extra,
+        })
+
+    def staleness(self, epoch: int, layers: Dict[str, Any],
+                  max_rel_drift: float, **extra) -> Dict[str, Any]:
+        """A staleness probe's per-layer relative drift between stale
+        and fresh boundary features (--staleness-probe-every)."""
+        return self.write({
+            "event": "staleness",
+            "epoch": int(epoch),
+            "layers": dict(layers),
+            "max_rel_drift": float(max_rel_drift),
             **extra,
         })
 
@@ -164,6 +227,21 @@ class MetricsLogger:
         return self.write({"event": event, **fields})
 
     # ---------------- lifecycle ---------------------------------------
+
+    def hard_flush(self) -> None:
+        """Flush AND fsync: records survive even an ``os._exit`` (which
+        skips atexit handlers and io teardown) or a SIGKILL an instant
+        later. Call before every hard-exit / crash-checkpoint path;
+        fault/recovery writers call it automatically. Best-effort on
+        sinks without a file descriptor (StringIO tests)."""
+        try:
+            self._f.flush()
+        except (OSError, ValueError):
+            return
+        try:
+            os.fsync(self._f.fileno())
+        except (OSError, ValueError, AttributeError):
+            pass
 
     def close(self) -> None:
         if self._owns_file and not self._f.closed:

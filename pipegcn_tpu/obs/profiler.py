@@ -1,0 +1,281 @@
+"""Device-trace profiling windows: measured phase time, not estimates.
+
+The report CLI has so far *estimated* the comm/compute overlap fraction
+from host-side `PhaseTimer` spans and standalone `measure_comm()`
+costs. This module turns a captured ``jax.profiler.trace`` into a
+measured decomposition:
+
+  1. ``jax.profiler`` writes TensorBoard-format traces, including a
+     Chrome-trace ``<host>.trace.json.gz`` whose device lines carry one
+     event per executed HLO op (``args.hlo_op`` / ``args.hlo_module``).
+  2. The op names alone are anonymous (``fusion.12``), but the COMPILED
+     step's HLO text carries ``metadata={op_name="jit(step)/.../layer0/
+     spmm/..."}`` — the `named_phase` scopes the model stack already
+     emits. ``hlo_op_map`` joins the two.
+  3. ``fold_trace`` buckets every device op's duration into a phase
+     (spmm / dense / halo_comm / grad_reduce / optimizer / norm /
+     dropout_rng / other) and measures the **overlap fraction**: the
+     share of communication device-time covered by concurrently-running
+     compute (interval union per trace process). Works on the CPU mesh
+     (virtual devices are executor threads of one process), so the
+     whole pipeline is tier-1 testable.
+
+The result is the contracted ``profile`` record (obs/schema.py v2):
+measured per-phase device seconds + overlap fraction in [0, 1], which
+the report CLI prints NEXT TO the host-side estimate and flags when
+the two diverge.
+
+Everything here is stdlib-only (gzip/json/re); jax is never imported —
+the trace directory and the compiled HLO text arrive as inputs, so the
+parser also runs in jax-free report tooling.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# phase vocabulary — the contract the profile/anatomy records share
+PHASES = ("spmm", "dense", "halo_comm", "grad_reduce", "optimizer",
+          "norm", "dropout_rng", "eval", "other")
+
+# communication phases whose device time the overlap fraction measures
+COMM_PHASES = ("halo_comm", "grad_reduce")
+
+# HLO opcode prefixes that are communication wherever they appear,
+# even outside a named scope (shard_map lowers ppermute/psum to these)
+_COMM_KINDS = ("collective-permute", "all-reduce", "all-gather",
+               "all-to-all", "reduce-scatter", "collective-broadcast",
+               "send", "recv")
+
+
+def classify_op(op_name: str, hlo_kind: str = "") -> str:
+    """Bucket one HLO op into a phase by its metadata scope path (the
+    `named_phase` names: layer{i}/spmm, halo_exchange, grad_reduce,
+    adam_update, ...) with the opcode as a fallback for collectives.
+    Backward ops keep the forward scope inside jax's transpose(...)
+    wrapper, so substring matching covers both directions."""
+    s = op_name.lower()
+    k = hlo_kind.lower()
+    if "halo_exchange" in s or "bgrad_return" in s:
+        return "halo_comm"
+    if "grad_reduce" in s:
+        return "grad_reduce"
+    if any(k.startswith(c) for c in _COMM_KINDS):
+        # an unscoped collective: the gradient psum is scoped, so bare
+        # collectives are halo traffic (stale-concat exchange blocks)
+        return "halo_comm"
+    if "adam_update" in s:
+        return "optimizer"
+    if "/spmm" in s or "spmm" in s:
+        return "spmm"
+    if "dropout" in s:
+        return "dropout_rng"
+    if "/norm" in s or "layer_norm" in s or "batch_norm" in s:
+        return "norm"
+    if "/dense" in s:
+        return "dense"
+    if "eval" in s:
+        return "eval"
+    return "other"
+
+
+# one optimized-HLO instruction: "%name = type opcode(...), ...,
+# metadata={op_name="..."}". Tuple-typed outputs and missing metadata
+# both occur; keep the regex tolerant and skip what it cannot read.
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<type>\([^=]*?\)|[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?)\s*"
+    r"(?P<kind>[\w\-]+)\(")
+_OPNAME_RE = re.compile(r'metadata=\{[^}]*op_name="(?P<op>[^"]*)"')
+
+
+def hlo_op_map(compiled_text: str) -> Dict[str, Tuple[str, str]]:
+    """{hlo op name -> (scope op_name, opcode)} from a compiled
+    module's text (``jitted.lower(...).compile().as_text()``). The op
+    names here are what the trace events' ``args.hlo_op`` carries, so
+    this is the join key between the anonymous timeline and the named
+    phases."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for line in compiled_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        om = _OPNAME_RE.search(line)
+        out[m.group("name")] = (om.group("op") if om else "",
+                                m.group("kind"))
+    return out
+
+
+def module_name(compiled_text: str) -> str:
+    """The HloModule name (trace events carry it as args.hlo_module)."""
+    m = re.match(r"HloModule\s+([\w.\-]+)", compiled_text)
+    return m.group(1) if m else ""
+
+
+# ---------------- trace loading ---------------------------------------
+
+
+def find_trace_files(profile_dir: str) -> List[str]:
+    """All ``*.trace.json(.gz)`` files of the NEWEST capture session
+    under a ``jax.profiler`` output dir (layout:
+    ``<dir>/plugins/profile/<timestamp>/<host>.trace.json.gz``)."""
+    sessions = sorted(glob.glob(
+        os.path.join(profile_dir, "plugins", "profile", "*")))
+    if not sessions:
+        return []
+    latest = sessions[-1]
+    return sorted(glob.glob(os.path.join(latest, "*.trace.json.gz"))
+                  + glob.glob(os.path.join(latest, "*.trace.json")))
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """The ``traceEvents`` list of one Chrome-trace file (.gz or
+    plain)."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt", encoding="utf-8") as f:
+        data = json.load(f)
+    evs = data.get("traceEvents", [])
+    return [e for e in evs if isinstance(e, dict) and e]
+
+
+def _union_intervals(iv: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+    if not iv:
+        return []
+    iv = sorted(iv)
+    out = [list(iv[0])]
+    for a, b in iv[1:]:
+        if a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return [(a, b) for a, b in out]
+
+
+def _overlap_with_union(iv: Tuple[float, float],
+                        union: Sequence[Tuple[float, float]]) -> float:
+    a, b = iv
+    tot = 0.0
+    for ua, ub in union:
+        if ub <= a:
+            continue
+        if ua >= b:
+            break
+        tot += min(b, ub) - max(a, ua)
+    return tot
+
+
+def fold_trace(events: Sequence[Dict[str, Any]],
+               op_map: Dict[str, Tuple[str, str]],
+               module: str = "") -> Dict[str, Any]:
+    """Fold device-op trace events into per-phase device seconds and a
+    measured comm/compute overlap fraction.
+
+    Only events carrying ``args.hlo_op`` participate (those are the
+    device-side op executions); when `module` or `op_map` is given,
+    events are further restricted to the train step's module so a
+    concurrently-dispatched eval program cannot masquerade as overlap.
+
+    Overlap: per trace process (pid), the compute intervals form a
+    union; each comm event's duration is split into covered/exposed
+    against it. fraction = covered_comm / total_comm (0.0 when the
+    capture saw no comm at all — P=1 runs)."""
+    phase_us: Dict[str, float] = {}
+    n_matched = n_dev = 0
+    comm_by_pid: Dict[Any, List[Tuple[float, float]]] = {}
+    comp_by_pid: Dict[Any, List[Tuple[float, float]]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        hop = args.get("hlo_op")
+        if not hop:
+            continue
+        n_dev += 1
+        if module and args.get("hlo_module") not in ("", None, module):
+            continue
+        op_name, kind = op_map.get(hop, ("", ""))
+        if op_map and hop not in op_map and module == "":
+            # an op from some other compiled program (eval, comm
+            # microbench): keep it out of the step decomposition
+            continue
+        if hop in op_map:
+            n_matched += 1
+        phase = classify_op(op_name or e.get("name", ""), kind
+                            or str(e.get("name", "")))
+        dur = float(e.get("dur", 0.0))
+        ts = float(e.get("ts", 0.0))
+        phase_us[phase] = phase_us.get(phase, 0.0) + dur
+        pid = e.get("pid")
+        tgt = comm_by_pid if phase in COMM_PHASES else comp_by_pid
+        tgt.setdefault(pid, []).append((ts, ts + dur))
+
+    comm_us = sum(phase_us.get(p, 0.0) for p in COMM_PHASES)
+    compute_us = sum(v for k, v in phase_us.items()
+                     if k not in COMM_PHASES)
+    covered_us = 0.0
+    for pid, comm in comm_by_pid.items():
+        union = _union_intervals(comp_by_pid.get(pid, []))
+        for iv in comm:
+            covered_us += _overlap_with_union(iv, union)
+    frac = (min(max(covered_us / comm_us, 0.0), 1.0)
+            if comm_us > 0 else 0.0)
+    return {
+        "phases": {k: round(v / 1e6, 9)
+                   for k, v in sorted(phase_us.items())},
+        "comm_s": round(comm_us / 1e6, 9),
+        "compute_s": round(compute_us / 1e6, 9),
+        "overlap_fraction": round(frac, 6),
+        "n_device_events": n_dev,
+        "n_matched_events": n_matched,
+    }
+
+
+def analyze_trace_dir(profile_dir: str, compiled_text: str
+                      ) -> Optional[Dict[str, Any]]:
+    """Parse the newest capture session under `profile_dir` against the
+    train step's compiled HLO; returns the body of a ``profile`` record
+    (event/epoch fields added by the caller) or None when the session
+    left no parsable trace."""
+    files = find_trace_files(profile_dir)
+    if not files:
+        return None
+    events: List[Dict[str, Any]] = []
+    for f in files:
+        try:
+            events.extend(load_trace_events(f))
+        except (OSError, ValueError):
+            continue
+    if not events:
+        return None
+    op_map = hlo_op_map(compiled_text)
+    folded = fold_trace(events, op_map, module=module_name(compiled_text))
+    if folded["n_device_events"] == 0:
+        return None
+    folded["trace_files"] = [os.path.relpath(f, profile_dir)
+                             for f in files]
+    return folded
+
+
+# ---------------- CLI flag parsing ------------------------------------
+
+
+def parse_profile_epochs(spec: str) -> Tuple[int, int]:
+    """'A:B' -> (A, B): capture a device trace around the dispatched
+    blocks of epochs [A, B). Raises ValueError on malformed or empty
+    windows so the CLI fails before burning a run."""
+    m = re.fullmatch(r"(\d+):(\d+)", spec.strip())
+    if not m:
+        raise ValueError(
+            f"--profile-epochs expects 'A:B' (epoch window [A, B)), "
+            f"got {spec!r}")
+    a, b = int(m.group(1)), int(m.group(2))
+    if b <= a:
+        raise ValueError(
+            f"--profile-epochs window [{a}, {b}) is empty")
+    return a, b

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2  # v2: profile / anatomy / staleness record kinds
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -84,6 +84,45 @@ RECOVERY_FIELDS: Dict[str, str] = {
     "epoch": "integer",          # epoch training had reached on recovery
 }
 
+# one record per captured profiling window (obs/profiler.py): MEASURED
+# per-phase device seconds folded from a jax.profiler trace, plus the
+# measured comm/compute overlap fraction — the report CLI prints it
+# next to (and flags divergence from) the host-side estimate. Extras:
+# epoch_start/epoch_end (the --profile-epochs window), trace_files,
+# n_device_events/n_matched_events (parser coverage).
+PROFILE_FIELDS: Dict[str, str] = {
+    "event": "string",             # "profile"
+    "phases": "object",            # {spmm|dense|halo_comm|...: seconds}
+    "comm_s": "number",            # device seconds in comm phases
+    "compute_s": "number",         # device seconds in everything else
+    "overlap_fraction": "number",  # measured, in [0, 1]
+}
+
+# one record per compiled-step anatomy (obs/anatomy.py): estimated
+# FLOPs/bytes per phase from the optimized HLO walk + XLA's own cost /
+# memory analysis. flops/bytes_accessed are XLA's totals (null when the
+# backend exposes no analysis); attributed_flops_fraction is the share
+# of the estimate landing in a named (non-"other") phase.
+ANATOMY_FIELDS: Dict[str, str] = {
+    "event": "string",             # "anatomy"
+    "phases": "object",            # {phase: {flops, bytes, n_ops}}
+    "est_flops": "number",         # this parser's own total estimate
+    "flops": "number?",            # XLA cost_analysis total
+    "attributed_flops_fraction": "number?",
+}
+
+# one record per staleness probe epoch (--staleness-probe-every):
+# per-layer relative drift between the stale boundary features the
+# pipelined step consumed and the fresh ones it shipped —
+# ||h_stale - h_fresh|| / ||h_fresh|| — the approximation the pipeline
+# actually pays, measured for the first time.
+STALENESS_FIELDS: Dict[str, str] = {
+    "event": "string",             # "staleness"
+    "epoch": "integer",            # probe epoch
+    "layers": "object",            # {layer: {rel_drift, fresh_norm}}
+    "max_rel_drift": "number",     # max over layers
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -91,6 +130,9 @@ _BY_EVENT = {
     "summary": SUMMARY_FIELDS,
     "fault": FAULT_FIELDS,
     "recovery": RECOVERY_FIELDS,
+    "profile": PROFILE_FIELDS,
+    "anatomy": ANATOMY_FIELDS,
+    "staleness": STALENESS_FIELDS,
 }
 
 _JSON_TYPES = {

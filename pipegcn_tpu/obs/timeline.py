@@ -1,0 +1,212 @@
+"""Cross-rank Perfetto/Chrome-trace timelines from metrics JSONL.
+
+Multi-host runs leave one metrics JSONL stream per rank (PR 3's
+fault/recovery records are rank-attributed for exactly this reason).
+Reading N streams side by side in a text editor is how desync bugs
+hide; this module merges them into ONE ``trace.json`` readable in
+Perfetto (ui.perfetto.dev) or chrome://tracing:
+
+  - each rank is a trace *process* (pid = rank), its epochs a track of
+    ``X`` (complete) slices; loss and grad-norm ride as ``C`` counter
+    tracks per rank;
+  - epochs are aligned at dispatch boundaries: when every epoch record
+    carries the ``time_unix`` extra (the MetricsLogger stamps it), real
+    wall-clock alignment is used; otherwise epoch e of every rank is
+    aligned at max-over-ranks of the rank-local cumulative step time —
+    the lockstep boundary the SPMD program enforces;
+  - fault / recovery / preemption records appear as instant events on
+    the owning rank's track, so a chaos drill's kill -> detect ->
+    checkpoint -> resume sequence reads as a single picture;
+  - ``profile`` records (obs/profiler.py) contribute per-phase span
+    estimates inside their capture window;
+  - ``staleness`` records ride a counter track (max relative drift).
+
+Chrome-trace JSON contract kept deliberately strict (the timeline test
+pins it): object with "traceEvents" (list) + "displayTimeUnit"; every
+non-metadata event has numeric ts >= 0 (microseconds) and X events a
+numeric dur >= 0; events are emitted sorted by ts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def _rank_of(records: Sequence[Dict[str, Any]], fallback: int) -> int:
+    for r in records:
+        if isinstance(r.get("rank"), int):
+            return r["rank"]
+    return fallback
+
+
+def _epoch_starts(epochs: List[Dict[str, Any]]
+                  ) -> Tuple[Dict[int, float], bool]:
+    """{epoch -> start seconds} for one rank + whether real wall-clock
+    timestamps were available. Records are written at dispatch END, so
+    start = time_unix - step_time_s when stamped; the fallback is the
+    rank-local cumulative sum of step times."""
+    stamped = all(isinstance(r.get("time_unix"), (int, float))
+                  for r in epochs) and bool(epochs)
+    starts: Dict[int, float] = {}
+    if stamped:
+        for r in epochs:
+            starts[r["epoch"]] = (float(r["time_unix"])
+                                  - float(r.get("step_time_s", 0.0)))
+        return starts, True
+    t = 0.0
+    for r in sorted(epochs, key=lambda x: x.get("epoch", 0)):
+        starts[r["epoch"]] = t
+        t += float(r.get("step_time_s", 0.0))
+    return starts, False
+
+
+def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
+                   ) -> Dict[str, Any]:
+    """Merge per-rank metrics records into one Chrome-trace object.
+
+    `rank_records`: [(rank, records), ...] — rank ids need not be
+    contiguous; duplicate ranks are kept apart by their input order."""
+    events: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = []
+
+    # pass 1: per-rank epoch start maps; establish the global alignment
+    per_rank = []
+    any_unstamped = False
+    for order, (rank, records) in enumerate(rank_records):
+        records = list(records)
+        epochs = [r for r in records if r.get("event") == "epoch"
+                  and isinstance(r.get("epoch"), int)]
+        starts, stamped = _epoch_starts(epochs)
+        any_unstamped |= not stamped
+        per_rank.append((order, rank, records, epochs, starts, stamped))
+
+    if any_unstamped:
+        # lockstep alignment: every rank's epoch e starts at the max of
+        # the rank-local cumulative starts (the dispatch boundary the
+        # slowest rank sets); re-map every rank onto that shared axis
+        all_epochs = sorted({e for _, _, _, eps, st, _ in per_rank
+                             for e in st})
+        shared: Dict[int, float] = {}
+        t = 0.0
+        for e in all_epochs:
+            t = max([t] + [st[e] for _, _, _, _, st, _ in per_rank
+                           if e in st])
+            shared[e] = t
+            durs = [float(r.get("step_time_s", 0.0))
+                    for _, _, _, eps, _, _ in per_rank
+                    for r in eps if r.get("epoch") == e]
+            t += max(durs, default=0.0)
+        per_rank = [(o, rk, recs, eps, {e: shared[e] for e in st}, False)
+                    for o, rk, recs, eps, st, _ in per_rank]
+        t0 = 0.0
+    else:
+        t0 = min((min(st.values()) for _, _, _, _, st, _ in per_rank
+                  if st), default=0.0)
+
+    def us(t: float) -> float:
+        return round(max(t - t0, 0.0) * 1e6, 3)
+
+    for order, rank, records, epochs, starts, stamped in per_rank:
+        pid = rank if rank >= 0 else order
+        meta.append({"ph": "M", "pid": pid, "name": "process_name",
+                     "args": {"name": f"rank {rank}"}})
+        meta.append({"ph": "M", "pid": pid, "name": "process_sort_index",
+                     "args": {"sort_index": pid}})
+        for tid, tname in ((0, "epochs"), (1, "faults"), (2, "profile")):
+            meta.append({"ph": "M", "pid": pid, "tid": tid,
+                         "name": "thread_name", "args": {"name": tname}})
+            meta.append({"ph": "M", "pid": pid, "tid": tid,
+                         "name": "thread_sort_index",
+                         "args": {"sort_index": tid}})
+
+        for r in epochs:
+            e = r["epoch"]
+            ts = us(starts[e])
+            dur = round(float(r.get("step_time_s", 0.0)) * 1e6, 3)
+            events.append({
+                "ph": "X", "pid": pid, "tid": 0, "ts": ts, "dur": dur,
+                "name": f"epoch {e}",
+                "args": {k: r[k] for k in
+                         ("loss", "grad_norm", "staleness_age",
+                          "halo_bytes") if k in r},
+            })
+            if isinstance(r.get("loss"), (int, float)):
+                events.append({"ph": "C", "pid": pid, "tid": 0,
+                               "ts": ts + dur, "name": "loss",
+                               "args": {"loss": float(r["loss"])}})
+
+        def _epoch_ts(ep: Optional[Any], end: bool = False) -> float:
+            """Best-effort ts for a record anchored to an epoch index."""
+            if isinstance(ep, int) and ep in starts:
+                base = starts[ep]
+                if end:
+                    rec = next((x for x in epochs if x["epoch"] == ep),
+                               None)
+                    base += float(rec.get("step_time_s", 0.0)) if rec \
+                        else 0.0
+                return us(base)
+            if isinstance(ep, int) and starts:
+                lo, hi = min(starts), max(starts)
+                if ep <= lo:
+                    return us(starts[lo])
+                last = next(x for x in epochs if x["epoch"] == hi)
+                return us(starts[hi]
+                          + float(last.get("step_time_s", 0.0)))
+            return 0.0
+
+        for r in records:
+            ev = r.get("event")
+            if ev in ("fault", "recovery"):
+                ts = r.get("time_unix")
+                ts = (us(float(ts)) if stamped
+                      and isinstance(ts, (int, float))
+                      else _epoch_ts(r.get("epoch"), end=True))
+                events.append({
+                    "ph": "i", "pid": pid, "tid": 1, "ts": ts, "s": "t",
+                    "name": f"{ev}:{r.get('kind', '?')}",
+                    "args": {k: v for k, v in r.items()
+                             if k not in ("event",)
+                             and isinstance(v, (int, float, str, bool))},
+                })
+            elif ev == "staleness":
+                md = r.get("max_rel_drift")
+                if isinstance(md, (int, float)):
+                    events.append({
+                        "ph": "C", "pid": pid, "tid": 1,
+                        "ts": _epoch_ts(r.get("epoch"), end=True),
+                        "name": "staleness_rel_drift",
+                        "args": {"max_rel_drift": float(md)}})
+            elif ev == "profile":
+                a = r.get("epoch_start")
+                b = r.get("epoch_end")
+                ts = _epoch_ts(a if isinstance(a, int) else None)
+                te = _epoch_ts(b - 1 if isinstance(b, int) else None,
+                               end=True)
+                phases = r.get("phases") or {}
+                cursor = ts
+                span = max(te - ts, 0.0)
+                tot = sum(v for v in phases.values()
+                          if isinstance(v, (int, float))) or 1.0
+                for name, sec in sorted(phases.items()):
+                    if not isinstance(sec, (int, float)) or sec <= 0:
+                        continue
+                    dur = round(span * sec / tot, 3) if span else \
+                        round(sec * 1e6, 3)
+                    events.append({"ph": "X", "pid": pid, "tid": 2,
+                                   "ts": round(cursor, 3), "dur": dur,
+                                   "name": name,
+                                   "args": {"device_s": sec}})
+                    cursor += dur
+
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0),
+                               e.get("tid", 0)))
+    return {"displayTimeUnit": "ms", "traceEvents": meta + events}
+
+
+def write_timeline(obj: Dict[str, Any], path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+
+
+__all__ = ["build_timeline", "write_timeline"]

@@ -145,7 +145,8 @@ class SequentialRunner:
                  log: Callable[[str], None] = lambda s: None,
                  metrics=None,
                  check_finite: bool = True,
-                 fault_plan=None):
+                 fault_plan=None,
+                 staleness_probe_every: int = 0):
         if not tcfg.enable_pipeline:
             raise ValueError("SequentialRunner implements the pipelined "
                              "(staleness-1) step; vanilla mode has "
@@ -189,6 +190,15 @@ class SequentialRunner:
         # for chaos-testing that path.
         self._check_finite = check_finite
         self._fault_plan = fault_plan
+        # staleness probes (same contract as Trainer.fit's
+        # staleness_probe_every; obs/schema.py 'staleness' records): on
+        # probe epochs run_epoch compares the stale halo rows each rank
+        # consumed against the fresh ones it routed — host arrays here,
+        # so the drift is a plain numpy reduction over ranks
+        self._probe_every = max(int(staleness_probe_every), 0)
+        if self._probe_every and not keep_carry:
+            raise ValueError("staleness probes need keep_carry=True "
+                             "(one-shot mode has no carry to compare)")
 
         self._glayers = [str(i) for i in range(cfg.n_graph_layers)]
         self._widths = {k: cfg.layer_sizes[int(k)] for k in self._glayers}
@@ -478,6 +488,11 @@ class SequentialRunner:
         if new_norm0 is not None:  # resumed-at-P restarts keep norm
             self.norm = new_norm0
 
+        probe_due = (self._probe_every > 0
+                     and epoch % self._probe_every == 0
+                     and self.comm is not None)
+        drift_sq = {k: 0.0 for k in self._glayers}
+        fresh_sq = {k: 0.0 for k in self._glayers}
         if self.comm is not None:
             for r in range(P):
                 c = self.comm[r]
@@ -493,6 +508,15 @@ class SequentialRunner:
                         halo_next[sl] = sends_all[(r - dd) % P][k][sl]
                         # _bwd_perm: r's send rows were consumed by (r+d)
                         bgrad_next[sl] = probes_all[(r + dd) % P][k][sl]
+                    if probe_due:
+                        # stale = the carry consumed this epoch, fresh
+                        # = what the ranks just routed; aggregate the
+                        # squared norms over every rank
+                        d = (halo_next.astype(np.float64)
+                             - c["halo"][k].astype(np.float64))
+                        drift_sq[k] += float(np.sum(d * d))
+                        fresh_sq[k] += float(np.sum(
+                            halo_next.astype(np.float64) ** 2))
                     c["halo"][k] = halo_next
                     c["bgrad"][k] = bgrad_next
                     m = tcfg.corr_momentum
@@ -526,6 +550,20 @@ class SequentialRunner:
                 staleness_age=int(1 if epoch > 0 else 0),
                 memory=memory_snapshot(),
             )
+        if probe_due:
+            layers = {}
+            max_rel = 0.0
+            for k in self._glayers:
+                dn = float(np.sqrt(drift_sq[k]))
+                fn = float(np.sqrt(fresh_sq[k]))
+                rel = dn / fn if fn > 0 else (0.0 if dn == 0.0 else 1.0)
+                layers[k] = {"rel_drift": rel, "fresh_norm": fn}
+                max_rel = max(max_rel, rel)
+            if self._metrics is not None:
+                self._metrics.staleness(epoch=epoch, layers=layers,
+                                        max_rel_drift=max_rel)
+            self._log(f"staleness probe epoch {epoch}: max relative "
+                      f"drift {max_rel:.4f}")
         if self._check_finite and not (np.isfinite(mean_loss)
                                        and np.isfinite(gnorm)):
             from ..resilience import DivergenceError

@@ -1001,6 +1001,8 @@ class Trainer:
         checkpoint_every: int = 100,
         checkpoint_keep: int = 3,
         profile_dir: Optional[str] = None,
+        profile_epochs: Optional[Tuple[int, int]] = None,
+        staleness_probe_every: int = 0,
         measure_comm_cost: bool = False,
         sharded_eval: bool = False,
         async_eval: bool = True,
@@ -1074,7 +1076,31 @@ class Trainer:
         path is identical to coord=None.
 
         `checkpoint_keep` bounds the on-disk checkpoint generations
-        (keep-last-N; utils/checkpoint.py rotation)."""
+        (keep-last-N; utils/checkpoint.py rotation).
+
+        Profiling (docs/OBSERVABILITY.md "Profiling"):
+
+        `profile_epochs=(A, B)` with `profile_dir` captures a
+        ``jax.profiler`` device trace around the dispatched blocks of
+        epochs [A, B) (epoch-granular inside the window), then folds
+        the captured trace against the step's compiled HLO into a
+        contracted ``profile`` record: MEASURED per-phase device time
+        (spmm / dense / halo collectives / optimizer / ...) and the
+        measured comm/compute overlap fraction — the quantity the
+        report CLI previously only estimated. Without `profile_epochs`
+        the legacy auto-window (epochs start+6..start+8) applies, and
+        the same analysis runs on it. The record rides the metrics
+        sink and the returned result dict ("profile").
+
+        `staleness_probe_every=N` (pipelined mode only) measures, every
+        N epochs, the per-layer relative drift between the STALE
+        boundary features the step consumed and the FRESH ones it
+        shipped: ``||h_stale - h_fresh|| / ||h_fresh||``. The stale
+        buffers are snapshotted before the dispatch (they are donated
+        into it) and compared against the post-step carry — exact, and
+        the only cost is one halo-buffer copy + a small jitted norm
+        program on probe epochs. Emits a ``staleness`` record per
+        probe; probe epochs dispatch unfused (chunk=1)."""
         from ..utils.checkpoint import save_checkpoint
 
         tcfg = self.tcfg
@@ -1158,6 +1184,57 @@ class Trainer:
         timer = PhaseTimer()
         profiling = False
         n_epochs = tcfg.n_epochs
+        # ---- profiling window + staleness probes (obs/profiler.py) ----
+        prof_window = None
+        if profile_epochs is not None:
+            a, b = int(profile_epochs[0]), int(profile_epochs[1])
+            a, b = max(a, start_epoch), min(b, n_epochs)
+            if b > a:
+                prof_window = (a, b)
+            else:
+                log_fn(f"warning: --profile-epochs window "
+                       f"{profile_epochs} is outside the run "
+                       f"[{start_epoch}, {n_epochs}); no trace captured")
+            if not profile_dir:
+                log_fn("warning: profile_epochs set without "
+                       "profile_dir; no trace captured")
+                prof_window = None
+        prof_started_at = None   # first epoch inside the live capture
+        prof_record = None       # the parsed profile record (result)
+        probe_every = max(int(staleness_probe_every), 0)
+        if probe_every and not tcfg.enable_pipeline:
+            log_fn("warning: staleness probes need --enable-pipeline "
+                   "(vanilla exchanges are synchronous — drift is 0 by "
+                   "construction); probes disabled")
+            probe_every = 0
+
+        def _finish_profile(window):
+            """Stop + fold the live capture into a profile record."""
+            jax.profiler.stop_trace()
+            log_fn(f"profiler trace written to {profile_dir}")
+            try:
+                body = self._profile_analysis(profile_dir)
+            except Exception as exc:  # noqa: BLE001 — telemetry only
+                log_fn(f"profile analysis failed: {exc!r}")
+                return None
+            if body is None:
+                log_fn("profile analysis found no parsable trace "
+                       "events (backend without Chrome-trace export?)")
+                return None
+            body["epoch_start"], body["epoch_end"] = window
+            log_fn(f"profile window [{window[0]}, {window[1]}): "
+                   f"measured overlap "
+                   f"{body['overlap_fraction']:.1%} "
+                   f"(comm {body['comm_s']:.4f}s device, compute "
+                   f"{body['compute_s']:.4f}s)")
+            if metrics is not None:
+                extras = {k: v for k, v in body.items()
+                          if k not in ("phases", "comm_s", "compute_s",
+                                       "overlap_fraction")}
+                metrics.profile(body["phases"], body["comm_s"],
+                                body["compute_s"],
+                                body["overlap_fraction"], **extras)
+            return body
 
         fused = max(1, int(getattr(tcfg, "fused_epochs", 1)))
         # per-epoch work (logs/eval/checkpoint/profiler) happens at these
@@ -1287,16 +1364,38 @@ class Trainer:
                             coord.note_snapshot(*last_good)
                     # the crash handler below does the rank-0 save
                     raise Preempted(epoch, preempt_reason)
-                if profile_dir and not profiling and \
-                        epoch >= min(start_epoch + 6, n_epochs - 1):
-                    jax.profiler.start_trace(profile_dir)
-                    profiling = True
+                if profile_dir and not profiling:
+                    if prof_window is not None:
+                        if prof_window[0] <= epoch < prof_window[1]:
+                            jax.profiler.start_trace(profile_dir)
+                            profiling = True
+                            prof_started_at = epoch
+                    elif epoch >= min(start_epoch + 6, n_epochs - 1):
+                        jax.profiler.start_trace(profile_dir)
+                        profiling = True
+                        prof_started_at = epoch
                 chunk = min(fused, n_epochs - epoch)
                 for m in periods:
                     to_boundary = m - epoch % m
                     chunk = min(chunk, to_boundary)
-                if profiling or (profile_dir and epoch < start_epoch + 10):
+                if prof_window is not None and not profiling and \
+                        epoch < prof_window[0]:
+                    # a fused block must not straddle the window start
+                    chunk = min(chunk, prof_window[0] - epoch)
+                if profiling or (profile_dir and prof_window is None
+                                 and epoch < start_epoch + 10):
                     chunk = 1  # epoch-granular around the profiled window
+                # staleness probe: snapshot the stale halo carry BEFORE
+                # the dispatch donates it (obs docs: drift is old vs
+                # new carry — exchange(h[e-1]) vs exchange(h[e]))
+                probe_due = (probe_every > 0
+                             and epoch % probe_every == 0
+                             and bool(self.state.get("comm")))
+                old_halo = None
+                if probe_due:
+                    chunk = 1
+                    old_halo = jax.tree_util.tree_map(
+                        jnp.copy, self.state["comm"]["halo"])
                 timer.clear()
                 # annotate=True: the host span shows up in --profile-dir
                 # traces next to the named device phases
@@ -1310,10 +1409,14 @@ class Trainer:
                         loss = float(blk_losses[-1])
                     jax.block_until_ready(self.state["params"])
                 dur = timer.durations()["step"] / chunk
-                if profiling and epoch >= start_epoch + 8:
-                    jax.profiler.stop_trace()
+                stop_profile = profiling and (
+                    epoch + chunk >= prof_window[1]
+                    if prof_window is not None
+                    else epoch >= start_epoch + 8)
+                if stop_profile:
                     profiling = False
-                    log_fn(f"profiler trace written to {profile_dir}")
+                    prof_record = _finish_profile(
+                        (prof_started_at, epoch + chunk)) or prof_record
                 # first 5 epochs after (re)start excluded from averaged
                 # timings — they include jit compilation (the reference
                 # excludes epochs <5 and log epochs, train.py:364). A chunk
@@ -1369,6 +1472,19 @@ class Trainer:
                                 else 0),
                             memory=mem,
                         )
+                # ---- staleness probe: relative drift between the
+                # stale halo features this epoch consumed (snapshotted
+                # above) and the fresh ones it shipped ----
+                if probe_due and old_halo is not None:
+                    layers, max_rel = self._staleness_drift(
+                        old_halo, self.state["comm"]["halo"])
+                    if metrics is not None:
+                        metrics.staleness(epoch=epoch, layers=layers,
+                                          max_rel_drift=max_rel)
+                    else:
+                        log_fn(f"staleness probe epoch {epoch}: max "
+                               f"relative drift {max_rel:.4f}")
+                    old_halo = None
                 # ---- divergence sentinel: check the block, roll back
                 # on trip (restore last good snapshot, back the LR off,
                 # flush the stale halo carry), bounded retries. With an
@@ -1642,10 +1758,11 @@ class Trainer:
             _harvest_eval(_dispatch_eval(epoch - 1, loss, dur))
 
         if profiling:
-            # run ended inside the trace window; finalize the trace
-            jax.profiler.stop_trace()
-            log_fn(f"profiler trace written to {profile_dir}")
-        if profile_dir and not profiling and \
+            # run ended inside the trace window; finalize + analyze
+            profiling = False
+            prof_record = _finish_profile(
+                (prof_started_at, epoch)) or prof_record
+        if profile_dir and prof_record is None and \
                 n_epochs - start_epoch <= 0:
             log_fn("warning: run too short, no profiler trace captured")
 
@@ -1665,6 +1782,9 @@ class Trainer:
             "eval_time": float(np.mean(eval_durs)) if eval_durs else None,
             "comm_cost": comm_cost if comm_measured else None,
             "history": history,
+            # the parsed profiling-window record (measured per-phase
+            # device time + overlap fraction), None when no window ran
+            "profile": prof_record,
         }
         if tcfg.eval and eval_graphs and "test" in eval_graphs and \
                 best_params is not None:
@@ -1699,6 +1819,58 @@ class Trainer:
                     pass
             metrics.summary(**summ)
         return result
+
+    # ---------------- profiling / staleness ---------------------------
+
+    def step_compiled_text(self) -> str:
+        """Optimized-HLO text of the single-epoch train step (the
+        metadata op_name scopes are the join key between trace events
+        and named phases — obs/profiler.py / obs/anatomy.py). Hits
+        jax's compile cache when the step already ran unfused."""
+        rng = jax.random.fold_in(self._epoch_rng_base(), 0)
+        return self._step.lower(self.state, self.data, rng) \
+            .compile().as_text()
+
+    def _profile_analysis(self, profile_dir: str):
+        """Fold the newest capture under `profile_dir` against the
+        compiled step; returns a profile-record body or None."""
+        from ..obs.profiler import analyze_trace_dir
+
+        return analyze_trace_dir(profile_dir, self.step_compiled_text())
+
+    def _staleness_drift(self, old_halo, new_halo):
+        """Per-layer relative drift between the stale halo carry
+        consumed this epoch (`old_halo`, snapshotted pre-dispatch) and
+        the fresh one the step shipped (`new_halo`): the approximation
+        error the staleness-1 pipeline pays. Returns ({layer:
+        {rel_drift, fresh_norm}}, max_rel_drift). The norm program is
+        jitted once and reused (cached by pytree structure)."""
+        fn = getattr(self, "_staleness_norm_fn", None)
+        if fn is None:
+            @jax.jit
+            def fn(old, new):
+                out = {}
+                for k in old:
+                    d = (new[k].astype(jnp.float32)
+                         - old[k].astype(jnp.float32))
+                    out[k] = (jnp.sqrt(jnp.sum(d * d)),
+                              jnp.sqrt(jnp.sum(jnp.square(
+                                  new[k].astype(jnp.float32)))))
+                return out
+
+            self._staleness_norm_fn = fn
+        norms = jax.device_get(fn(old_halo, new_halo))
+        layers = {}
+        max_rel = 0.0
+        for k, (dn, fresh) in sorted(norms.items()):
+            dn, fresh = float(dn), float(fresh)
+            # degenerate all-zero fresh buffer: report 1.0 (total
+            # drift) rather than an inf that breaks strict JSON readers
+            rel = dn / fresh if fresh > 0 else (0.0 if dn == 0.0
+                                               else 1.0)
+            layers[k] = {"rel_drift": rel, "fresh_norm": fresh}
+            max_rel = max(max_rel, rel)
+        return layers, max_rel
 
     # ---------------- cost analysis -----------------------------------
 

@@ -496,6 +496,14 @@ class Coordinator:
                 except Exception as exc:  # noqa: BLE001
                     self.log(f"emergency checkpoint failed: {exc!r}")
         finally:
+            # _hard_exit skips atexit AND io teardown: fsync every
+            # buffered metrics record (the fault record above explains
+            # this death — it must survive it)
+            if self.metrics is not None:
+                try:
+                    self.metrics.hard_flush()
+                except Exception:  # noqa: BLE001 — exit anyway
+                    pass
             _hard_exit(EXIT_PREEMPTED)
 
     # ---------------- consensus ---------------------------------------

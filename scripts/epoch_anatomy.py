@@ -14,6 +14,12 @@ Variants: baseline (block-u4-float8, the headline config) |
 dropout=0 (no RNG, no mask traffic) | norm=None (no LayerNorm
 fwd/bwd) | n_linear tail only dispatch floor probe: fused=1 vs 4.
 
+The ablation clock itself lives in pipegcn_tpu/obs/anatomy.py
+(`time_config` / `time_variants`) next to the structural HLO
+attribution (`step_anatomy`, the CLI's --anatomy flag); this script is
+the chip-window wrapper that picks the headline config's variants and
+writes results/epoch_anatomy.json.
+
 Usage: python scripts/epoch_anatomy.py [--part ...] [--reps 3]
 """
 
@@ -21,34 +27,9 @@ import argparse
 import json
 import os
 import sys
-import time
-
-import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-
-
-def time_config(sg, cfg, tcfg, reps, blk):
-    from pipegcn_tpu.parallel import Trainer
-
-    t0 = time.perf_counter()
-    tr = Trainer(sg, cfg, tcfg)
-    setup = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    tr.train_epochs(0, 1)
-    compile_s = time.perf_counter() - t0
-    if blk > 1:
-        tr.train_epochs(1, blk)  # fused-program compile, off the clock
-    times = []
-    e = 1 + blk
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        tr.train_epochs(e, blk)
-        times.append((time.perf_counter() - t0) / blk)
-        e += blk
-    del tr
-    return float(np.median(times)), setup, compile_s
 
 
 def main():
@@ -104,6 +85,8 @@ def main():
         ("rbg", base, dataclasses.replace(tcfg, rng_impl="rbg")),
         ("fused1", base, dataclasses.replace(tcfg, fused_epochs=1)),
     ]
+    from pipegcn_tpu.obs.anatomy import time_config
+
     rec = {"backend": jax.default_backend()}
     base_s = None
     for name, cfg, tc in variants:

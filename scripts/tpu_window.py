@@ -9,11 +9,22 @@ results/tpu_window/. Each step is its own subprocess with a timeout;
 the tunnel is re-probed between steps so a mid-queue outage stops the
 run cleanly instead of hanging it.
 
+Window preflight: each queue entry declares the on-disk artifacts it
+needs (4th tuple element, glob patterns relative to the repo). The
+moment a window opens the harvester verifies them and SKIPS entries
+with missing artifacts — a loud `skipped` record in
+results/tpu_window/window.jsonl plus a stderr line — instead of
+burning scarce window minutes rebuilding partitions the host could
+have built outside the window (two rounds of windows were lost to
+exactly that). `--dry-run` prints the preflight verdicts and exits.
+
 Usage: nohup python scripts/tpu_window.py [--poll-s 300] &
        python scripts/tpu_window.py --once   # single probe+queue pass
+       python scripts/tpu_window.py --dry-run  # preflight only
 """
 
 import argparse
+import glob as _glob
 import json
 import os
 import subprocess
@@ -25,21 +36,26 @@ sys.path.insert(0, REPO)
 
 LOG_DIR = os.path.join(REPO, "results", "tpu_window")
 
-# (name, argv, timeout_s) — priority order: most load-bearing first
-# (round-5 order: VERDICT r4 items 1-3 lead). bench.py self-degrades
-# on crashes; the microbench/gat steps are best-effort.
+# the bench-artifact the Reddit-shape probes all assume (built by
+# scripts/build_bench_artifact.py or any prior bench run)
+_BENCH_PART = "partitions/bench-reddit-1-c2-s1024"
+
+# (name, argv, timeout_s, requires) — priority order: most load-bearing
+# first (round-5 order: VERDICT r4 items 1-3 lead). bench.py
+# self-degrades on crashes; the microbench/gat steps are best-effort.
+# `requires` are glob patterns (repo-relative) the preflight checks.
 QUEUE = [
     # VERDICT r5 item 1: attribute the 0.518 s non-SpMM floor (ablate
     # dropout RNG / LayerNorm / fbuf assembly / dispatch amortization)
     ("epoch_anatomy",
      [sys.executable, "scripts/epoch_anatomy.py"],
-     2400),
+     2400, [_BENCH_PART]),
     # VERDICT r5 item 3: decompose the remainder's 0.63 s (cast /
     # gather-traffic / ladder-structure / chunking shares + in-session
     # cliff anchor)
     ("rem_probe",
      [sys.executable, "scripts/rem_probe.py"],
-     2400),
+     2400, [_BENCH_PART]),
     # calibrated-task convergence study (VERDICT item 2) THIRD so a
     # single ~45-min window covers the top-2 probes AND puts real
     # training hours on the accuracy claim (on chip this study is
@@ -52,33 +68,33 @@ QUEUE = [
       "--noise", "32", "--homophily", "0.6", "--label-noise", "0.03",
       "--light-dir", "results/convergence_light/d492",
       "--time-budget", "1500"],
-     2400),
+     2400, []),
     # refresh the round-5 headline + results/last_tpu_bench.json
     ("bench_u4_f8_r5",
      [sys.executable, "bench.py", "--block-group", "4",
       "--rem-dtype", "float8", "--no-compare"],
-     3600),
+     3600, [_BENCH_PART]),
     # VERDICT r5 item 8: second shape point for the auto-kernel policy
     ("offshape_products",
      [sys.executable, "scripts/offshape_bench.py", "--shape",
       "products", "--impl", "auto"],
-     3600),
+     3600, []),
     ("offshape_products_bucket",
      [sys.executable, "scripts/offshape_bench.py", "--shape",
       "products", "--impl", "bucket"],
-     3600),
+     3600, []),
     # the policy question is bucket-vs-BLOCK at this shape (auto
     # resolves to bucket there); block tables prewarmed host-side
     ("offshape_products_block",
      [sys.executable, "scripts/offshape_bench.py", "--shape",
       "products", "--impl", "block"],
-     3600),
+     3600, []),
     # cheap GAT attribution (incl. the narrow-row gather-rate curve
     # that decides the el-packing-vs-Pallas-softmax question) BEFORE
     # the convergence legs, which absorb every remaining window second
     ("gat_microbench",
      [sys.executable, "scripts/gat_microbench.py"],
-     2400),
+     2400, []),
     # VERDICT r3 item 3, full scale: the 97.1%-claim analogue at FULL
     # node count AND full degree (232,965 nodes x avg degree 492 =
     # Reddit's shape, reference README.md:91-99), P=2 like the
@@ -102,7 +118,7 @@ QUEUE = [
       "--light-dir", "results/convergence_light/full",
       "--state-dir", "results/convergence_state_full",
       "--out", "results/convergence_fullscale.md"],
-     7200),
+     7200, []),
     # LAST: the raw-xla GAT compile crashed the remote compile helper
     # once (HTTP 500) around a tunnel death — quarantined at the tail
     # so a repeat cannot burn the load-bearing steps above
@@ -110,11 +126,11 @@ QUEUE = [
      [sys.executable, "scripts/gat_bench.py",
       "--dataset", "synthetic:60000:30:602:41",
       "--rem-dtype", "float8"],
-     3600),
+     3600, []),
     ("gat_bench_small_xla",
      [sys.executable, "scripts/gat_bench.py",
       "--dataset", "synthetic:60000:30:602:41", "--impl", "xla"],
-     3600),
+     3600, []),
 ]
 
 
@@ -132,11 +148,58 @@ def probe(timeout_s: float = 60.0) -> bool:
         return False
 
 
+def preflight(requires, repo: str = REPO) -> list:
+    """Missing artifact patterns of one queue entry (glob-expanded,
+    repo-relative); [] means the entry may run."""
+    missing = []
+    for pat in requires:
+        full = pat if os.path.isabs(pat) else os.path.join(repo, pat)
+        if not _glob.glob(full):
+            missing.append(pat)
+    return missing
+
+
+def preflight_queue(queue=None, repo: str = REPO):
+    """{name: missing} for every entry whose artifacts are absent —
+    computed ONCE at window start so no window second is burned
+    rebuilding what the host could have built offline."""
+    queue = QUEUE if queue is None else queue
+    return {name: miss for name, _, _, req in queue
+            if (miss := preflight(req, repo))}
+
+
+def _skip_record(name: str, missing: list) -> None:
+    """Loud skip: stderr line + a durable `skipped` record in
+    window.jsonl (free-form MetricsLogger event, fsynced)."""
+    print(f"# {name}: SKIPPED — missing artifacts {missing} "
+          f"(build them outside the window)", file=sys.stderr,
+          flush=True)
+    try:
+        from pipegcn_tpu.obs import MetricsLogger
+
+        os.makedirs(LOG_DIR, exist_ok=True)
+        with MetricsLogger(os.path.join(LOG_DIR, "window.jsonl")) as ml:
+            ml.event("skipped", step=name, missing=missing,
+                     time_unix=time.time())
+            ml.hard_flush()
+    except Exception as exc:  # noqa: BLE001 — the queue must go on
+        print(f"# could not write skipped record: {exc!r}",
+              file=sys.stderr, flush=True)
+
+
 def run_queue(skip: set) -> None:
     os.makedirs(LOG_DIR, exist_ok=True)
-    for name, argv, tmo in QUEUE:
+    # preflight the WHOLE queue at window open (artifacts do not
+    # appear mid-window; one verdict per window keeps the log readable)
+    skipped = preflight_queue()
+    for name, miss in skipped.items():
+        if name not in skip:
+            _skip_record(name, miss)
+    for name, argv, tmo, _req in QUEUE:
         if name in skip:
             continue
+        if name in skipped:
+            continue  # skipped loudly above; not marked done
         if not probe():
             print(f"# tunnel died before {name}; stopping queue",
                   flush=True)
@@ -163,7 +226,20 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--poll-s", type=float, default=300.0)
     ap.add_argument("--once", action="store_true")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print each entry's preflight verdict "
+                         "(runnable vs missing artifacts) and exit "
+                         "without probing the tunnel")
     args = ap.parse_args()
+    if args.dry_run:
+        skipped = preflight_queue()
+        for name, _, _, req in QUEUE:
+            if name in skipped:
+                print(f"{name}: SKIP (missing {skipped[name]})")
+            else:
+                print(f"{name}: ok"
+                      + (f" (requires {req})" if req else ""))
+        sys.exit(1 if skipped else 0)
     done: set = set()
     status = os.path.join(LOG_DIR, "status.json")
     if os.path.exists(status):
@@ -173,7 +249,7 @@ def main() -> None:
         if probe():
             print("# tunnel UP — running measurement queue", flush=True)
             run_queue(done)
-            if all(name in done for name, _, _ in QUEUE):
+            if all(name in done for name, _, _, _ in QUEUE):
                 print("# queue complete", flush=True)
                 return
         elif args.once:

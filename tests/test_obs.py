@@ -28,10 +28,12 @@ from pipegcn_tpu.utils.timer import CommTimer
 
 # ---------------- schema -------------------------------------------------
 
-# FROZEN copy of the v1 contract. If any assert below fires, a field
-# was removed or retyped without bumping SCHEMA_VERSION — consumers
-# (bench trajectory, report CLI, scripts) would break silently.
-_V1_FIELDS = {
+# FROZEN copy of the v2 contract (v1 + the fault/recovery kinds PR 2/3
+# added as extras + the profile/anatomy/staleness kinds that bumped the
+# version to 2). If any assert below fires, a field was removed or
+# retyped without bumping SCHEMA_VERSION — consumers (bench trajectory,
+# report CLI, timeline CLI, scripts) would break silently.
+_V2_FIELDS = {
     "run": {
         "event": "string", "schema_version": "integer",
         "time_unix": "number", "config": "object", "device": "object",
@@ -50,16 +52,39 @@ _V1_FIELDS = {
         "event": "string", "n_epochs": "integer",
         "epoch_time_s": "number?", "best_val": "number",
     },
+    "fault": {
+        "event": "string", "kind": "string", "epoch": "integer",
+    },
+    "recovery": {
+        "event": "string", "kind": "string", "epoch": "integer",
+    },
+    "profile": {
+        "event": "string", "phases": "object", "comm_s": "number",
+        "compute_s": "number", "overlap_fraction": "number",
+    },
+    "anatomy": {
+        "event": "string", "phases": "object", "est_flops": "number",
+        "flops": "number?", "attributed_flops_fraction": "number?",
+    },
+    "staleness": {
+        "event": "string", "epoch": "integer", "layers": "object",
+        "max_rel_drift": "number",
+    },
 }
 
 
-def test_schema_v1_drift_guard():
+def test_schema_v2_drift_guard():
     current = {"run": obs_schema.RUN_FIELDS,
                "epoch": obs_schema.EPOCH_FIELDS,
                "eval": obs_schema.EVAL_FIELDS,
-               "summary": obs_schema.SUMMARY_FIELDS}
-    if obs_schema.SCHEMA_VERSION == 1:
-        for kind, fields in _V1_FIELDS.items():
+               "summary": obs_schema.SUMMARY_FIELDS,
+               "fault": obs_schema.FAULT_FIELDS,
+               "recovery": obs_schema.RECOVERY_FIELDS,
+               "profile": obs_schema.PROFILE_FIELDS,
+               "anatomy": obs_schema.ANATOMY_FIELDS,
+               "staleness": obs_schema.STALENESS_FIELDS}
+    if obs_schema.SCHEMA_VERSION == 2:
+        for kind, fields in _V2_FIELDS.items():
             for name, tag in fields.items():
                 assert current[kind].get(name) == tag, (
                     f"schema field {kind}.{name} removed or retyped "
@@ -67,7 +92,7 @@ def test_schema_v1_drift_guard():
     else:
         # a bump legitimizes any field change; the contract is that the
         # version moved WITH the change
-        assert obs_schema.SCHEMA_VERSION > 1
+        assert obs_schema.SCHEMA_VERSION > 2
 
 
 def test_validate_record():
