@@ -57,6 +57,28 @@ MAX_DISPATCH_S = 25.0
 _STAGE_FLAG = "--_stage"
 
 
+class NonFiniteLoss(RuntimeError):
+    """A training loss went non-finite mid-measurement: the run is
+    diverged, and timing a diverged program measures the wrong program
+    — abort IMMEDIATELY (the offshape-products NaN burned three full
+    measurement blocks after the first NaN epoch, VERDICT r5) with a
+    loud fault record and exit 3 instead of publishing green JSON."""
+
+    def __init__(self, epoch: int, loss: float):
+        super().__init__(
+            f"non-finite loss {loss!r} at epoch {epoch}")
+        self.epoch = epoch
+        self.loss = loss
+
+
+def _check_finite(loss: float, epoch: int) -> None:
+    if not np.isfinite(loss):
+        print(f"# NON-FINITE LOSS at epoch {epoch} — aborting the "
+              f"measurement now (every further block would time a "
+              f"diverged program)", file=sys.stderr)
+        raise NonFiniteLoss(epoch, float(loss))
+
+
 def _reexec_degraded(stage: int, reason: str) -> None:
     delay = min(30.0 * (2 ** stage), 120.0)
     print(f"# measurement crashed at stage {stage}: {reason}\n"
@@ -349,6 +371,22 @@ def main():
     try:
         result = _measure(args, backend, device_kind, n_parts, degraded,
                           sg, hidden, n_layers, spmm_chunk)
+    except NonFiniteLoss as exc:
+        # divergence is a NUMERICS failure, not a worker crash — the
+        # degraded re-exec ladder would just re-measure the same NaN
+        # at lower quality. Loud fault record + red exit instead.
+        print(f"# FATAL: {exc} — benchmark invalid; exiting 3",
+              file=sys.stderr)
+        if args.metrics_out:
+            from pipegcn_tpu.obs import MetricsLogger
+
+            try:
+                with MetricsLogger(args.metrics_out) as ml:
+                    ml.fault(kind="non-finite-loss", epoch=exc.epoch,
+                             reason=str(exc), backend=backend)
+            except OSError:
+                pass
+        sys.exit(3)
     except Exception as exc:  # noqa: BLE001 — worker crashes arrive as
         # JaxRuntimeError/RuntimeError/XlaRuntimeError; anything fatal
         # mid-measurement gets one shot at a degraded re-exec
@@ -420,13 +458,13 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
             return loss
 
         t0 = time.perf_counter()
-        run_block(e, 1)
+        _check_finite(run_block(e, 1), e)
         e += 1
         compile_s = time.perf_counter() - t0
         singles = []
         for _ in range(2 if blk > 1 and not force_blk else 1):
             t0 = time.perf_counter()
-            run_block(e, 1)
+            _check_finite(run_block(e, 1), e)
             e += 1
             singles.append(time.perf_counter() - t0)
         single_s = min(singles)
@@ -453,7 +491,7 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         if my_blk > 1:
             t0 = time.perf_counter()
             for _ in range(max(1, warmup_blocks)):
-                run_block(e, my_blk)
+                _check_finite(run_block(e, my_blk), e + my_blk - 1)
                 e += my_blk
             print(f"# fused-block warmup/compile "
                   f"({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
@@ -464,6 +502,9 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
             loss = run_block(e, my_blk)
             e += my_blk
             times.append((time.perf_counter() - t0) / my_blk)
+            # abort on the FIRST non-finite block, not after all of
+            # them: a NaN run must stop burning TPU-window time
+            _check_finite(loss, e - 1)
         return float(np.median(times)), loss, my_blk
 
     headline_pipeline = not args.no_pipeline
@@ -485,6 +526,12 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         "pipeline": headline_pipeline,
         "loss": round(loss, 4) if np.isfinite(loss) else None,
     }
+    if trainer.fallbacks:
+        # the kernel fallback ladder fired mid-measurement: the number
+        # was produced by the DOWNGRADED kernel, and the JSON must say so
+        extras["kernel_fallbacks"] = [
+            f"{f['from_impl']}->{f['to_impl']}" for f in trainer.fallbacks]
+        extras["spmm_impl"] = trainer._current_impl()
     if degraded:
         extras["degraded"] = True
     if args.stage > 0:

@@ -239,6 +239,11 @@ def run(args) -> dict:
         raise ValueError(
             "--resume requires --checkpoint-dir (there is nothing to "
             "resume from)")
+    # validate the loss-scale spec BEFORE the partition/trainer build
+    # (a typo'd flag must not burn a multi-minute setup)
+    from ..resilience.numerics import LossScaleConfig
+
+    LossScaleConfig.parse(getattr(args, "loss_scale", "off"))
     profile_epochs = None
     if getattr(args, "profile_epochs", ""):
         # parse BEFORE the partition/trainer build: a malformed window
@@ -314,6 +319,7 @@ def run(args) -> dict:
         block_group=args.block_group,
         block_fused=args.block_fused,
         rem_dtype=args.rem_dtype,  # 'none' normalized by ModelConfig
+        rem_amax=args.rem_amax,
         dtype=args.dtype,
     )
     tcfg = TrainConfig(
@@ -329,6 +335,8 @@ def run(args) -> dict:
         eval=args.eval,
         fused_epochs=args.fused_epochs,
         rng_impl=args.rng_impl,
+        numerics_tripwire=args.numerics_tripwire,
+        loss_scale=args.loss_scale,
     )
     trainer = Trainer(sg, cfg, tcfg)
 

@@ -231,10 +231,35 @@ def create_parser() -> argparse.ArgumentParser:
                         help="deterministic chaos injection: comma-"
                              "separated kind@epoch[:rN] entries "
                              "(nan-loss, nan-grad, sigterm, crash, "
-                             "corrupt-ckpt, desync, hang), e.g. "
+                             "corrupt-ckpt, desync, hang, overflow, "
+                             "kernel-crash), e.g. "
                              "'nan-loss@5:r1,sigterm@8'; each fires "
                              "once, host-side only; :rN targets one "
                              "rank (process index) in multi-host runs")
+    # ---- numerics guardrails (docs/RESILIENCE.md "Numerics") ----
+    parser.add_argument("--loss-scale", "--loss_scale", type=str,
+                        default="off",
+                        help="mixed-precision loss scaling: 'auto' "
+                             "(dynamic — backoff on overflow, regrow "
+                             "after a clean streak), a positive number "
+                             "(static scale), or 'off'. Non-'off' also "
+                             "arms in-graph overflow-skip: an epoch "
+                             "whose reduced gradient is non-finite "
+                             "keeps params unchanged (skips counted in "
+                             "the metrics JSONL as 'numerics' records)")
+    parser.add_argument("--rem-amax", "--rem_amax", action="store_true",
+                        help="amax-clamped fp8 transport cast: scale "
+                             "each gathered tensor by a power of two "
+                             "from its amax so the e4m3/e5m2 cast lands "
+                             "mid-range instead of saturating or "
+                             "flushing to zero (only with --rem-dtype "
+                             "float8)")
+    parser.add_argument("--no-numerics-tripwire", "--no_numerics_tripwire",
+                        action="store_false", dest="numerics_tripwire",
+                        help="drop the in-graph per-phase non-finite "
+                             "tripwire from the step (fault records "
+                             "then name no NaN birth phase)")
+    parser.set_defaults(numerics_tripwire=True)
     # ---- cross-rank coordination (docs/RESILIENCE.md multi-host) ----
     parser.add_argument("--watchdog-timeout", "--watchdog_timeout",
                         type=float, default=60.0,

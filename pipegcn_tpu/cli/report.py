@@ -202,6 +202,12 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         out["staleness_max_rel_drift"] = round(max(drifts), 6)
         out["staleness_last_rel_drift"] = round(drifts[-1], 6)
 
+    # ---- numerics health (resilience/numerics.py): first-NaN phase,
+    # loss-scale backoff/skip counts, kernel fallbacks taken ----
+    from ..resilience.numerics import summarize_numerics
+
+    out.update(summarize_numerics(records))
+
     # ---- compiled-step anatomy (obs/anatomy.py) ----
     anatomies = [r for r in records if r.get("event") == "anatomy"]
     if anatomies:
@@ -295,6 +301,25 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
                                sorted(s["fault_source_ranks"].items()))
             lines.append(f"  {'consensus source ranks':<26} {by_src} "
                          f"({s.get('n_agreed_faults', 0)} agreed)")
+    # ---- numerics health ----
+    if s.get("first_nan_phase"):
+        lines.append("  {:<26} {} (epoch {})".format(
+            "!! first NaN phase", s["first_nan_phase"],
+            s.get("first_nan_epoch", "?")))
+    if s.get("loss_scale_skips") is not None:
+        lines.append("  {:<26} {} skipped, {} backoffs, {} regrowths, "
+                     "scale {}".format(
+                         "loss-scale events", s["loss_scale_skips"],
+                         s.get("loss_scale_backoffs", 0),
+                         s.get("loss_scale_growths", 0),
+                         s.get("loss_scale_last", "?")))
+    elif s.get("loss_scale_growths"):
+        lines.append("  {:<26} {} regrowths, scale {}".format(
+            "loss-scale events", s["loss_scale_growths"],
+            s.get("loss_scale_last", "?")))
+    if s.get("kernel_fallbacks"):
+        lines.append("  {:<26} {}".format(
+            "kernel fallbacks", ", ".join(s["kernel_fallbacks"])))
     row("best val", "best_val", "{:.4f}")
     row("best epoch", "best_epoch")
     row("test acc", "test_acc", "{:.4f}")

@@ -91,6 +91,13 @@ class ModelConfig:
     # (use_pp=False / gcn) clamp instead of going NaN; the one-shot
     # metric-bearing paths (pp precompute, sharded eval) are exempt.
     rem_dtype: Optional[str] = None
+    # amax-clamped fp8 transport (resilience/numerics guardrail): scale
+    # each gathered tensor by a power of two derived from its running
+    # amax so the cast lands mid-range in e4m3/e5m2 instead of
+    # saturating (or flushing to zero) at the static clamp; the inverse
+    # scale is applied after the (linear) aggregation. No-op unless
+    # rem_dtype is 'float8'.
+    rem_amax: bool = False
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     def __post_init__(self):
@@ -407,6 +414,7 @@ def forward(
     spmm_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
     gat_fn: Optional[Callable[..., jax.Array]] = None,
     halo_eval: bool = False,
+    probe: Optional[Callable[[str, jax.Array], None]] = None,
 ) -> Tuple[jax.Array, List[dict]]:
     """Run the GraphSAGE stack; returns (logits [n_dst, n_class],
     updated norm_state).
@@ -430,12 +438,21 @@ def forward(
     so layer 0 is a plain dense like in training) — with eval semantics
     everywhere else (no dropout, BN running stats). No single device
     ever materializes the full graph.
+
+    `probe(phase, array)` (optional) is the numerics tripwire hook
+    (resilience/numerics.py PHASES): called with each phase's output
+    tensor so the caller can fold cheap in-graph finiteness counts into
+    the step metrics. Phases emitted here: input / halo_concat / spmm /
+    dense / norm / logits; loss and grads are the caller's to probe.
     """
+    if probe is None:
+        probe = lambda _name, _x: None  # noqa: E731 — trivial no-op
     norm_state = norm_state if norm_state is not None else []
     new_norm_state: List[dict] = []
     use_norm = cfg.norm is not None
     cdt = cfg.compute_dtype
     h = h.astype(cdt)
+    probe("input", h)
 
     def dense(x, w, b, out_dtype):
         # params live in f32; cast to the compute dtype at use so the
@@ -476,6 +493,7 @@ def forward(
             if training or halo_eval:
                 if (i > 0 or not cfg.use_pp) and comm_update is not None:
                     h = comm_update(i, h)
+                    probe("halo_concat", h)
                 if training and cfg.dropout > 0:
                     h = _dropout(sub, h, cfg.dropout)
                 lp = params["layers"][i]
@@ -497,6 +515,7 @@ def forward(
                                            in_deg, n_dst,
                                            cfg.spmm_chunk,
                                            cfg.sorted_edges)
+                    probe("spmm", ah)
                     if is_gcn:
                         # mean * sqrt(d_i) = (Σ_j h_j/sqrt(d_j))/sqrt(d_i)
                         ah = ah.astype(jnp.float32) * d_sqrt[:, None]
@@ -536,6 +555,12 @@ def forward(
             lp = params["layers"][i]
             h = dense(h, lp["w"], lp["b"], out_dt)
 
+        # one probe per layer output: the final layer's is the logits
+        # phase, every other layer's the dense phase (aggregated over
+        # layers by the collector — provenance wants the phase, the
+        # per-layer split would only bloat the record)
+        probe("logits" if i == cfg.n_layers - 1 else "dense", h)
+
         if i < cfg.n_layers - 1:
             if use_norm:
               with jax.named_scope("norm"):
@@ -553,6 +578,7 @@ def forward(
                         h = _sync_batch_norm_eval(
                             h, np_["scale"], np_["bias"], norm_state[i]
                         )
+                probe("norm", h)
             h = jax.nn.relu(h)
 
     if training and cfg.norm == "batch":

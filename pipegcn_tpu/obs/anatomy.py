@@ -168,9 +168,12 @@ def step_anatomy(trainer) -> Dict[str, Any]:
     blocks so far; cached otherwise."""
     import jax
 
+    import jax.numpy as jnp
+
     rng = jax.random.fold_in(trainer._epoch_rng_base(), 0)
     compiled = trainer._step.lower(
-        trainer.state, trainer.data, rng).compile()
+        trainer.state, trainer.data, rng,
+        jnp.float32(trainer.loss_scaler.scale)).compile()
     rec = hlo_anatomy(compiled.as_text())
     try:
         ca = trainer.step_cost_analysis()

@@ -221,6 +221,39 @@ class MetricsLogger:
             **extra,
         })
 
+    def numerics(self, kind: str, epoch: int, **extra) -> Dict[str, Any]:
+        """A numerics-guardrail event (resilience/numerics.py): a
+        loss-scale overflow (step skipped, scale backed off), a scale
+        regrowth, or a tripwire provenance record naming the phase a
+        non-finite value was born in. Hard-flushed: a tripwire record
+        often immediately precedes a DivergenceError exit."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "numerics",
+            "kind": str(kind),
+            "epoch": int(epoch),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
+    def fallback(self, epoch: int, from_impl: str, to_impl: str,
+                 **extra) -> Dict[str, Any]:
+        """A kernel-fallback-ladder downgrade: the aggregation kernel
+        crashed at compile/first dispatch and the trainer rebuilt one
+        rung down instead of dying. Hard-flushed — the run may still be
+        about to lose the device."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "fallback",
+            "epoch": int(epoch),
+            "from_impl": str(from_impl),
+            "to_impl": str(to_impl),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 2  # v2: profile / anatomy / staleness record kinds
+SCHEMA_VERSION = 3  # v3: numerics / fallback record kinds
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -123,6 +123,33 @@ STALENESS_FIELDS: Dict[str, str] = {
     "max_rel_drift": "number",     # max over layers
 }
 
+# one record per numerics-guardrail event (resilience/numerics.py):
+#   kind "overflow"  — a loss-scale overflow epoch: the in-graph select
+#                      skipped the update; extras: scale, skipped,
+#                      new_scale (when auto mode backed off)
+#   kind "growth"    — the dynamic scale regrew after a clean streak;
+#                      extras: scale
+#   kind "tripwire"  — a sentinel trip's NaN provenance; extras: phase
+#                      (resilience/numerics.PHASES), counts (per-phase
+#                      non-finite element counts of the tripped epoch)
+NUMERICS_FIELDS: Dict[str, str] = {
+    "event": "string",             # "numerics"
+    "kind": "string",              # overflow | growth | tripwire
+    "epoch": "integer",
+}
+
+# one record per kernel-fallback-ladder downgrade (resilience/numerics
+# + Trainer._dispatch): a compile-or-dispatch crash of the aggregation
+# kernel was absorbed by rebuilding one rung down (block -> bucket ->
+# sorted-XLA) instead of killing the run. Extras: reason (the absorbed
+# error, truncated).
+FALLBACK_FIELDS: Dict[str, str] = {
+    "event": "string",             # "fallback"
+    "epoch": "integer",            # epoch the downgrade happened at
+    "from_impl": "string",         # kernel that failed
+    "to_impl": "string",           # kernel the step rebuilt on
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -133,6 +160,8 @@ _BY_EVENT = {
     "profile": PROFILE_FIELDS,
     "anatomy": ANATOMY_FIELDS,
     "staleness": STALENESS_FIELDS,
+    "numerics": NUMERICS_FIELDS,
+    "fallback": FALLBACK_FIELDS,
 }
 
 _JSON_TYPES = {

@@ -507,7 +507,11 @@ def _apply_classes(classes, compute, per_row_elems, pads, inv, out_tile,
                                  + chunks.shape[2:])[:n_w]
         outs.append(out.reshape(-1, out_tile, n_feat))
     outs.append(jnp.zeros((1, out_tile, n_feat), jnp.float32))
-    res = jnp.take(jnp.concatenate(outs, axis=0), inv, axis=0)
+    # mode='clip': indices in-bounds by construction (appended zero
+    # rows are the sentinels) — fill-mode gathers are the one path
+    # that can mint NaN from valid data (bucket_spmm rationale)
+    res = jnp.take(jnp.concatenate(outs, axis=0), inv, axis=0,
+                   mode="clip")
     return res.reshape(-1, n_feat)[:out_rows]
 
 
@@ -533,10 +537,11 @@ def _dense_apply(a_pad, groups, ginv, tiles, T, out_rows, n_feat,
     s = a_pad.shape[-1] * 8 if packed else a_pad.shape[-1]
 
     def compute(bi, ti):  # [R, K] x2 -> [R, T, F] f32
-        blks = jnp.take(a_pad, bi, axis=0)
+        blks = jnp.take(a_pad, bi, axis=0, mode="clip")
         blks = _unpack_bits(blks, s, compute_dtype) if packed \
             else blks.astype(compute_dtype)
-        tls = jnp.take(tiles, ti, axis=0)       # [R, K, S|T, F]
+        tls = jnp.take(tiles, ti, axis=0,
+                       mode="clip")           # [R, K, S|T, F]
         return jnp.einsum(spec, blks, tls,
                           preferred_element_type=jnp.float32)
 
@@ -566,10 +571,12 @@ def _dense_apply_grouped(a_pad, classes, inv, tiles, T, out_rows,
     s = a_pad.shape[-1] * 8 if packed else a_pad.shape[-1]
 
     def compute(ai, ti):  # [R, group, U] + [R, U] -> [R, group, T|S, F]
-        blks = jnp.take(a_pad, ai, axis=0)        # [R, G, U, T, S(/8)]
+        blks = jnp.take(a_pad, ai, axis=0,
+                        mode="clip")          # [R, G, U, T, S(/8)]
         blks = _unpack_bits(blks, s, compute_dtype) if packed \
             else blks.astype(compute_dtype)
-        tls = jnp.take(tiles, ti, axis=0)         # [R, U, S|T, F]
+        tls = jnp.take(tiles, ti, axis=0,
+                       mode="clip")           # [R, U, S|T, F]
         return jnp.einsum(spec, blks, tls,
                           preferred_element_type=jnp.float32)
 
@@ -592,6 +599,7 @@ def make_block_spmm_fn(
     tile: int,
     chunk_edges: Optional[int] = None,
     rem_dtype: Optional[str] = None,
+    rem_amax: bool = False,
     interpret: bool = False,
     vma: Optional[frozenset] = None,
 ):
@@ -600,13 +608,21 @@ def make_block_spmm_fn(
     sharded_block_tables for keys), already stripped to per-device blocks
     when used inside shard_map. `rem_dtype` narrows the REMAINDER's
     gather transport only (bucket_spmm.transport_dtypes) — the dense
-    MXU path keeps the activation dtype."""
-    from .bucket_spmm import transport_cast, transport_dtypes
+    MXU path keeps the activation dtype. `rem_amax` swaps the static
+    saturating fp8 cast for the amax-clamped one (the de-scale applies
+    to the remainder alone, before it joins the dense partial)."""
+    from .bucket_spmm import (amax_transport_cast, transport_cast,
+                              transport_dtypes)
 
     d = plan_arrays
     deg_col = in_deg[:, None]
     T = tile
     rem_fwd_dt, rem_bwd_dt = transport_dtypes(rem_dtype)
+
+    def _rem_cast(x, dt):
+        if rem_amax:
+            return amax_transport_cast(x, dt)
+        return transport_cast(x, dt), None
 
     def tiles_of(x, n_tiles, S):
         rpad = n_tiles * S - x.shape[0]
@@ -669,10 +685,12 @@ def make_block_spmm_fn(
                                  d["blk_fwd_ginv"], tiles, T, n_out,
                                  fbuf.shape[-1], fbuf.dtype,
                                  packed=packed)
+        rem_in, rem_inv = _rem_cast(fbuf, rem_fwd_dt)
         rem = bucket_aggregate(
-            transport_cast(fbuf, rem_fwd_dt),
-            rem_mats("blkrem_fwd_"), d["blkrem_fwd_inv"],
+            rem_in, rem_mats("blkrem_fwd_"), d["blkrem_fwd_inv"],
             chunk_edges=chunk_edges)
+        if rem_inv is not None:
+            rem = rem * rem_inv
         return (dense + rem) / deg_col
 
     def fwd(fbuf):
@@ -704,11 +722,15 @@ def make_block_spmm_fn(
         # the remainder's transport cast comes straight from the f32
         # cotangent — not through the proto.dtype rounding above
         # (matching bucket_spmm's single-rounding path)
+        if rem_bwd_dt is not None:
+            rem_in, rem_inv = _rem_cast(gd32, rem_bwd_dt)
+        else:
+            rem_in, rem_inv = gd, None
         rem = bucket_aggregate(
-            transport_cast(gd32, rem_bwd_dt)
-            if rem_bwd_dt is not None else gd,
-            rem_mats("blkrem_bwd_"), d["blkrem_bwd_inv"],
+            rem_in, rem_mats("blkrem_bwd_"), d["blkrem_bwd_inv"],
             chunk_edges=chunk_edges)
+        if rem_inv is not None:
+            rem = rem * rem_inv
         return ((dense + rem).astype(proto.dtype),)
 
     f.defvjp(fwd, bwd)
@@ -945,6 +967,7 @@ def make_device_block_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
                               n_out: int, n_src_rows: int, tile: int,
                               chunk_edges: Optional[int] = None,
                               rem_dtype: Optional[str] = None,
+                              rem_amax: bool = False,
                               interpret: bool = False,
                               axis_name: Optional[str] = None):
     """Bind per-device blocks of build_sharded_block_tables (inside
@@ -953,5 +976,5 @@ def make_device_block_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
                    if k.startswith(("blk_", "blkrem_"))}
     return make_block_spmm_fn(
         plan_arrays, in_deg, n_out, n_src_rows, tile, chunk_edges,
-        rem_dtype, interpret,
+        rem_dtype, rem_amax, interpret,
         frozenset((axis_name,)) if axis_name else None)

@@ -155,7 +155,16 @@ def bucket_aggregate(
 
     Rows wider than SLAB_BYTES are processed per feature slab (see
     SLAB_BYTES note above); `slab` overrides the element width (0
-    disables slabbing)."""
+    disables slabbing).
+
+    Every gather runs with mode='clip' (clamped, no bounds-check
+    select): the table indices are in-bounds BY CONSTRUCTION (pad
+    entries point at appended zero sentinel rows, validated host-side
+    by validate_bucket_tables), and jnp.take's default FILL_OR_DROP
+    mode is the one component of this kernel that can FABRICATE NaN
+    out of valid data — exactly the failure shape of the epoch-0
+    products-scale NaN that appeared on the experimental TPU platform
+    but never on CPU (docs/RESILIENCE.md "Numerics")."""
     f = fbuf.shape[-1]
     if slab is None:
         slab = SLAB_BYTES // fbuf.dtype.itemsize
@@ -176,7 +185,7 @@ def bucket_aggregate(
             continue
         rows_per_chunk = max(1, chunk_elems // max(1, w * f))
         if n_b <= rows_per_chunk:
-            msgs = jnp.take(fbuf_pad, mat, axis=0)
+            msgs = jnp.take(fbuf_pad, mat, axis=0, mode="clip")
             outs.append(msgs.astype(jnp.float32).sum(axis=1))
             continue
         n_chunks = -(-n_b // rows_per_chunk)
@@ -186,13 +195,13 @@ def bucket_aggregate(
         mat_c = mat_p.reshape(n_chunks, rows_per_chunk, w)
 
         def body(_, m):
-            msgs = jnp.take(fbuf_pad, m, axis=0)
+            msgs = jnp.take(fbuf_pad, m, axis=0, mode="clip")
             return None, msgs.astype(jnp.float32).sum(axis=1)
 
         _, chunks = jax.lax.scan(body, None, mat_c)
         outs.append(chunks.reshape(-1, f)[:n_b])
     res = jnp.concatenate(outs + [jnp.zeros((1, f), jnp.float32)], axis=0)
-    return jnp.take(res, inv_perm, axis=0)
+    return jnp.take(res, inv_perm, axis=0, mode="clip")
 
 
 def _slabbed_aggregate(fbuf, idx_mats, inv_perm, chunk_elems, chunk_edges,
@@ -288,6 +297,34 @@ def transport_cast(x: jax.Array, dt) -> jax.Array:
     return x.astype(dt)
 
 
+def amax_transport_cast(x: jax.Array, dt):
+    """Amax-clamped fp8 cast (resilience/numerics guardrail): scale the
+    tensor by a power of two chosen from its own amax so values land
+    mid-range in the fp8 format — instead of the static clamp
+    saturating large activations (a silent bias) or small cotangents
+    flushing to zero (a silent underflow). Returns ``(y, inv_scale)``;
+    the caller multiplies the (linear) aggregation's output by
+    ``inv_scale`` to undo it. inv_scale is None when dt is not an fp8
+    format (the plain saturating cast applies)."""
+    if dt is None:
+        return x, None
+    m = _F8_MAX.get(dt)
+    if m is None:
+        return transport_cast(x, dt), None
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf))
+    # power-of-two scale targeting half the finite max (headroom for
+    # the aggregation's intermediate values); exact to re-divide, so
+    # the de-scale introduces no extra rounding. Degenerate amax
+    # (zero / non-finite) keeps scale 1 — a NaN input must stay a NaN
+    # output for the tripwire, never become a NaN *scale*.
+    ok = jnp.isfinite(amax) & (amax > 0)
+    s = jnp.where(ok, jnp.exp2(jnp.floor(jnp.log2(
+        m / 2.0 / jnp.where(ok, amax, 1.0)))), 1.0)
+    y = jnp.clip(xf * s, -m, m).astype(dt)
+    return y, 1.0 / s
+
+
 def make_bucket_spmm_fn(
     fwd_mats: Sequence[jax.Array],
     fwd_inv: jax.Array,
@@ -298,20 +335,30 @@ def make_bucket_spmm_fn(
     chunk_elems: int = DEFAULT_CHUNK_ELEMS,
     chunk_edges: Optional[int] = None,
     rem_dtype: Optional[str] = None,
+    rem_amax: bool = False,
 ):
     """Differentiable mean-aggregation closure: f(fbuf [R, F]) ->
     f32 [n_out, F]; backward is the transpose bucket aggregation, f32
     accumulation, cotangent cast back to fbuf's dtype. `rem_dtype`
     optionally narrows the GATHER TRANSPORT (see transport_dtypes) —
-    the one cast before aggregation halves gathered rows at F=256."""
+    the one cast before aggregation halves gathered rows at F=256.
+    `rem_amax` swaps the static saturating fp8 cast for the
+    amax-clamped one (amax_transport_cast): per-tensor power-of-two
+    scaling into mid-range, inverse applied after aggregation."""
     deg_col = in_deg[:, None]
     fwd_dt, bwd_dt = transport_dtypes(rem_dtype)
 
+    def _cast(x, dt):
+        if rem_amax:
+            return amax_transport_cast(x, dt)
+        return transport_cast(x, dt), None
+
     @jax.custom_vjp
     def f(fbuf):
-        return bucket_aggregate(transport_cast(fbuf, fwd_dt), fwd_mats,
-                                fwd_inv, chunk_elems,
-                                chunk_edges) / deg_col
+        y, inv = _cast(fbuf, fwd_dt)
+        out = bucket_aggregate(y, fwd_mats, fwd_inv, chunk_elems,
+                               chunk_edges) / deg_col
+        return out * inv if inv is not None else out
 
     def fwd(fbuf):
         return f(fbuf), jnp.zeros((0,), fbuf.dtype)
@@ -324,10 +371,14 @@ def make_bucket_spmm_fn(
         # in f32. The transport cast comes straight from the f32
         # value — never through an intermediate rounding.
         gd32 = g.astype(jnp.float32) / deg_col
-        gd = transport_cast(gd32, bwd_dt) if bwd_dt is not None \
-            else gd32.astype(proto.dtype)
+        if bwd_dt is not None:
+            gd, inv = _cast(gd32, bwd_dt)
+        else:
+            gd, inv = gd32.astype(proto.dtype), None
         d_fbuf = bucket_aggregate(gd, bwd_mats, bwd_inv, chunk_elems,
                                   chunk_edges)
+        if inv is not None:
+            d_fbuf = d_fbuf * inv
         return (d_fbuf[:n_src_rows].astype(proto.dtype),)
 
     f.defvjp(fwd, bwd)
@@ -412,14 +463,53 @@ def build_sharded_bucket_tables(sg, chunk_elems: int = DEFAULT_CHUNK_ELEMS
                 [pad_to_cap(p.bwd_mats[b], bwd_caps[b], sg.n_max)
                  for p in plans]
             )
+    validate_bucket_tables(tables, sg.n_max, n_src_rows)
     return tables
+
+
+def validate_bucket_tables(tables: Dict[str, np.ndarray], n_max: int,
+                           n_src_rows: int) -> None:
+    """Host-side bounds check of sharded bucket tables ([P, ...] device
+    axis leading): every index must lie in [0, bound] where bound is
+    the consuming gather's zero-sentinel row. The device kernel gathers
+    with mode='clip' ON THE STRENGTH OF THIS CHECK — an out-of-bounds
+    index from a build bug or a rotted cache must surface HERE as a
+    named ValueError at build/load time, never as a silently-clamped
+    wrong row (or, under the previous fill-mode gathers, a NaN minted
+    mid-epoch). O(tables) numpy min/max — noise next to the O(E)
+    build."""
+    fwd_rows = sum(int(t.shape[-2]) for k, t in tables.items()
+                   if k.startswith("bkt_fwd_") and not k.endswith("inv"))
+    bwd_rows = sum(int(t.shape[-2]) for k, t in tables.items()
+                   if k.startswith("bkt_bwd_") and not k.endswith("inv"))
+    for k, t in tables.items():
+        if k == "bkt_fwd_inv":
+            hi = fwd_rows          # + the appended zero sentinel row
+        elif k == "bkt_bwd_inv":
+            hi = bwd_rows
+        elif k.startswith("bkt_fwd_"):
+            hi = n_src_rows        # fbuf_pad's zero sentinel row
+        elif k.startswith("bkt_bwd_"):
+            hi = n_max
+        else:
+            continue
+        a = np.asarray(t)
+        lo_v = int(a.min(initial=0))
+        hi_v = int(a.max(initial=0))
+        if lo_v < 0 or hi_v > hi:
+            raise ValueError(
+                f"bucket table {k!r} holds out-of-bounds indices "
+                f"[{lo_v}, {hi_v}] (valid range [0, {hi}]): corrupt "
+                f"table cache or a table-build bug — rebuild the "
+                f"partition artifact's cached tables")
 
 
 def make_device_bucket_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
                                n_src_rows: int,
                                chunk_elems: int = DEFAULT_CHUNK_ELEMS,
                                chunk_edges: Optional[int] = None,
-                               rem_dtype: Optional[str] = None):
+                               rem_dtype: Optional[str] = None,
+                               rem_amax: bool = False):
     """Bind the per-device blocks of build_sharded_bucket_tables (call
     inside shard_map, after stripping the leading device axis) into the
     differentiable closure."""
@@ -430,4 +520,5 @@ def make_device_bucket_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
     return make_bucket_spmm_fn(
         fwd_mats, d["bkt_fwd_inv"], bwd_mats, d["bkt_bwd_inv"],
         in_deg, n_src_rows, chunk_elems, chunk_edges, rem_dtype,
+        rem_amax,
     )

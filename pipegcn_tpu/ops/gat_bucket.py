@@ -211,7 +211,8 @@ def _gather_weighted(slabs, idx, w, slab, dh, acc_out):
     idx [r, D]; w [r, D, H] f32; acc_out [r, H, dh] f32 (functional:
     returns the updated value)."""
     for j in range(slabs.shape[0]):
-        msgs = jnp.take(slabs[j], idx, axis=0).astype(jnp.float32)
+        msgs = jnp.take(slabs[j], idx, axis=0,
+                        mode="clip").astype(jnp.float32)
         h0, nh, off = _slab_heads(j, slab, dh)
         if nh >= 1 and off == 0 and slab >= dh:
             m2 = msgs.reshape(*idx.shape, nh, dh)
@@ -230,7 +231,8 @@ def _gather_contract(slabs, idx, rowvec, slab, dh):
     H = rowvec.shape[1]
     c = jnp.zeros((r, D, H), jnp.float32)
     for j in range(slabs.shape[0]):
-        msgs = jnp.take(slabs[j], idx, axis=0).astype(jnp.float32)
+        msgs = jnp.take(slabs[j], idx, axis=0,
+                        mode="clip").astype(jnp.float32)
         h0, nh, off = _slab_heads(j, slab, dh)
         if nh >= 1 and off == 0 and slab >= dh:
             m2 = msgs.reshape(r, D, nh, dh)
@@ -251,7 +253,8 @@ def _gather_weighted_contract(slabs, idx, w, rowvec, slab, dh, acc_out):
     H = rowvec.shape[1]
     c = jnp.zeros((r, D, H), jnp.float32)
     for j in range(slabs.shape[0]):
-        msgs = jnp.take(slabs[j], idx, axis=0).astype(jnp.float32)
+        msgs = jnp.take(slabs[j], idx, axis=0,
+                        mode="clip").astype(jnp.float32)
         h0, nh, off = _slab_heads(j, slab, dh)
         if nh >= 1 and off == 0 and slab >= dh:
             m2 = msgs.reshape(r, D, nh, dh)
@@ -359,8 +362,10 @@ def make_device_gat_fn(
 
             def body(_, xs):
                 idx, rr = xs
-                lel = jnp.take(el_pad, idx, axis=0)        # [r, D, H]
-                l_pre = lel + jnp.take(er_pad, rr, axis=0)[:, None, :]
+                lel = jnp.take(el_pad, idx, axis=0,
+                               mode="clip")    # [r, D, H]
+                l_pre = lel + jnp.take(er_pad, rr, axis=0,
+                                       mode="clip")[:, None, :]
                 l = _leaky(l_pre, slope)
                 m = l.max(axis=1)                          # [r, H]
                 m = jnp.where(jnp.isfinite(m), m, 0.0)     # all-pad rows
@@ -380,9 +385,9 @@ def make_device_gat_fn(
                                                   jnp.float32)])
         m_c = jnp.concatenate(ms + [jnp.zeros((1, H), jnp.float32)])
         s_c = jnp.concatenate(ss + [jnp.ones((1, H), jnp.float32)])
-        out = jnp.take(out_c, fwd_inv, axis=0)[:n_dst]
-        m = jnp.take(m_c, fwd_inv, axis=0)[:n_dst]
-        s = jnp.take(s_c, fwd_inv, axis=0)[:n_dst]
+        out = jnp.take(out_c, fwd_inv, axis=0, mode="clip")[:n_dst]
+        m = jnp.take(m_c, fwd_inv, axis=0, mode="clip")[:n_dst]
+        s = jnp.take(s_c, fwd_inv, axis=0, mode="clip")[:n_dst]
         return out / s[..., None], m, s
 
     @jax.custom_vjp
@@ -421,8 +426,9 @@ def make_device_gat_fn(
 
             def body_a(_, xs):
                 idx, rr = xs
-                lel = jnp.take(el_pad, idx, axis=0)
-                err = jnp.take(er_pad, rr, axis=0)          # [r, H]
+                lel = jnp.take(el_pad, idx, axis=0, mode="clip")
+                err = jnp.take(er_pad, rr, axis=0,
+                               mode="clip")      # [r, H]
                 l_pre = lel + err[:, None, :]
                 mr = jnp.take(m, jnp.minimum(rr, n_dst - 1), axis=0)
                 sr = jnp.take(s, jnp.minimum(rr, n_dst - 1), axis=0)
@@ -439,7 +445,7 @@ def make_device_gat_fn(
             _, der_b = jax.lax.scan(body_a, None, (mat_c, rows_c))
             ders.append(der_b.reshape(-1, H)[:n_b])
         der_c = jnp.concatenate(ders + [jnp.zeros((1, H), jnp.float32)])
-        der = jnp.take(der_c, fwd_inv, axis=0)[:n_dst]
+        der = jnp.take(der_c, fwd_inv, axis=0, mode="clip")[:n_dst]
 
         # ---- pass B (src-keyed transpose): d_z, d_el ------------------
         # per-dst stats ride ONE narrow stacked gather; m sentinel +inf
@@ -471,14 +477,17 @@ def make_device_gat_fn(
 
             def body_b(_, xs):
                 idx, rr = xs
-                st = jnp.take(stats_pad, idx, axis=0)       # [r, D, 4H]
+                st = jnp.take(stats_pad, idx, axis=0,
+                              mode="clip")        # [r, D, 4H]
                 er_g, m_g, s_g, rho_g = (
                     st[..., :H], st[..., H:2 * H],
                     st[..., 2 * H:3 * H], st[..., 3 * H:])
-                el_r = jnp.take(el_pad, rr, axis=0)         # [r, H]
+                el_r = jnp.take(el_pad, rr, axis=0,
+                                mode="clip")        # [r, H]
                 l_pre = el_r[:, None, :] + er_g
                 alpha = jnp.exp(_leaky(l_pre, slope) - m_g) / s_g
-                z_r = jnp.take(z_pad3, rr, axis=0)          # [r, H, dh]
+                z_r = jnp.take(z_pad3, rr, axis=0,
+                               mode="clip")         # [r, H, dh]
                 dz_b, c = _gather_weighted_contract(
                     g_slabs, idx, alpha, z_r, slab_g, dh,
                     jnp.zeros((idx.shape[0], H, dh), jnp.float32))
@@ -491,8 +500,8 @@ def make_device_gat_fn(
             dels.append(del_b.reshape(-1, H)[:n_b])
         dz_c = jnp.concatenate(dzs + [jnp.zeros((1, H, dh), jnp.float32)])
         del_c = jnp.concatenate(dels + [jnp.zeros((1, H), jnp.float32)])
-        dz = jnp.take(dz_c, bwd_inv, axis=0)[:R].astype(z.dtype)
-        d_el = jnp.take(del_c, bwd_inv, axis=0)[:R]
+        dz = jnp.take(dz_c, bwd_inv, axis=0, mode="clip")[:R].astype(z.dtype)
+        d_el = jnp.take(del_c, bwd_inv, axis=0, mode="clip")[:R]
         return dz, d_el, der
 
     gat.defvjp(gat_fwd, gat_bwd)

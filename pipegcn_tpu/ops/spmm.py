@@ -29,7 +29,8 @@ import numpy as np
 def _segment_sum_once(fbuf, edge_src, edge_dst, n_out, sorted_edges):
     # gather in fbuf's dtype (bf16 halves the random-row HBM traffic),
     # accumulate in f32 (bf16 sums over ~500-degree rows lose ~9 bits)
-    msgs = jnp.take(fbuf, edge_src, axis=0).astype(jnp.float32)
+    msgs = jnp.take(fbuf, edge_src, axis=0,
+                    mode="clip").astype(jnp.float32)
     return jax.ops.segment_sum(
         msgs, edge_dst, num_segments=n_out + 1,
         indices_are_sorted=sorted_edges,
@@ -66,7 +67,7 @@ def spmm_sum(
     main_dst = edge_dst[: n_full * chunk].reshape(n_full, chunk)
 
     def _chunk_sum(s, d):
-        msgs = jnp.take(fbuf, s, axis=0).astype(jnp.float32)
+        msgs = jnp.take(fbuf, s, axis=0, mode="clip").astype(jnp.float32)
         return jax.ops.segment_sum(
             msgs, d, num_segments=n_out + 1,
             indices_are_sorted=sorted_edges,
@@ -82,7 +83,7 @@ def spmm_sum(
     rem = e - n_full * chunk
     if rem:
         msgs = jnp.take(
-            fbuf, edge_src[n_full * chunk :], axis=0
+            fbuf, edge_src[n_full * chunk :], axis=0, mode="clip"
         ).astype(jnp.float32)
         acc = acc + jax.ops.segment_sum(
             msgs, edge_dst[n_full * chunk :], num_segments=n_out + 1,

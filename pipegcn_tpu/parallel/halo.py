@@ -97,7 +97,7 @@ def exchange_blocks(
     with jax.named_scope("halo_exchange"):
         blocks = []
         for d in range(1, num_parts):
-            blk = jnp.take(h, send_idx[d - 1], axis=0)
+            blk = jnp.take(h, send_idx[d - 1], axis=0, mode="clip")
             blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
             blocks.append(
                 _ring_permute(blk, axis_name, _fwd_perm(num_parts, d)))

@@ -353,6 +353,7 @@ class SequentialRunner:
             already the corrected (EMA) buffers when corr is on; the
             host picks them, mirroring trainer.py:697-706."""
             from ..ops.bucket_spmm import make_device_bucket_spmm_fn
+            from ..resilience.numerics import PHASES
 
             probes = {k: jnp.zeros((H, widths[k]), cdt) for k in glayers}
             sends = {}
@@ -367,7 +368,8 @@ class SequentialRunner:
                     # this epoch's send rows [H, F], routed by the host
                     # in halo slot order (exchange_blocks's pre-permute
                     # payload, flattened)
-                    blk = jnp.take(hs, d["send_idx"], axis=0)
+                    blk = jnp.take(hs, d["send_idx"], axis=0,
+                                   mode="clip")
                     sends[k] = jnp.where(d["send_mask"][:, None], blk,
                                          0.0)
                 return fbuf
@@ -380,12 +382,22 @@ class SequentialRunner:
             def loss_fn(params, probes_arg):
                 nonlocal probes_in
                 probes_in = probes_arg
+                # numerics tripwire: same per-phase non-finite counts
+                # the mesh trainer harvests (resilience/numerics.py),
+                # summed across ranks by run_epoch
+                counts = {ph: jnp.zeros((), jnp.int32) for ph in PHASES}
+
+                def nf_probe(name, x):
+                    counts[name] = counts[name] + jnp.sum(
+                        ~jnp.isfinite(x), dtype=jnp.int32)
+
                 logits, new_norm = forward(
                     params, cfg, d["feat"], edge_dummy, edge_dummy,
                     d["in_deg"], n_max, training=True, rng=rng,
                     comm_update=comm_update, norm_state=norm,
                     psum=lambda x: x, row_mask=d["row_mask"],
                     spmm_fn=spmm_fn, gat_fn=None,
+                    probe=nf_probe,
                 )
                 if multilabel:
                     loss = bce_logits_sum(logits, d["label"],
@@ -393,20 +405,22 @@ class SequentialRunner:
                 else:
                     loss = cross_entropy_sum(logits, d["label"],
                                              d["train_mask"])
-                return loss, new_norm
+                counts["loss"] = counts["loss"] + jnp.sum(
+                    ~jnp.isfinite(loss), dtype=jnp.int32)
+                return loss, (new_norm, counts)
 
             probes_in = probes
             if keep_carry:
-                (loss, new_norm), (pgrads, probe_grads) = \
+                (loss, (new_norm, counts)), (pgrads, probe_grads) = \
                     jax.value_and_grad(loss_fn, argnums=(0, 1),
                                        has_aux=True)(params, probes)
-                return loss, pgrads, probe_grads, sends, new_norm
+                return loss, pgrads, probe_grads, sends, new_norm, counts
             # one-shot mode: no next-epoch carry, so neither the probe
             # cotangents nor the send rows are fetched (XLA drops the
             # dead halo-cotangent extraction)
-            (loss, new_norm), pgrads = jax.value_and_grad(
+            (loss, (new_norm, counts)), pgrads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, probes)
-            return loss, pgrads, {}, {}, new_norm
+            return loss, pgrads, {}, {}, new_norm, counts
 
         return rank_step
 
@@ -447,6 +461,7 @@ class SequentialRunner:
                 self._log(f"resuming epoch {epoch} at rank {start_rank}")
         sends_all, probes_all = [], []
         new_norm0 = None
+        nf_counts: Dict[str, int] = {}
         zero_stale = {k: np.zeros((H, self._widths[k]), cdt)
                       for k in self._glayers} if self.comm is None else None
         for r in range(start_rank, P):
@@ -462,9 +477,12 @@ class SequentialRunner:
                     k: (c["bavg"][k].astype(cdt) if tcfg.grad_corr
                         else c["bgrad"][k]) for k in self._glayers}
             rng_r = jax.random.fold_in(rng_e, r)
-            loss, pgrads, probe_grads, sends, new_norm = jax.device_get(
-                self._jit_rank(self.params, self.norm, rng_r, d,
-                               stale_halo, stale_bgrad))
+            loss, pgrads, probe_grads, sends, new_norm, counts = \
+                jax.device_get(
+                    self._jit_rank(self.params, self.norm, rng_r, d,
+                                   stale_halo, stale_bgrad))
+            for k, v in counts.items():
+                nf_counts[k] = nf_counts.get(k, 0) + int(v)
             loss_sum += float(loss)
             grad_sum = (pgrads if grad_sum is None
                         else tm(np.add, grad_sum, pgrads))
@@ -567,13 +585,20 @@ class SequentialRunner:
         if self._check_finite and not (np.isfinite(mean_loss)
                                        and np.isfinite(gnorm)):
             from ..resilience import DivergenceError
+            from ..resilience.numerics import first_nonfinite_phase
 
             reason = (f"non-finite loss {mean_loss!r}"
                       if not np.isfinite(mean_loss)
                       else f"non-finite grad norm {gnorm!r}")
+            # tripwire provenance: the per-rank counts name the phase
+            # where the non-finite value was born
+            phase = first_nonfinite_phase(nf_counts)
+            extra = {"phase": phase} if phase else {}
+            if phase:
+                reason += f" (first non-finite phase: {phase})"
             if self._metrics is not None:
                 self._metrics.fault(kind="divergence", epoch=epoch,
-                                    reason=reason)
+                                    reason=reason, **extra)
             raise DivergenceError(
                 f"sequential epoch {epoch}: {reason}; the caller holds "
                 f"the host-side state and decides rollback")

@@ -86,6 +86,17 @@ class TrainConfig:
     # dropout noise). A floor-shrink lever for the dropout-RNG share of
     # the non-SpMM epoch floor (scripts/epoch_anatomy.py measures it).
     rng_impl: str = "threefry"
+    # ---- numerics guardrails (resilience/numerics.py) ----
+    # in-graph non-finite tripwire: cheap per-phase isfinite counts
+    # (halo concat / spmm / dense / norm / logits / loss / grads) ride
+    # the step metrics, so a NaN's BIRTH phase is named in fault
+    # records instead of just "loss is nan"
+    numerics_tripwire: bool = True
+    # dynamic loss scaling: 'off' | 'auto' | a positive number (static
+    # scale). Non-'off' also arms in-graph overflow-skip: a non-finite
+    # reduced gradient skips that epoch's parameter update (select, no
+    # extra dispatch) and the host state machine backs the scale off.
+    loss_scale: str = "off"
     # Run the P-part SPMD program on ONE device: the identical
     # per-device step is wrapped in jax.vmap(axis_name='parts') instead
     # of shard_map — vmap implements psum/ppermute/axis_index
@@ -179,6 +190,21 @@ class Trainer:
             "norm": jax.device_put(norm, self._repl),
             "comm": jax.device_put(self._init_comm(), self._shard),
         }
+        # ---- numerics guardrails (resilience/numerics.py) ----
+        from ..resilience.numerics import LossScaleConfig, LossScaler
+
+        # host side of the dynamic loss-scale state machine; the scale
+        # is passed into every dispatch as a traced scalar (value
+        # changes never recompile)
+        self.loss_scaler = LossScaler(
+            LossScaleConfig.parse(getattr(tcfg, "loss_scale", "off")))
+        # kernel fallback ladder state: an unproven kernel's first
+        # dispatch is guarded (see _dispatch); successful dispatch
+        # proves it. Fallbacks taken accumulate here for fit()/bench
+        # to surface as contracted `fallback` records.
+        self.fallbacks: list = []
+        self._kernel_proven = False
+        self._inject_kernel_crash = False
         self._step = self._build_step()
         self._eval_cache: Dict[int, Any] = {}
         self._sharded_eval_cache: Dict[int, Any] = {}
@@ -313,10 +339,16 @@ class Trainer:
             return
 
         def use_bucket():
-            from ..ops.bucket_spmm import build_sharded_bucket_tables
+            from ..ops.bucket_spmm import (build_sharded_bucket_tables,
+                                           validate_bucket_tables)
 
             self._bucket_tables = self._cached_tables(
                 "bucket", lambda: build_sharded_bucket_tables(self.sg))
+            # the kernel's clip-mode gathers are sound only for
+            # in-bounds tables; a rotted cache must fail HERE, loudly,
+            # not clamp to wrong rows mid-epoch
+            validate_bucket_tables(self._bucket_tables, self.sg.n_max,
+                                   self.sg.n_max + self.sg.halo_size)
 
         def use_block():
             from ..ops.block_spmm import build_sharded_block_tables
@@ -626,6 +658,7 @@ class Trainer:
             return make_device_bucket_spmm_fn(
                 d, d["in_deg"], n_src_rows, chunk_edges=cfg.spmm_chunk,
                 rem_dtype=rem_dtype,
+                rem_amax=cfg.rem_amax and transport,
             )
         if "blk_a" in d or "blk_a_bits" in d:
             from ..ops.block_spmm import make_device_block_spmm_fn
@@ -633,6 +666,7 @@ class Trainer:
             return make_device_block_spmm_fn(
                 d, d["in_deg"], n_max, n_src_rows, self._block_tile,
                 chunk_edges=cfg.spmm_chunk, rem_dtype=rem_dtype,
+                rem_amax=cfg.rem_amax and transport,
                 interpret=jax.default_backend() == "cpu",
                 axis_name=PARTS_AXIS if "blk_a_bits_t" in d else None,
             )
@@ -663,6 +697,8 @@ class Trainer:
         )
 
     def _build_step(self):
+        from ..resilience.numerics import PHASES, LossScaleConfig
+
         sg, cfg, tcfg, P = self.sg, self.cfg, self.tcfg, self.P
         n_max, b_max, H = sg.n_max, sg.b_max, sg.halo_size
         n_train = float(sg.n_train_global)
@@ -672,8 +708,16 @@ class Trainer:
         momentum = tcfg.corr_momentum
         use_pallas = self._pallas_tables is not None
         pallas_interp = getattr(self, "_pallas_interpret", False)
+        # trace-time gates for the numerics guardrails: the tripwire
+        # adds a handful of isfinite reductions; loss scaling adds the
+        # scale multiply + the overflow-skip select. Both off -> the
+        # traced program is byte-identical to the pre-guardrail step
+        # (scale is a dead input).
+        tripwire = bool(getattr(tcfg, "numerics_tripwire", True))
+        ls_on = LossScaleConfig.parse(
+            getattr(tcfg, "loss_scale", "off")).enabled
 
-        def step(state, data, rng):
+        def step(state, data, rng, scale):
             # strip the leading size-1 device axis of sharded blocks
             d = {k: v[0] for k, v in data.items()}
             comm = {
@@ -709,6 +753,13 @@ class Trainer:
                         comm["bavg"][k].astype(cdt) if tcfg.grad_corr
                         else comm["bgrad"][k]
                     )
+                    if ls_on:
+                        # the carry stores UNSCALED boundary grads (the
+                        # scale can change between the epoch that ships
+                        # them and the one that consumes them); rescale
+                        # into this epoch's scaled-cotangent frame
+                        stale_bgrad = (stale_bgrad.astype(jnp.float32)
+                                       * scale).astype(cdt)
                     op = make_stale_concat(d["send_idx"], d["send_mask"], n_max)
                     fbuf = op(h, stale_halo, stale_bgrad, probes_in[k])
                     # this epoch's exchange, consumed next epoch; aux only
@@ -731,22 +782,44 @@ class Trainer:
             def loss_fn(params, probes_arg):
                 nonlocal probes_in
                 probes_in = probes_arg
+                # numerics tripwire (resilience/numerics.py): per-phase
+                # non-finite element counts, collected by the forward's
+                # probe hook and returned as aux — the provenance the
+                # sentinel's fault record names on a NaN trip. Seeded
+                # with a device-varying zero: a phase this config never
+                # probes would otherwise be an unvarying constant and
+                # the psum below would trip shard_map's VMA check.
+                vz = (d["row_mask"][0] * 0.0).astype(jnp.int32)
+                counts = {ph: vz for ph in PHASES}
+
+                def nf_probe(name, x):
+                    counts[name] = counts[name] + jnp.sum(
+                        ~jnp.isfinite(x), dtype=jnp.int32)
+
                 logits, new_norm = forward(
                     params, cfg, d["feat"], d["edge_src"], d["edge_dst"],
                     d["in_deg"], n_max, training=True, rng=rng,
                     comm_update=comm_update, norm_state=norm, psum=psum,
                     row_mask=d["row_mask"], spmm_fn=spmm_fn,
                     gat_fn=gat_fn,
+                    probe=nf_probe if tripwire else None,
                 )
                 if multilabel:
                     loss = bce_logits_sum(logits, d["label"], d["train_mask"])
                 else:
                     loss = cross_entropy_sum(logits, d["label"],
                                              d["train_mask"])
-                return loss, new_norm
+                if tripwire:
+                    counts["loss"] = counts["loss"] + jnp.sum(
+                        ~jnp.isfinite(loss), dtype=jnp.int32)
+                # loss scaling happens HERE so every cotangent of this
+                # trace (param grads AND probe/halo cotangents) carries
+                # the scale; the reduction below divides it back out
+                sc_loss = loss * scale if ls_on else loss
+                return sc_loss, (new_norm, counts, loss)
 
             probes_in = probes
-            (loss, new_norm), grads = jax.value_and_grad(
+            (_, (new_norm, nf_counts, loss)), grads = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True
             )(params, probes)
             pgrads, probe_grads = grads
@@ -756,17 +829,44 @@ class Trainer:
             with named_phase("grad_reduce"):
                 pgrads = jax.tree_util.tree_map(
                     lambda g: psum(g) / n_train, pgrads)
+                if ls_on:
+                    pgrads = jax.tree_util.tree_map(
+                        lambda g: g / scale, pgrads)
             # global l2 norm of the reduced gradient (telemetry; the
             # grads are replicated post-psum, so this is the true
             # distributed gradient's norm, not a per-device slice's)
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
                 for g in jax.tree_util.tree_leaves(pgrads)))
+            # non-finite count over the REDUCED gradient: the tripwire's
+            # 'grads' phase and (under loss scaling) the overflow flag
+            # driving the in-graph step-skip
+            if tripwire or ls_on:
+                gbad = sum(
+                    jnp.sum(~jnp.isfinite(g), dtype=jnp.int32)
+                    for g in jax.tree_util.tree_leaves(pgrads))
+            if tripwire:
+                # forward-phase counts are per-device partials; psum
+                # makes them the global counts (replicated, like the
+                # loss metric). The grads count is post-psum already.
+                nf_counts = {k: psum(v) for k, v in nf_counts.items()}
+                nf_counts["grads"] = gbad
             with named_phase("adam_update"):
                 new_params, new_opt = adam_update(
                     pgrads, opt, params, lr=tcfg.lr,
                     weight_decay=tcfg.weight_decay,
                 )
+            if ls_on:
+                # overflow-skip: a non-finite reduced gradient anywhere
+                # keeps params/opt at their previous values — the
+                # skipped step costs one epoch, not the run. The host
+                # state machine (fit() + LossScaler) sees the flag and
+                # backs the scale off.
+                ls_ok = gbad == 0
+                sel = lambda n, o: jnp.where(ls_ok, n, o)
+                new_params = jax.tree_util.tree_map(sel, new_params,
+                                                    params)
+                new_opt = jax.tree_util.tree_map(sel, new_opt, opt)
 
             new_comm = {}
             if pipeline:
@@ -780,6 +880,12 @@ class Trainer:
                     new_comm["halo"][k] = fresh_halo[k]
                     # ship this epoch's halo cotangents to their owners
                     bg = return_blocks(probe_grads[k], PARTS_AXIS, P, b_max)
+                    if ls_on:
+                        # probe cotangents carry this epoch's loss
+                        # scale; the carry stores them UNSCALED (see
+                        # comm_update's rescale on consumption)
+                        bg = (bg.astype(jnp.float32) / scale).astype(
+                            bg.dtype)
                     new_comm["bgrad"][k] = bg
                     if tcfg.feat_corr:
                         new_comm["favg"][k] = (
@@ -802,7 +908,12 @@ class Trainer:
                 "norm": new_norm,
                 "comm": new_comm,
             }
-            return new_state, {"loss": loss_out, "grad_norm": gnorm}
+            m = {"loss": loss_out, "grad_norm": gnorm}
+            if tripwire:
+                m["numerics"] = nf_counts
+            if ls_on:
+                m["overflow"] = (gbad > 0).astype(jnp.int32)
+            return new_state, m
 
         if self.emulated:
             # vmap(axis_name) in place of shard_map: identical step
@@ -812,25 +923,25 @@ class Trainer:
             # around the vmapped slice.
             tm = jax.tree_util.tree_map
 
-            def vstep(state, data, rng):
+            def vstep(state, data, rng, scale):
                 st = dict(state)
                 st["comm"] = tm(lambda v: v[None], state["comm"])
                 d1 = tm(lambda v: v[None], data)
-                ns, m = step(st, d1, rng)
+                ns, m = step(st, d1, rng, scale)
                 ns["comm"] = tm(lambda v: v[0], ns["comm"])
                 return ns, m
 
-            vm = jax.vmap(vstep, in_axes=(0, 0, None), out_axes=0,
+            vm = jax.vmap(vstep, in_axes=(0, 0, None, None), out_axes=0,
                           axis_name=PARTS_AXIS)
 
-            def emu(state, data, rng):
-                ns, m = vm(state, data, rng)
+            def emu(state, data, rng, scale):
+                ns, m = vm(state, data, rng, scale)
                 # psum'd: identical across parts
-                return ns, {k: v[0] for k, v in m.items()}
+                return ns, tm(lambda v: v[0], m)
 
-            def emu_multi(state, data, rngs):
+            def emu_multi(state, data, rngs, scale):
                 def body(st, rng):
-                    return emu(st, data, rng)
+                    return emu(st, data, rng, scale)
 
                 return jax.lax.scan(body, state, rngs)
 
@@ -862,29 +973,37 @@ class Trainer:
                         and "blk_a_bits_t" in self._block_tables
                         and jax.default_backend() == "cpu")
         check_vma = not ((use_pallas and pallas_interp) or fused_interp)
-        # both step metrics are replicated scalars (post-psum)
+        # every step metric is a replicated scalar (post-psum); the
+        # tripwire counts and overflow flag ride the same contract
         metric_spec = {"loss": PartitionSpec(), "grad_norm": PartitionSpec()}
+        if tripwire:
+            metric_spec["numerics"] = {ph: PartitionSpec()
+                                       for ph in PHASES}
+        if ls_on:
+            metric_spec["overflow"] = PartitionSpec()
         smapped = jax.shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(state_spec, data_spec, PartitionSpec()),
+            in_specs=(state_spec, data_spec, PartitionSpec(),
+                      PartitionSpec()),
             out_specs=(state_spec, metric_spec),
             check_vma=check_vma,
         )
 
-        def multi(state, data, rngs):
+        def multi(state, data, rngs, scale):
             # k epochs in one compiled program: one dispatch, and XLA can
             # schedule epoch e+1's independent work (e.g. next halo
             # exchange) behind epoch e's tail
             def body(st, rng):
-                return step(st, data, rng)
+                return step(st, data, rng, scale)
 
             return jax.lax.scan(body, state, rngs)
 
         smapped_multi = jax.shard_map(
             multi,
             mesh=self.mesh,
-            in_specs=(state_spec, data_spec, PartitionSpec()),
+            in_specs=(state_spec, data_spec, PartitionSpec(),
+                      PartitionSpec()),
             out_specs=(state_spec, metric_spec),
             check_vma=check_vma,
         )
@@ -902,9 +1021,121 @@ class Trainer:
                                   impl=self.tcfg.rng_impl)
         return jax.random.PRNGKey(self.tcfg.seed + 17)
 
+    # ---------------- kernel fallback dispatch guard -------------------
+
+    def _current_impl(self) -> str:
+        """The aggregation kernel the step is currently built on (the
+        RESOLVED impl — 'auto' never survives _setup_pallas_spmm)."""
+        if self._pallas_tables is not None:
+            return "pallas"
+        if self._block_tables is not None:
+            return "block"
+        if self._bucket_tables is not None:
+            return "bucket"
+        if self._gat_tables is not None:
+            return "gat-bucket"
+        return "xla"
+
+    def downgrade_kernel(self, to_impl: str, reason: str) -> dict:
+        """Rebuild the trainer one rung down the kernel fallback ladder
+        (resilience/numerics.fallback_ladder): swap the kernel tables on
+        device, restore the raw edge list if the new impl needs it, and
+        rebuild the jitted step. The trainer's state (params/opt/comm)
+        is untouched — the caller restores it from a host snapshot when
+        the failed dispatch may have poisoned donated buffers. Returns
+        the fallback record (also appended to self.fallbacks for fit()
+        / bench to emit as a contracted `fallback` metrics record)."""
+        frm = self._current_impl()
+        self.cfg = dataclasses.replace(self.cfg, spmm_impl=to_impl)
+        self._eval_cfg = dataclasses.replace(self._eval_cfg,
+                                             spmm_impl=to_impl)
+        self._setup_pallas_spmm()
+        keep = {k: v for k, v in self.data.items()
+                if not k.startswith(("spmm_", "bkt_", "blk_", "gat_"))}
+        tables_active = False
+        for t in (self._pallas_tables, self._bucket_tables,
+                  self._block_tables, self._gat_tables):
+            if t is not None:
+                tables_active = True
+                for k, v in t.items():
+                    keep[k] = jax.device_put(jnp.asarray(v), self._shard)
+        if not tables_active and self._edges_trimmed:
+            # the raw-edge XLA path needs the real edge list the table
+            # kernels let the trainer trim to a token shape
+            keep["edge_src"] = jax.device_put(
+                jnp.asarray(np.asarray(self.sg.edge_src,
+                                       dtype=np.int32)), self._shard)
+            keep["edge_dst"] = jax.device_put(
+                jnp.asarray(np.asarray(self.sg.edge_dst,
+                                       dtype=np.int32)), self._shard)
+        self._edges_trimmed = tables_active
+        self.data = keep
+        self._step = self._build_step()
+        self._kernel_proven = False
+        rec = {"from_impl": frm, "to_impl": self._current_impl(),
+               "epoch": int(getattr(self, "last_epoch", 0)),
+               "reason": reason, "emitted": False}
+        self.fallbacks.append(rec)
+        return rec
+
+    def _dispatch(self, run_fn):
+        """Run one step dispatch under the kernel fallback ladder: a
+        compile-or-first-dispatch failure that looks like a
+        kernel/backend error (numerics.is_kernel_error) downgrades the
+        kernel and retries the same dispatch from a host snapshot,
+        instead of killing the run (VERDICT r5: the block kernel
+        hard-crashed the TPU backend at products shape with no
+        fallback). Once a kernel has survived one dispatch it is
+        'proven' and the guard (and its snapshot copy) costs nothing.
+        Multi-process runs skip the guard: a unilateral downgrade would
+        desync the SPMD program — there the crash propagates to the
+        coordinated recovery paths instead."""
+        from ..resilience.numerics import (KernelFallbackError,
+                                           fallback_ladder,
+                                           is_kernel_error)
+
+        inject = self._inject_kernel_crash
+        armed = ((not self._kernel_proven or inject)
+                 and jax.process_count() == 1
+                 and (inject or fallback_ladder(self._current_impl())))
+        if not armed:
+            # multi-process / ladder-exhausted: the injection flag must
+            # not survive to poison an unrelated later dispatch
+            self._inject_kernel_crash = False
+            out = run_fn()
+            self._kernel_proven = True
+            return out
+        snap = self.host_state()
+        while True:
+            if self._inject_kernel_crash:
+                self._inject_kernel_crash = False
+                err: BaseException = RuntimeError(
+                    "fault-injected kernel dispatch failure "
+                    "(INTERNAL: TPU backend error)")
+            else:
+                try:
+                    out = run_fn()
+                    self._kernel_proven = True
+                    return out
+                except Exception as exc:  # noqa: BLE001 — classified below
+                    if not is_kernel_error(exc):
+                        raise
+                    err = exc
+            rungs = fallback_ladder(self._current_impl())
+            if not rungs:
+                raise KernelFallbackError(
+                    f"aggregation kernel {self._current_impl()!r} failed "
+                    f"with no fallback rung left: {err!r}") from err
+            self.downgrade_kernel(rungs[0], repr(err)[:300])
+            # the failed dispatch may have consumed the donated state
+            # buffers; re-place the pre-dispatch snapshot
+            self.restore_state(snap)
+
     def train_epoch(self, epoch: int) -> float:
         rng = jax.random.fold_in(self._epoch_rng_base(), epoch)
-        self.state, m = self._step(self.state, self.data, rng)
+        scale = jnp.float32(self.loss_scaler.scale)
+        self.state, m = self._dispatch(
+            lambda: self._step(self.state, self.data, rng, scale))
         # per-step telemetry (loss + grad norm, scalars) for fit()'s
         # metrics sink; train_epochs stores the [k]-array equivalents
         self._last_metrics = m
@@ -930,7 +1161,9 @@ class Trainer:
         rngs = jax.vmap(lambda e: jax.random.fold_in(base, e))(
             jnp.arange(start_epoch, start_epoch + k)
         )
-        self.state, ms = self._multi_step(self.state, self.data, rngs)
+        scale = jnp.float32(self.loss_scaler.scale)
+        self.state, ms = self._dispatch(
+            lambda: self._multi_step(self.state, self.data, rngs, scale))
         self._last_metrics = ms  # [k] arrays; see train_epoch
         self.last_epoch = start_epoch + k  # see train_epoch
         return np.asarray(ms["loss"])
@@ -1289,6 +1522,13 @@ class Trainer:
                 if fault_plan is not None and fault_plan.due("crash", epoch):
                     raise RuntimeError(
                         f"fault-injected crash at epoch {epoch}")
+                if fault_plan is not None and \
+                        fault_plan.due("kernel-crash", epoch):
+                    # the next dispatch raises a simulated TPU-backend
+                    # error; the _dispatch guard must absorb it via the
+                    # kernel fallback ladder (resilience/numerics.py)
+                    log_fn(f"fault-injected kernel crash at epoch {epoch}")
+                    self._inject_kernel_crash = True
                 if fault_plan is not None and fault_plan.due("hang", epoch):
                     # simulate a wedged process: heartbeats stop too, so
                     # the PEERS' watchdogs — not this rank — must act
@@ -1432,6 +1672,21 @@ class Trainer:
                         and not eval_in_stream:
                     durs.extend([dur] * chunk)
                 eval_in_stream = False
+                # ---- kernel fallbacks taken during the dispatch:
+                # surface them as contracted `fallback` records ----
+                for fb in self.fallbacks:
+                    if not fb.get("emitted"):
+                        fb["emitted"] = True
+                        log_fn(f"kernel fallback: {fb['from_impl']} -> "
+                               f"{fb['to_impl']} ({fb['reason'][:120]})")
+                        if metrics is not None:
+                            metrics.fallback(
+                                epoch=epoch, from_impl=fb["from_impl"],
+                                to_impl=fb["to_impl"],
+                                reason=fb["reason"])
+                        # the downgraded step recompiles; exclude its
+                        # first blocks from the timing stats
+                        seen_chunks.clear()
                 # grad norms ride the step output ([k] arrays for fused
                 # blocks) — harvested here for the metrics records AND
                 # the sentinel check
@@ -1451,6 +1706,36 @@ class Trainer:
                         gn = np.array(gn, np.float64)
                         gn[min(j - epoch, gn.size - 1)] = np.nan
                         log_fn(f"fault-injected nan grad norm at epoch {j}")
+                # ---- loss-scale state machine (resilience/numerics):
+                # harvested overflow flags drive backoff / skip
+                # accounting / regrowth; overflow epochs are HANDLED
+                # events the sentinel must not mistake for divergence
+                ovf = None
+                if self.loss_scaler.cfg.enabled:
+                    ovf = np.atleast_1d(np.asarray(
+                        self._last_metrics.get("overflow", 0)))
+                    if fault_plan is not None:
+                        j = fault_plan.due_in("overflow", epoch,
+                                              epoch + chunk)
+                        if j is not None:
+                            ovf = np.array(ovf)
+                            ovf[min(j - epoch, ovf.size - 1)] = 1
+                            log_fn(f"fault-injected loss-scale overflow "
+                                   f"at epoch {j}")
+                    for ev in self.loss_scaler.update(epoch, ovf):
+                        if ev["kind"] == "overflow":
+                            log_fn(
+                                f"loss-scale overflow at epoch "
+                                f"{ev['epoch']}: step skipped, scale "
+                                f"{ev['scale']:g}"
+                                + (f" -> {ev['new_scale']:g}"
+                                   if "new_scale" in ev else ""))
+                        else:
+                            log_fn(f"loss-scale regrown to "
+                                   f"{ev['scale']:g} at epoch "
+                                   f"{ev['epoch']}")
+                        if metrics is not None:
+                            metrics.numerics(**ev)
                 if metrics is not None:
                     # one record per epoch in the block; the HBM
                     # watermark is sampled once per dispatch
@@ -1494,7 +1779,41 @@ class Trainer:
                 reason = None
                 trip_extra = {}
                 if sentinel is not None:
-                    reason = sentinel.check(epoch, blk_losses, gn)
+                    chk_l, chk_g = blk_losses, gn
+                    if ovf is not None and np.any(ovf):
+                        # overflow-skipped epochs were handled by the
+                        # loss scaler; mask them out of the sentinel's
+                        # view (their non-finite grad norm is expected)
+                        from ..resilience.numerics import \
+                            sanitize_for_sentinel
+
+                        chk_l, chk_g = sanitize_for_sentinel(
+                            blk_losses, gn, ovf)
+                    if chk_l is not None:
+                        reason = sentinel.check(epoch, chk_l, chk_g)
+                if reason is not None:
+                    # ---- NaN provenance (resilience/numerics): the
+                    # step's tripwire counts name the phase where the
+                    # non-finite value was BORN ----
+                    from ..resilience.numerics import (
+                        epoch_nonfinite_counts, first_nonfinite_phase)
+
+                    nm = self._last_metrics.get("numerics") \
+                        if isinstance(self._last_metrics, dict) else None
+                    if nm is not None:
+                        phase = first_nonfinite_phase(nm)
+                        if phase is not None:
+                            bad = ~np.isfinite(np.atleast_1d(np.asarray(
+                                blk_losses, np.float64)))
+                            j = int(np.argmax(bad)) if bad.any() else 0
+                            trip_extra["phase"] = phase
+                            if metrics is not None:
+                                metrics.numerics(
+                                    kind="tripwire", epoch=epoch + j,
+                                    phase=phase,
+                                    counts=epoch_nonfinite_counts(nm, j))
+                            log_fn(f"numerics tripwire: first non-finite "
+                                   f"phase = {phase}")
                 if coord_on:
                     desync_local = False
                     if coord.desync_due(epoch + chunk):
@@ -1828,7 +2147,8 @@ class Trainer:
         and named phases — obs/profiler.py / obs/anatomy.py). Hits
         jax's compile cache when the step already ran unfused."""
         rng = jax.random.fold_in(self._epoch_rng_base(), 0)
-        return self._step.lower(self.state, self.data, rng) \
+        return self._step.lower(self.state, self.data, rng,
+                                jnp.float32(self.loss_scaler.scale)) \
             .compile().as_text()
 
     def _profile_analysis(self, profile_dir: str):
@@ -1880,7 +2200,8 @@ class Trainer:
         reporting. Compiles the single-epoch program if it isn't already
         cached; returns {} when the backend doesn't expose an analysis."""
         rng = jax.random.fold_in(self._epoch_rng_base(), 0)
-        ca = self._step.lower(self.state, self.data, rng) \
+        ca = self._step.lower(self.state, self.data, rng,
+                              jnp.float32(self.loss_scaler.scale)) \
             .compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else None

@@ -14,6 +14,10 @@ all three (docs/RESILIENCE.md):
   faults.py      deterministic fault-injection plans
                  ("nan-loss@5:r1,sigterm@8,corrupt-ckpt@10") for chaos
                  testing the recovery paths; :rN targets one rank
+  numerics.py    numerical robustness — in-graph non-finite tripwire
+                 (NaN provenance by phase), dynamic loss scaling with
+                 overflow-skip, and the kernel fallback ladder
+                 (block -> bucket -> sorted-XLA on backend crashes)
   coord.py       cross-rank coordination for jax.distributed runs —
                  fault consensus (one tiny psum per dispatch boundary
                  makes every recovery action lockstep across ranks),
@@ -39,6 +43,15 @@ from .coord import (
     digest_leaves,
 )
 from .faults import FaultPlan, corrupt_latest_checkpoint
+from .numerics import (
+    PHASES,
+    KernelFallbackError,
+    LossScaleConfig,
+    LossScaler,
+    fallback_ladder,
+    first_nonfinite_phase,
+    is_kernel_error,
+)
 from .preemption import EXIT_PREEMPTED, Preempted, PreemptionHandler
 from .sentinel import DivergenceError, DivergenceSentinel, SentinelConfig
 
@@ -46,6 +59,13 @@ __all__ = [
     "DivergenceError",
     "DivergenceSentinel",
     "SentinelConfig",
+    "PHASES",
+    "KernelFallbackError",
+    "LossScaleConfig",
+    "LossScaler",
+    "fallback_ladder",
+    "first_nonfinite_phase",
+    "is_kernel_error",
     "EXIT_PREEMPTED",
     "Preempted",
     "PreemptionHandler",

@@ -21,6 +21,15 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
   hang          the rank freezes at that epoch boundary (heartbeats
                 stop too, like a truly wedged process) — exercises the
                 PEERS' heartbeat watchdog / PeerLost path
+  overflow      that epoch's harvested loss-scale overflow flag reads 1
+                (what a saturated-activation backward reports) —
+                exercises the loss-scale backoff / step-skip accounting
+                / regrowth state machine (needs --loss-scale; inert
+                when scaling is off, like every injection host-side)
+  kernel-crash  the dispatch at the start of that epoch raises a
+                simulated TPU-backend error — exercises the kernel
+                fallback ladder (block -> bucket -> sorted-XLA) and its
+                contracted `fallback` record
 
 The optional ``:rN`` qualifier targets one rank (``jax.process_index``)
 so multi-process chaos drills can kill, desynchronize, or hang a single
@@ -50,10 +59,10 @@ import re
 from typing import List, Optional
 
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
-         "desync", "hang")
+         "desync", "hang", "overflow", "kernel-crash")
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire
-_BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang")
+_BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash")
 
 _ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::r(\d+))?$")
 

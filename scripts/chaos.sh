@@ -16,3 +16,12 @@ cd "$(dirname "$0")/.."
 timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults \
     -p no:cacheprovider "$@"
+
+# Numerics lane (docs/RESILIENCE.md "Numerics"): NaN tripwire
+# provenance, loss-scale backoff/skip/regrow, kernel fallback ladder,
+# and the products-shape NaN regression — tier-1-safe but run
+# standalone here so a numerics regression fails the chaos lane even
+# when someone trims the tier-1 selection.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m numerics \
+    -p no:cacheprovider "$@"

@@ -114,15 +114,32 @@ def main():
 
     from bench import MAX_DISPATCH_S
 
+    def check_finite(losses, e_last):
+        # abort on the FIRST non-finite intermediate loss: the
+        # products-shape NaN burned every remaining measurement block
+        # after epoch 0 went NaN (VERDICT r5) — a diverged run must
+        # stop spending TPU-window time IMMEDIATELY, loudly, red
+        bad = ~np.isfinite(np.asarray(losses, np.float64))
+        if bad.any():
+            j = int(np.argmax(bad))
+            print(f"# NON-FINITE LOSS at epoch "
+                  f"{e_last - len(losses) + 1 + j} — aborting the "
+                  f"measurement (exit 3); diagnose with the numerics "
+                  f"tripwire (docs/RESILIENCE.md 'Numerics')",
+                  file=sys.stderr)
+            sys.exit(3)
+
     t0 = time.perf_counter()
     losses = tr.train_epochs(0, 1)
     print(f"# compile+first {time.perf_counter()-t0:.0f}s "
           f"loss={float(losses[-1]):.4f}", file=sys.stderr)
+    check_finite(losses, 0)
     singles = []
     for i in (1, 2):
         t0 = time.perf_counter()
         losses = tr.train_epochs(i, 1)
         singles.append(time.perf_counter() - t0)
+        check_finite(losses, i)
     single = min(singles)
     print(f"# single epoch {single:.2f}s", file=sys.stderr)
     blk = max(1, min(args.epochs,
@@ -130,10 +147,11 @@ def main():
     e = 3
     if blk > 1:
         t0 = time.perf_counter()
-        tr.train_epochs(e, blk)
+        losses = tr.train_epochs(e, blk)
         e += blk
         print(f"# fused-{blk} warmup/compile "
               f"{time.perf_counter()-t0:.0f}s", file=sys.stderr)
+        check_finite(losses, e - 1)
 
     times = []
     for r in range(args.reps):
@@ -144,6 +162,7 @@ def main():
         times.append(dt / blk)
         print(f"# block {r}: {dt:.2f}s -> {dt/blk:.3f} s/epoch "
               f"loss={float(losses[-1]):.4f}", file=sys.stderr)
+        check_finite(losses, e - 1)
 
     final_loss = float(losses[-1])
     print(json.dumps({

@@ -156,16 +156,20 @@ def main():
         # one AOT compile serves both the HLO inspection and the timed
         # epochs (calling through t.train_epoch would compile a second
         # time via the jit cache)
-        compiled = t._step.lower(t.state, t.data, rng0).compile()
+        import jax.numpy as jnp
+
+        scale = jnp.float32(t.loss_scaler.scale)
+        compiled = t._step.lower(t.state, t.data, rng0,
+                                 scale).compile()
         hlo = compiled.as_text()
         state = t.state
-        state, _ = compiled(state, t.data, rng0)
+        state, _ = compiled(state, t.data, rng0, scale)
         jax.block_until_ready(state["params"])
         times = []
         for e in range(1, args.epochs):
             rng = jax.random.fold_in(base, e)
             t0 = time.perf_counter()
-            state, _ = compiled(state, t.data, rng)
+            state, _ = compiled(state, t.data, rng, scale)
             jax.block_until_ready(state["params"])
             times.append(time.perf_counter() - t0)
         t.state = state

@@ -28,12 +28,12 @@ from pipegcn_tpu.utils.timer import CommTimer
 
 # ---------------- schema -------------------------------------------------
 
-# FROZEN copy of the v2 contract (v1 + the fault/recovery kinds PR 2/3
-# added as extras + the profile/anatomy/staleness kinds that bumped the
-# version to 2). If any assert below fires, a field was removed or
-# retyped without bumping SCHEMA_VERSION — consumers (bench trajectory,
-# report CLI, timeline CLI, scripts) would break silently.
-_V2_FIELDS = {
+# FROZEN copy of the v3 contract (v2 + the numerics/fallback kinds the
+# numerical-robustness PR added, bumping the version to 3). If any
+# assert below fires, a field was removed or retyped without bumping
+# SCHEMA_VERSION — consumers (bench trajectory, report CLI, timeline
+# CLI, scripts) would break silently.
+_V3_FIELDS = {
     "run": {
         "event": "string", "schema_version": "integer",
         "time_unix": "number", "config": "object", "device": "object",
@@ -70,10 +70,17 @@ _V2_FIELDS = {
         "event": "string", "epoch": "integer", "layers": "object",
         "max_rel_drift": "number",
     },
+    "numerics": {
+        "event": "string", "kind": "string", "epoch": "integer",
+    },
+    "fallback": {
+        "event": "string", "epoch": "integer", "from_impl": "string",
+        "to_impl": "string",
+    },
 }
 
 
-def test_schema_v2_drift_guard():
+def test_schema_v3_drift_guard():
     current = {"run": obs_schema.RUN_FIELDS,
                "epoch": obs_schema.EPOCH_FIELDS,
                "eval": obs_schema.EVAL_FIELDS,
@@ -82,9 +89,11 @@ def test_schema_v2_drift_guard():
                "recovery": obs_schema.RECOVERY_FIELDS,
                "profile": obs_schema.PROFILE_FIELDS,
                "anatomy": obs_schema.ANATOMY_FIELDS,
-               "staleness": obs_schema.STALENESS_FIELDS}
-    if obs_schema.SCHEMA_VERSION == 2:
-        for kind, fields in _V2_FIELDS.items():
+               "staleness": obs_schema.STALENESS_FIELDS,
+               "numerics": obs_schema.NUMERICS_FIELDS,
+               "fallback": obs_schema.FALLBACK_FIELDS}
+    if obs_schema.SCHEMA_VERSION == 3:
+        for kind, fields in _V3_FIELDS.items():
             for name, tag in fields.items():
                 assert current[kind].get(name) == tag, (
                     f"schema field {kind}.{name} removed or retyped "
@@ -92,7 +101,7 @@ def test_schema_v2_drift_guard():
     else:
         # a bump legitimizes any field change; the contract is that the
         # version moved WITH the change
-        assert obs_schema.SCHEMA_VERSION > 2
+        assert obs_schema.SCHEMA_VERSION > 3
 
 
 def test_validate_record():
