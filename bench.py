@@ -185,9 +185,9 @@ def persist_last_tpu(value, vs_baseline, extras, backend,
     """Atomically record a real-TPU headline to
     results/last_tpu_bench.json so a later degraded/CPU run can still
     surface the most recent real measurement. Called both for the
-    final result AND for the best-so-far number right before the risky
-    fused-candidate compile (a worker death must not lose an in-hand
-    measurement)."""
+    final result AND for the best-so-far number right before the
+    riskier lever/sweep compiles (a worker death must not lose an
+    in-hand measurement)."""
     last_path = os.path.join(REPO, "results", "last_tpu_bench.json")
     try:
         import datetime
@@ -211,8 +211,8 @@ def persist_last_tpu(value, vs_baseline, extras, backend,
                 rec["headline_config"] = extras["headline_config"]
                 rec["block_group"] = 4
                 rec["rem_dtype"] = "float8"
-                if "fused" in extras["headline_config"]:
-                    rec["block_fused"] = True
+            if extras.get("tuning"):
+                rec["tuning"] = extras["tuning"]
             json.dump(rec, f)
         os.replace(tmp, last_path)  # atomic: a mid-write kill must
         # not destroy the previous good record
@@ -242,7 +242,7 @@ def main():
                     help="epochs per dispatch (lax.scan); per-epoch time "
                          "= block time / fused")
     ap.add_argument("--spmm-impl", default="auto",
-                    choices=["xla", "pallas", "bucket", "block", "auto"])
+                    choices=["xla", "bucket", "block", "auto"])
     ap.add_argument("--block-tile", type=int, default=256,
                     help="dense-tile edge for the block kernel")
     from pipegcn_tpu.partition.partitioner import DEFAULT_CLUSTER_SIZE
@@ -257,10 +257,20 @@ def main():
     ap.add_argument("--block-group", type=int, default=1,
                     help="union-gather group size for the block "
                          "kernel's dense path (1 = per-tile lists)")
-    ap.add_argument("--block-fused", action="store_true",
-                    help="fused unpack+matmul Pallas kernel for the "
-                         "union-gather dense path (needs --block-group "
-                         "> 1)")
+    ap.add_argument("--bucket-merge", type=int, default=0,
+                    help="merge bucket widths below 2^k into the 2^k "
+                         "bucket (0 = full ladder) — the non-SpMM-floor "
+                         "lever: fewer buckets, fewer fixed per-bucket "
+                         "dispatch overheads")
+    ap.add_argument("--tune", action="store_true", dest="tune",
+                    default=True, help=argparse.SUPPRESS)
+    ap.add_argument("--no-tune", action="store_false", dest="tune",
+                    help="with --spmm-impl auto: never run the live "
+                         "micro-bench tuner; fall back to the "
+                         "deterministic default when no persisted "
+                         "tuning table is trusted")
+    ap.add_argument("--tuner-samples", type=int, default=200_000,
+                    help="edge budget for the tuner's sampled slice")
     ap.add_argument("--rem-dtype", default="none",
                     choices=["none", "bfloat16", "float8"],
                     help="gather-transport dtype for the remainder "
@@ -294,8 +304,8 @@ def main():
     if args.stage >= 1:
         args.fused, args.blocks = 1, min(args.blocks, 3)
         args.no_compare, args.sweep_spmm = True, False
-        # the most battle-tested kernel: a crash may have been a kernel
-        # (e.g. Pallas) issue rather than the tunnel
+        # the most battle-tested kernel: a crash may have been a
+        # kernel-specific issue rather than the tunnel
         args.spmm_impl = "bucket"
     if args.stage >= 2:
         args.small = True
@@ -420,7 +430,9 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         block_tile=args.block_tile,
         block_nnz=args.block_nnz or None,
         block_group=args.block_group,
-        block_fused=args.block_fused,
+        bucket_merge=args.bucket_merge,
+        tune=args.tune,
+        tuner_samples=args.tuner_samples,
         rem_dtype=args.rem_dtype,  # 'none' normalized by ModelConfig
     )
     blk = max(1, args.fused)
@@ -556,6 +568,16 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
     except Exception as exc:  # cost analysis is best-effort diagnostics
         print(f"# cost analysis unavailable: {exc}", file=sys.stderr)
     extras["est_ici_bytes_per_epoch"] = trainer.est_ici_bytes_per_epoch()
+    if getattr(trainer, "tuning", None):
+        # the auto-tuner's decision + the full measured per-candidate
+        # micro-bench table: WHY this kernel produced the number
+        tu = trainer.tuning
+        extras["tuning"] = {
+            "winner": dict(tu["winner"]),
+            "source": tu["source"],
+            "stale_reason": tu.get("stale_reason"),
+            "costs": list(tu.get("costs", [])),
+        }
 
     # The headline number is in hand from here on: the optional extras
     # below must never discard it, so a crash there falls through to the
@@ -691,48 +713,54 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                                 cand_loss)
             del tr_c
 
-            # second candidate: the fused Pallas dense path. Its
-            # first-ever on-chip compile is the riskiest thing this
-            # process does (spilled Pallas compiles have crashed the
-            # tunnel worker) — persist the best-so-far number FIRST so
-            # even a worker death can't lose an in-hand measurement,
-            # and isolate the attempt from the sweep below.
             if backend == "tpu" and not args.small:
-                # same gates as the final persist: only a full-scale
-                # real-TPU number may take the last_tpu record
+                # persist the best-so-far number before any further
+                # risky compiles: a worker death must not lose an
+                # in-hand measurement (same gates as the final persist)
                 persist_last_tpu(
                     round(epoch_s, 4),
                     round(BASELINE_EPOCH_S / epoch_s, 3),
                     extras, backend, device_kind)
-            try:
-                t0 = time.perf_counter()
-                tr_f = Trainer(sg, dataclasses.replace(
-                    cand_cfg, block_fused=True), TrainConfig(
-                        lr=0.01, n_epochs=args.blocks * blk,
-                        enable_pipeline=headline_pipeline, seed=0,
-                        eval=False, fused_epochs=blk))
-                f_s, f_loss, _ = time_trainer(
-                    tr_f, max(3, args.blocks // 2), force_blk=used_blk)
-                print(f"# candidate block-u4-float8-fused: "
-                      f"{f_s:.4f}s/epoch "
-                      f"(total {time.perf_counter()-t0:.0f}s)",
-                      file=sys.stderr)
-                extras["candidate_fused_epoch_s"] = round(f_s, 4)
-                if f_s < epoch_s:
-                    adopt_candidate("block-u4-float8-fused", tr_f,
-                                    f_s, f_loss)
-            except Exception as exc:  # noqa: BLE001 — keep best-so-far
-                extras["fused_candidate_error"] = repr(exc)[:200]
-                print(f"# fused candidate crashed ({exc!r}); keeping "
-                      f"the best measured config", file=sys.stderr)
-            finally:
-                # the fused program must not stay HBM-resident while
-                # the sweep compiles more trainers (two full programs
-                # can OOM the chip)
+
+        # ---- non-SpMM-floor lever: bucket-width merging ---------------
+        # The bucket kernel's fixed per-epoch floor scales with the
+        # number of bucket segments it dispatches (one padded
+        # gather+reduce per width rung); --bucket-merge k truncates the
+        # width ladder below 2^k, trading padding FLOPs for fewer
+        # fixed overheads. Measure the SAME bucket program with and
+        # without merging and publish the delta — the floor attack's
+        # before/after evidence. Crash-isolated like the candidate
+        # pass: a failure here never costs the in-hand headline.
+        if (((backend == "tpu" and not args.small)
+             or args.force_candidate)
+                and not extras.get("degraded")
+                and args.bucket_merge == 0):
+            lever = {}
+            for name, merge in (("bucket", 0), ("bucket-m8", 8)):
                 try:
-                    del tr_f
-                except UnboundLocalError:
-                    pass
+                    t0 = time.perf_counter()
+                    tr_m = Trainer(sg, dataclasses.replace(
+                        cfg, spmm_impl="bucket", bucket_merge=merge,
+                        block_group=1, rem_dtype=None), TrainConfig(
+                            lr=0.01, n_epochs=args.blocks * blk,
+                            enable_pipeline=headline_pipeline, seed=0,
+                            eval=False, fused_epochs=blk))
+                    m_s, _, _ = time_trainer(
+                        tr_m, max(3, args.blocks // 2),
+                        force_blk=used_blk)
+                    lever[name] = round(m_s, 4)
+                    print(f"# floor lever {name}: {m_s:.4f}s/epoch "
+                          f"(total {time.perf_counter()-t0:.0f}s)",
+                          file=sys.stderr)
+                    del tr_m
+                except Exception as exc:  # noqa: BLE001
+                    lever[name] = None
+                    print(f"# floor lever {name} failed: {exc!r}",
+                          file=sys.stderr)
+            extras["bucket_merge_lever"] = lever
+            if lever.get("bucket") and lever.get("bucket-m8"):
+                extras["bucket_merge_delta_s"] = round(
+                    lever["bucket"] - lever["bucket-m8"], 4)
 
         # ---- optional SpMM implementation sweep -----------------------
         if args.sweep_spmm:
@@ -753,28 +781,11 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                                   rem_dtype=None)),
                 ("block-u4-f8", dict(spmm_impl="block", block_group=4,
                                      rem_dtype="float8")),
-                ("pallas", dict(spmm_impl="pallas", block_group=1,
-                                rem_dtype=None)),
+                ("bucket-m8", dict(spmm_impl="bucket", block_group=1,
+                                   bucket_merge=8, rem_dtype=None)),
             ]
             for impl, overrides in entries:
                 try:
-                    if impl == "pallas":
-                        # forcing the VMEM-resident kernel on a shard
-                        # that cannot fit compiles a heavily-spilled
-                        # program — observed to crash the tunneled TPU
-                        # worker; skip out-of-domain rather than risk
-                        # the run (inside this try so a gate failure
-                        # records None instead of discarding the
-                        # already-measured sweep entries)
-                        from pipegcn_tpu.ops.pallas_spmm import \
-                            sharded_fits
-
-                        if not sharded_fits(sg, hidden):
-                            sweep[impl] = None
-                            print("# spmm sweep: pallas skipped (shard "
-                                  "exceeds the VMEM domain)",
-                                  file=sys.stderr)
-                            continue
                     t0 = time.perf_counter()
                     tr = Trainer(sg,
                         dataclasses.replace(cfg, **overrides),

@@ -12,7 +12,7 @@ helper/feature_buffer.py).
 Layout:
     graph/      host-side graph containers + dataset loaders (numpy)
     partition/  graph partitioner + halo index pipeline (host, numpy)
-    ops/        TPU compute kernels (XLA segment-sum SpMM, Pallas kernels)
+    ops/        TPU compute kernels (XLA/bucket/block SpMM + auto-tuner)
     models/     GraphSAGE model family (pure JAX, functional params)
     parallel/   mesh, halo exchange, pipelining, gradient reduction, SyncBN
     train/      trainer, losses, metrics, evaluation

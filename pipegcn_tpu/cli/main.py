@@ -317,7 +317,9 @@ def run(args) -> dict:
         block_tile=args.block_tile,
         block_nnz=args.block_nnz or None,
         block_group=args.block_group,
-        block_fused=args.block_fused,
+        bucket_merge=args.bucket_merge,
+        tune=args.tune,
+        tuner_samples=args.tuner_samples,
         rem_dtype=args.rem_dtype,  # 'none' normalized by ModelConfig
         rem_amax=args.rem_amax,
         dtype=args.dtype,

@@ -113,13 +113,15 @@ def create_parser() -> argparse.ArgumentParser:
                         help="edge-chunk size bounding SpMM memory "
                              "(0 = unchunked)")
     parser.add_argument("--spmm-impl", "--spmm_impl",
-                        choices=["xla", "pallas", "bucket", "block", "auto"],
+                        choices=["xla", "bucket", "block", "auto"],
                         default="xla",
                         help="aggregation kernel: XLA gather+segment-sum, "
-                             "the Pallas VMEM-resident CSR kernel, the "
-                             "scatter-free degree-bucketed kernel, the "
-                             "hybrid block-dense MXU kernel, or "
-                             "auto-select by shard size")
+                             "the scatter-free degree-bucketed kernel, "
+                             "the hybrid block-dense MXU kernel, or "
+                             "auto — resolved from the artifact's "
+                             "measured tuning table / a live "
+                             "micro-bench (ops/tuner.py), never from "
+                             "shape thresholds")
     parser.add_argument("--n-heads", "--n_heads", type=int, default=4,
                         help="attention heads for --model gat")
     parser.add_argument("--block-tile", "--block_tile", type=int,
@@ -136,11 +138,28 @@ def create_parser() -> argparse.ArgumentParser:
                              "dst tiles share one gathered source-tile "
                              "union in the block kernel's dense path "
                              "(1 = per-tile block lists)")
-    parser.add_argument("--block-fused", "--block_fused",
-                        action="store_true",
-                        help="fused unpack+matmul Pallas kernel for the "
-                             "union-gather dense path (needs "
-                             "--block-group > 1; experimental)")
+    parser.add_argument("--bucket-merge", "--bucket_merge", type=int,
+                        default=0,
+                        help="merge bucket-ladder rungs below this width "
+                             "into one bucket (fewer kernel launches / "
+                             "transients per epoch at bounded padding "
+                             "cost; 0 = full ladder). Tuner-signature "
+                             "relevant: changing it re-tunes")
+    parser.add_argument("--tune", action="store_true", dest="tune",
+                        default=True,
+                        help="allow a live tuner micro-bench when "
+                             "--spmm-impl auto finds no trusted "
+                             "tuning.json in the partition artifact "
+                             "(default on; single-process runs only)")
+    parser.add_argument("--no-tune", action="store_false", dest="tune",
+                        help="never micro-bench at trainer setup: a "
+                             "cache miss falls back to the "
+                             "deterministic default kernel with a loud "
+                             "record")
+    parser.add_argument("--tuner-samples", "--tuner_samples", type=int,
+                        default=200_000,
+                        help="edge budget of the tuner's sampled "
+                             "degree-distribution slice")
     parser.add_argument("--rem-dtype", "--rem_dtype",
                         choices=["none", "bfloat16", "float8"],
                         default="none",

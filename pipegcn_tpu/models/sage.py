@@ -63,8 +63,9 @@ class ModelConfig:
     train_size: int = 0            # global n_train (SyncBN divisor, loss)
     spmm_chunk: Optional[int] = None
     sorted_edges: bool = False     # edge_dst ascending (CSR order)
-    # 'xla' | 'pallas' | 'bucket' | 'block' | 'auto' — must stay in sync
-    # with cli/parser.py --spmm-impl and Trainer._setup_pallas_spmm
+    # 'xla' | 'bucket' | 'block' | 'auto' — must stay in sync with
+    # cli/parser.py --spmm-impl and Trainer._setup_spmm; 'auto'
+    # resolves from the measured tuning table (ops/tuner.py)
     spmm_impl: str = "xla"
     block_tile: int = 256          # dense-tile edge for spmm_impl='block'
     # minimum edges for a (dst, src) tile to go dense; None = the
@@ -75,12 +76,18 @@ class ModelConfig:
     # (block_spmm._group_union; measured F-tile dedupe headroom in
     # docs/PERF_NOTES.md). 1 = per-tile K-class layout
     block_group: int = 1
-    # fused unpack+matmul Pallas kernel for the union-gather dense path
-    # (ops/fused_block.py): keeps the gathered A blocks and F-tile
-    # unions in VMEM instead of XLA's two HBM transients. Requires the
-    # grouped layout (block_group > 1). Experimental until a chip
-    # measurement lands (docs/PERF_NOTES.md)
-    block_fused: bool = False
+    # bucket-merge lever (ops/bucket_spmm._bucket_widths min_width):
+    # buckets narrower than this merge into the first surviving ladder
+    # rung, trading bounded padding for fewer per-bucket gather
+    # launches/transients. 0 = full ladder.
+    bucket_merge: int = 0
+    # spmm_impl='auto' resolution (ops/tuner.py): True lets a cache
+    # miss run the live micro-benchmark campaign; False restricts auto
+    # to a persisted tuning table (falling back to the deterministic
+    # default kernel when none exists — never a live measurement)
+    tune: bool = True
+    # edge budget of the tuner's sampled degree-distribution slice
+    tuner_samples: int = 200_000
     # gather-transport dtype for the bucket kernel / block remainder /
     # GAT attention kernel's wide value+cotangent gathers
     # (bucket_spmm.transport_dtypes): None = activation dtype;
@@ -111,10 +118,9 @@ class ModelConfig:
             raise ValueError(
                 f"unknown rem_dtype: {self.rem_dtype!r} "
                 "(none | bfloat16 | float8)")
-        if self.block_fused and self.block_group <= 1:
+        if self.bucket_merge < 0:
             raise ValueError(
-                "block_fused needs the union-gather layout "
-                "(block_group > 1)")
+                f"bucket_merge must be >= 0, got {self.bucket_merge}")
         if self.model in ("gcn", "gat") and self.use_pp:
             # the pp precompute caches SAGE's mean-neighbor concat;
             # gcn/gat first layers aggregate like every other layer
@@ -125,7 +131,7 @@ class ModelConfig:
                                  f"{self.n_heads}")
             if self.spmm_impl not in ("xla", "auto", "bucket"):
                 # per-edge attention weights need the attention-bucket
-                # kernel (ops/gat_bucket.py); the pallas/block tables
+                # kernel (ops/gat_bucket.py); the block tables
                 # are unweighted and cannot express them
                 raise ValueError(
                     f"spmm_impl={self.spmm_impl!r} does not apply to "
@@ -505,7 +511,7 @@ def forward(
                                    i == cfg.n_layers - 1, out_dt,
                                    chunk=cfg.spmm_chunk, gat_fn=gat_fn)
                 else:
-                    # spmm_fn (e.g. the Pallas VMEM-resident kernel)
+                    # spmm_fn (the bucket/block table kernels)
                     # returns the mean directly when injected
                     with jax.named_scope("spmm"):
                         if spmm_fn is not None:

@@ -254,6 +254,22 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def tuning(self, winner: Dict[str, Any], source: str,
+               costs, **extra) -> Dict[str, Any]:
+        """The SpMM auto-tuner's dispatch decision (ops/tuner.py +
+        Trainer._resolve_auto): the winning kernel config, where the
+        decision came from (artifact | live | default), and the full
+        measured per-candidate cost table — the record that says WHY
+        this kernel dispatches."""
+        extra.setdefault("time_unix", time.time())
+        return self.write({
+            "event": "tuning",
+            "winner": dict(winner),
+            "source": str(source),
+            "costs": list(costs),
+            **extra,
+        })
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 3  # v3: numerics / fallback record kinds
+SCHEMA_VERSION = 4  # v4: tuning record kind (SpMM auto-tuner decision)
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -150,6 +150,24 @@ FALLBACK_FIELDS: Dict[str, str] = {
     "to_impl": "string",           # kernel the step rebuilt on
 }
 
+# one record per run with spmm_impl='auto' (ops/tuner.py +
+# Trainer._resolve_auto): WHY this kernel dispatches. winner carries
+# {name, impl, rem_dtype, rem_amax, block_group}; costs is the full
+# measured per-candidate micro-bench table (empty for the
+# no-measurement default); source says where the decision came from:
+#   "artifact" — trusted persisted tuning.json in the partition artifact
+#   "live"     — micro-bench ran at trainer setup (cache miss); extras
+#                carry stale_reason (why the persisted table, if any,
+#                was rejected — the LOUD part of the stale-table path)
+#   "default"  — no table and no live tune allowed (multi-process or
+#                --no-tune): the tuner's fixed deterministic default
+TUNING_FIELDS: Dict[str, str] = {
+    "event": "string",             # "tuning"
+    "winner": "object",            # the dispatched kernel config
+    "source": "string",            # artifact | live | default
+    "costs": "array",              # measured per-candidate cost table
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -162,6 +180,7 @@ _BY_EVENT = {
     "staleness": STALENESS_FIELDS,
     "numerics": NUMERICS_FIELDS,
     "fallback": FALLBACK_FIELDS,
+    "tuning": TUNING_FIELDS,
 }
 
 _JSON_TYPES = {

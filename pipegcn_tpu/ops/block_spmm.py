@@ -600,8 +600,6 @@ def make_block_spmm_fn(
     chunk_edges: Optional[int] = None,
     rem_dtype: Optional[str] = None,
     rem_amax: bool = False,
-    interpret: bool = False,
-    vma: Optional[frozenset] = None,
 ):
     """Differentiable hybrid mean-aggregation closure f(fbuf [R, F]) ->
     f32 [n_out, F]. `plan_arrays` holds the BlockPlan tensors (see
@@ -647,9 +645,6 @@ def make_block_spmm_fn(
 
     grouped = "blk_fwdu_inv" in d
     packed = "blk_a_bits" in d
-    # the sublane-repacked table's presence IS the fused toggle — the
-    # trainer only derives it when cfg.block_fused is set
-    fused = grouped and packed and "blk_a_bits_t" in d
 
     def a_padded():
         # append the zero block IN the stored dtype (bit-packed uint8 /
@@ -659,23 +654,11 @@ def make_block_spmm_fn(
         return jnp.concatenate(
             [a, jnp.zeros((1,) + a.shape[1:], a.dtype)], axis=0)
 
-    def a_t_padded():
-        a = d["blk_a_bits_t"]
-        return jnp.concatenate(
-            [a, jnp.zeros((1,) + a.shape[1:], a.dtype)], axis=0)
-
     @jax.custom_vjp
     def f(fbuf):
         n_s_tiles = -(-n_src_rows // T)
         tiles = tiles_of(fbuf, n_s_tiles, T)
-        if fused:
-            from .fused_block import fused_dense_apply_grouped
-
-            dense = fused_dense_apply_grouped(
-                a_t_padded(), union_classes("fwd"), d["blk_fwdu_inv"],
-                tiles, T, n_out, fbuf.shape[-1], interpret=interpret,
-                vma=vma)
-        elif grouped:
+        if grouped:
             dense = _dense_apply_grouped(
                 a_padded(), union_classes("fwd"), d["blk_fwdu_inv"],
                 tiles, T, n_out, fbuf.shape[-1], fbuf.dtype,
@@ -702,14 +685,7 @@ def make_block_spmm_fn(
         # transpose dense: per source tile, sum A^T @ g_tile
         n_d_tiles = -(-n_out // T)
         g_tiles = tiles_of(gd, n_d_tiles, T)
-        if fused:
-            from .fused_block import fused_dense_apply_grouped
-
-            dense = fused_dense_apply_grouped(
-                a_t_padded(), union_classes("bwd"), d["blk_bwdu_inv"],
-                g_tiles, T, n_src_rows, g.shape[-1], transpose=True,
-                interpret=interpret, vma=vma)
-        elif grouped:
+        if grouped:
             dense = _dense_apply_grouped(
                 a_padded(), union_classes("bwd"), d["blk_bwdu_inv"],
                 g_tiles, T, n_src_rows, g.shape[-1], gd.dtype,
@@ -967,14 +943,11 @@ def make_device_block_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,
                               n_out: int, n_src_rows: int, tile: int,
                               chunk_edges: Optional[int] = None,
                               rem_dtype: Optional[str] = None,
-                              rem_amax: bool = False,
-                              interpret: bool = False,
-                              axis_name: Optional[str] = None):
+                              rem_amax: bool = False):
     """Bind per-device blocks of build_sharded_block_tables (inside
     shard_map, leading device axis stripped)."""
     plan_arrays = {k: v for k, v in d.items()
                    if k.startswith(("blk_", "blkrem_"))}
     return make_block_spmm_fn(
         plan_arrays, in_deg, n_out, n_src_rows, tile, chunk_edges,
-        rem_dtype, rem_amax, interpret,
-        frozenset((axis_name,)) if axis_name else None)
+        rem_dtype, rem_amax)

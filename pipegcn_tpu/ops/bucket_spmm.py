@@ -2,7 +2,7 @@
 
 A second TPU-native replacement for DGL's SpMM kernel (reference
 module/layer.py:47-49), built for the regime where the per-device shard
-does NOT fit VMEM (where ops/pallas_spmm.py applies). XLA lowers
+does NOT fit VMEM. XLA lowers
 `segment_sum` to scatter-add, which serializes badly on TPU; this
 formulation removes every scatter from both the forward AND the backward:
 
@@ -23,7 +23,7 @@ the same scatter-free kernel in the other direction, accumulating in f32.
 Padding overhead is bounded by 1.5x (the _ladder_rungs width steps)
 and is ~1.2x on real degree distributions. All shapes are static; per-device tables
 are padded to shared maxima so one traced program serves every device in
-shard_map (same approach as ops/pallas_spmm.build_sharded_tables).
+shard_map.
 """
 
 from __future__ import annotations
@@ -62,10 +62,18 @@ def _ladder_rungs():
         w = max(w + 1, (w * 3) // 2)
 
 
-def _bucket_widths(max_deg: int) -> List[int]:
-    """Ladder rungs up to (and including) the first >= max_deg."""
+def _bucket_widths(max_deg: int, min_width: int = 0) -> List[int]:
+    """Ladder rungs up to (and including) the first >= max_deg.
+
+    `min_width` truncates the ladder from BELOW: rungs narrower than it
+    are dropped, merging every low-degree row into the first surviving
+    rung (the bucket-merge launch/transient lever — fewer per-bucket
+    gather launches and concat operands at a padding cost bounded by
+    min_width per merged row). 0 keeps the full ladder."""
     widths = []
     for w in _ladder_rungs():
+        if w < min_width:
+            continue
         widths.append(w)
         if w >= max_deg:
             return widths
@@ -385,11 +393,16 @@ def make_bucket_spmm_fn(
     return f
 
 
-def build_sharded_bucket_tables(sg, chunk_elems: int = DEFAULT_CHUNK_ELEMS
+def build_sharded_bucket_tables(sg, chunk_elems: int = DEFAULT_CHUNK_ELEMS,
+                                min_width: int = 0
                                 ) -> Dict[str, np.ndarray]:
     """Stacked per-device tables for shard_map (leading device axis),
     padded to shared bucket widths and per-bucket row caps so the traced
     program is identical on every device.
+
+    `min_width` merges every bucket narrower than it into the first
+    surviving ladder rung (see _bucket_widths) — the bucket-merge
+    launch-overhead lever, surfaced as --bucket-merge.
 
     Returns {'bkt_fwd_<b>': [P, cap_b, w_b], 'bkt_fwd_inv': [P, n_max],
              'bkt_bwd_<b>': ..., 'bkt_bwd_inv': [P, R]}.
@@ -406,8 +419,8 @@ def build_sharded_bucket_tables(sg, chunk_elems: int = DEFAULT_CHUNK_ELEMS
             do = np.bincount(sg.edge_src[r][real], minlength=n_src_rows)
             max_in = max(max_in, int(di.max(initial=1)))
             max_out = max(max_out, int(do.max(initial=1)))
-    fw = _bucket_widths(max_in)
-    bw = _bucket_widths(max_out)
+    fw = _bucket_widths(max_in, min_width)
+    bw = _bucket_widths(max_out, min_width)
 
     plans = [
         BucketPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max, n_src_rows,

@@ -13,7 +13,8 @@ Conventions (produced by partition.halo.ShardedGraph):
   - `edge_src` indexes into `fbuf` rows (inner nodes then halo slots);
     padded entries point at row 0 (harmless: their dst is the sentinel).
 
-A Pallas CSR-blocked kernel can be swapped in behind the same signature.
+Table-driven kernels (ops/bucket_spmm.py, ops/block_spmm.py) swap in
+behind the same signature via the trainer's spmm_fn closure.
 """
 
 from __future__ import annotations

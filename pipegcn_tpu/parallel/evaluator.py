@@ -141,23 +141,11 @@ class ShardedEvaluator:
         norm_spec = jax.tree_util.tree_map(
             lambda _: repl, trainer.state["norm"])
         data_spec = jax.tree_util.tree_map(lambda _: spec, self._dev_data)
-        # pallas interpret mode (CPU testing) hits an internal VMA
-        # mismatch in jax's HLO interpreter; relax the check ONLY when
-        # this evaluator's own trace contains the pallas kernel (its
-        # tables are in the data) — a foreign-graph eval under a pallas
-        # trainer runs bucket tables and keeps the check
-        check_vma = not (use_tables and (
-            ("spmm_esrc" in self._dev_data
-             and getattr(trainer, "_pallas_interpret", False))
-            # fused block kernel (interpret mode): same VMA mismatch
-            or ("blk_a_bits_t" in self._dev_data
-                and jax.default_backend() == "cpu")))
         self._run = jax.jit(jax.shard_map(
             eval_fn,
             mesh=trainer.mesh,
             in_specs=(params_spec, norm_spec, data_spec, spec),
             out_specs=repl,
-            check_vma=check_vma,
         ))
 
     # ------------------------------------------------------------------

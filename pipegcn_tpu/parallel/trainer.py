@@ -56,12 +56,10 @@ from .halo import (
 )
 from .mesh import PARTS_AXIS, make_mesh
 
-# 'auto' SpMM selection at non-VMEM scale (see _setup_pallas_spmm):
-# the hybrid block kernel only beats bucket when the shard is big
-# enough for dispatch overheads to amortize AND the layout puts a
-# meaningful fraction of edges into MXU-worthy dense tiles
-_AUTO_BLOCK_MIN_EDGES = 1_000_000     # avg edges per device
-_AUTO_BLOCK_MIN_COVERAGE = 0.3        # estimate_block_coverage
+# 'auto' SpMM selection is table-driven (see _setup_spmm and
+# ops/tuner.py): the kernel is resolved from a persisted measured cost
+# table (tuning.json in the partition artifact) or a live micro-bench
+# campaign — there are no hand-coded shape thresholds.
 
 
 @dataclasses.dataclass
@@ -137,21 +135,18 @@ class Trainer:
             self._shard = NamedSharding(self.mesh, PartitionSpec(PARTS_AXIS))
             self._repl = NamedSharding(self.mesh, PartitionSpec())
 
-        self._setup_pallas_spmm()
+        self._setup_spmm()
         # with kernel tables active, the step (and the sharded
         # evaluator) aggregate through them and the raw edge list is
         # only needed for the one-shot pp precompute — at Reddit scale
         # the two int32 edge arrays are ~0.9 GB of HBM that would
         # otherwise sit resident for nothing (forward()'s edge args are
         # untraced when spmm_fn is set, so a token shape suffices)
-        self._edges_trimmed = (self._pallas_tables is not None
-                               or self._bucket_tables is not None
+        self._edges_trimmed = (self._bucket_tables is not None
                                or self._block_tables is not None
                                or self._gat_tables is not None)
         # bucket/block tables can also serve the pp precompute, so the
-        # raw edges never reach the device at all; the pallas kernel's
-        # VMEM gate covered the layer widths only, so a pallas trainer
-        # still uploads edges for the (wider) raw-feature precompute
+        # raw edges never reach the device at all
         pp_via_tables = (self._bucket_tables is not None
                          or self._block_tables is not None)
         need_edges = (not self._edges_trimmed) or \
@@ -221,7 +216,7 @@ class Trainer:
 
         self._eval_run = _eval_run
 
-    # ---------------- pallas spmm selection ---------------------------
+    # ---------------- spmm kernel selection ---------------------------
 
     # bump when any kernel-table layout changes: stale caches must miss
     _TABLES_FORMAT = 5  # v5: x1.5-step bucket/K ladders (pad <= 1.5x)
@@ -288,26 +283,23 @@ class Trainer:
                         pass
         return tables
 
-    def _setup_pallas_spmm(self) -> None:
-        """Resolve cfg.spmm_impl: 'pallas' forces the VMEM-resident CSR
-        kernel (ops/pallas_spmm.py), 'bucket' the scatter-free
-        degree-bucketed aggregation (ops/bucket_spmm.py), 'block' the
-        hybrid dense-tile MXU kernel (ops/block_spmm.py). 'auto' picks
-        pallas when the shard fits the VMEM budget; otherwise block when
-        the shard is large AND its layout concentrates enough edges into
-        dense tiles (estimate_block_coverage), else bucket — the
-        v5e-measured ranking at each regime (docs/PERF_NOTES.md). 'xla'
-        (default) keeps gather+segment-sum."""
-        from ..ops.pallas_spmm import build_sharded_tables, sharded_applicable
-
+    def _setup_spmm(self) -> None:
+        """Resolve cfg.spmm_impl: 'bucket' builds the scatter-free
+        degree-bucketed aggregation tables (ops/bucket_spmm.py), 'block'
+        the hybrid dense-tile MXU kernel's (ops/block_spmm.py), 'xla'
+        (default) keeps gather+segment-sum over the raw edge list.
+        'auto' resolves from MEASURED cost (_resolve_auto): the
+        partition artifact's persisted tuning.json when present and
+        trusted, else a live micro-bench campaign (ops/tuner.py) — then
+        lands on one of the three concrete impls above. No hand-coded
+        shape thresholds exist on this path."""
         impl = self.cfg.spmm_impl
-        self._pallas_tables = None
-        self._pallas_max_e = 0
         self._bucket_tables = None
         self._block_tables = None
         self._block_tile = 0
         self._gat_tables = None
-        if impl not in ("xla", "pallas", "auto", "bucket", "block"):
+        self.tuning = None
+        if impl not in ("xla", "auto", "bucket", "block"):
             raise ValueError(f"unknown spmm_impl: {impl}")
         if self.cfg.model == "gat":
             # per-edge attention weights run through the attention-bucket
@@ -335,132 +327,146 @@ class Trainer:
                         "is accuracy-validated (results/"
                         "staleness_parity_gat.md)")
             return
-        if impl == "xla":
-            return
-
-        def use_bucket():
-            from ..ops.bucket_spmm import (build_sharded_bucket_tables,
-                                           validate_bucket_tables)
-
-            self._bucket_tables = self._cached_tables(
-                "bucket", lambda: build_sharded_bucket_tables(self.sg))
-            # the kernel's clip-mode gathers are sound only for
-            # in-bounds tables; a rotted cache must fail HERE, loudly,
-            # not clamp to wrong rows mid-epoch
-            validate_bucket_tables(self._bucket_tables, self.sg.n_max,
-                                   self.sg.n_max + self.sg.halo_size)
-
-        def use_block():
-            from ..ops.block_spmm import build_sharded_block_tables
-
-            w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
-            tile = self.cfg.block_tile
-            nnz = self.cfg.block_nnz
-            grp = self.cfg.block_group
-            key = (f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else "")
-                   + (f"_u{grp}" if grp > 1 else ""))
-            self._block_tables = self._cached_tables(
-                key,
-                lambda: build_sharded_block_tables(
-                    self.sg, tile=tile, n_feat_hint=w_hint,
-                    nnz_threshold=nnz, group=grp)[0])
-            self._block_tile = tile
-            if self.cfg.block_fused:
-                # the fused Pallas path contracts sublane-packed A
-                # (ops/fused_block.py layout contract); derive + cache
-                # the repack next to the base tables
-                if "blk_a_bits" not in self._block_tables:
-                    raise ValueError(
-                        "block_fused needs bit-packed A blocks (edge "
-                        "multiplicity > 1 stores A unpacked)")
-                from ..ops.fused_block import repack_bits_sublane
-
-                self._block_tables = dict(self._block_tables)
-                self._block_tables["blk_a_bits_t"] = self._cached_tables(
-                    key + "_fused",
-                    lambda: {"blk_a_bits_t": np.stack([
-                        repack_bits_sublane(b)
-                        for b in self._block_tables["blk_a_bits"]])},
-                )["blk_a_bits_t"]
-
-        def use_large():
-            # non-VMEM shards: the hybrid block-dense kernel wins when
-            # the layout concentrates enough edges into MXU-worthy
-            # tiles (measured on v5e at Reddit scale — see
-            # docs/PERF_NOTES.md); otherwise the dense blocks would be
-            # too few to matter and the scatter-free bucket kernel's
-            # slabbed gathers are the best remaining formulation
-            from ..ops.block_spmm import estimate_block_coverage
-
-            w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
-            if (float(np.mean(self.sg.edge_count)) >= _AUTO_BLOCK_MIN_EDGES
-                    and estimate_block_coverage(
-                        self.sg, self.cfg.block_tile, w_hint,
-                        nnz_threshold=self.cfg.block_nnz)
-                    >= _AUTO_BLOCK_MIN_COVERAGE):
-                use_block()
-            else:
-                use_bucket()
-
+        if impl == "auto":
+            impl = self._resolve_auto()
         if impl == "bucket":
-            use_bucket()
-            return
-        if impl == "block":
-            use_block()
-            return
+            self._use_bucket()
+        elif impl == "block":
+            self._use_block()
 
-        # the Pallas CSR kernel's grid cannot carry the emulate_parts
-        # vmap batch axis (the TPU lowering rejects the batched block
-        # shapes — observed on-chip, round 4); emulated runs use the
-        # XLA-composed paths
-        if self.emulated:
-            if impl == "pallas":
-                raise ValueError(
-                    "spmm_impl='pallas' does not support emulate_parts "
-                    "(vmap-batched Pallas grid); use 'auto', 'block' or "
-                    "'bucket'")
-            if impl == "auto":
-                use_large()
-                return
+    def _use_bucket(self) -> None:
+        from ..ops.bucket_spmm import (build_sharded_bucket_tables,
+                                       validate_bucket_tables)
 
-        # cheap VMEM gate first (needs only shapes) — skip the O(E) table
-        # build when 'auto' will reject the shard anyway
-        n_src_rows = self.sg.n_max + self.sg.halo_size
-        widths = [
-            self._layer_width(i)
-            for i in range(1 if self.cfg.use_pp else 0,
-                           self.cfg.n_graph_layers)
-        ]
-        w_max = max(widths, default=1)
-        if impl == "auto" and not sharded_applicable(n_src_rows, w_max, 0):
-            use_large()
-            return
-        tables, max_e, n_src_rows = build_sharded_tables(self.sg)
-        fits = sharded_applicable(n_src_rows, w_max, max_e)
-        if impl == "auto" and not fits:
-            use_large()
-            return
-        if impl == "pallas" and not fits:
-            import warnings
+        merge = int(getattr(self.cfg, "bucket_merge", 0))
+        kind = "bucket" + (f"_m{merge}" if merge else "")
+        self._bucket_tables = self._cached_tables(
+            kind, lambda: build_sharded_bucket_tables(
+                self.sg, min_width=merge))
+        # the kernel's clip-mode gathers are sound only for
+        # in-bounds tables; a rotted cache must fail HERE, loudly,
+        # not clamp to wrong rows mid-epoch
+        validate_bucket_tables(self._bucket_tables, self.sg.n_max,
+                               self.sg.n_max + self.sg.halo_size)
 
-            warnings.warn(
-                "spmm_impl='pallas' forced but the shard exceeds the VMEM "
-                "budget; expect compile failure or spills"
-            )
-        self._pallas_tables = tables
-        self._pallas_max_e = max_e
-        # interpret mode off TPU so tests exercise the same kernel
-        self._pallas_interpret = jax.default_backend() == "cpu"
+    def _use_block(self) -> None:
+        from ..ops.block_spmm import build_sharded_block_tables
+
+        w_hint = max(self.cfg.layer_sizes[:self.cfg.n_graph_layers])
+        tile = self.cfg.block_tile
+        nnz = self.cfg.block_nnz
+        grp = self.cfg.block_group
+        key = (f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else "")
+               + (f"_u{grp}" if grp > 1 else ""))
+        self._block_tables = self._cached_tables(
+            key,
+            lambda: build_sharded_block_tables(
+                self.sg, tile=tile, n_feat_hint=w_hint,
+                nnz_threshold=nnz, group=grp)[0])
+        self._block_tile = tile
+
+    def _resolve_auto(self) -> str:
+        """Pick the concrete kernel for spmm_impl='auto' from measured
+        cost, never from shape heuristics. Trust order: (1) the
+        artifact's persisted tuning.json when tuner format, source edge
+        checksum AND config signature all match; (2) a live micro-bench
+        campaign (ops/tuner.py) on single-process runs with cfg.tune,
+        persisted back into a disk-backed artifact; (3) the tuner's
+        fixed deterministic default, with a loud warning — multi-process
+        runs never live-tune (per-rank timing noise would argmin
+        different kernels and desync the SPMD program). The decision
+        (winner + measured cost table + source) lands in self.tuning
+        for fit()/bench to emit as a contracted `tuning` record."""
+        import warnings
+
+        from ..ops import tuner
+
+        cfg = self.cfg
+        width = max(cfg.layer_sizes[:cfg.n_graph_layers])
+        sig = tuner.signature_for(
+            width=width, block_tile=cfg.block_tile,
+            bucket_merge=getattr(cfg, "bucket_merge", 0),
+            chunk_edges=cfg.spmm_chunk)
+        cd = getattr(self.sg, "cache_dir", None)
+        rec, reason = None, "no artifact directory (in-memory graph)"
+        if cd:
+            rec, reason = tuner.load_tuning(
+                cd,
+                expect_checksum=getattr(self.sg,
+                                        "source_edge_checksum", -1),
+                signature=sig)
+        source = "artifact"
+        if rec is None:
+            can_tune = (bool(getattr(cfg, "tune", True))
+                        and jax.process_count() == 1)
+            if can_tune:
+                source = "live"
+                rec = tuner.tune(
+                    self.sg, width, block_tile=cfg.block_tile,
+                    block_nnz=cfg.block_nnz,
+                    block_group=cfg.block_group,
+                    rem_dtype=cfg.rem_dtype or "auto",
+                    rem_amax=cfg.rem_amax,
+                    chunk_edges=cfg.spmm_chunk,
+                    bucket_merge=getattr(cfg, "bucket_merge", 0),
+                    edge_budget=int(getattr(
+                        cfg, "tuner_samples",
+                        tuner.DEFAULT_EDGE_BUDGET)))
+                if cd:
+                    try:
+                        tuner.save_tuning(cd, rec)
+                    except OSError:
+                        pass  # read-only artifact: table is session-only
+            else:
+                source = "default"
+                why = ("tuning disabled (--no-tune)"
+                       if not getattr(cfg, "tune", True)
+                       else "multi-process run (live tuning would "
+                            "desync ranks)")
+                warnings.warn(
+                    f"spmm_impl='auto' with no trusted tuning table "
+                    f"({reason}) and no live tune ({why}); using the "
+                    f"deterministic default {tuner.DEFAULT_IMPL!r}")
+                rec = {"winner": {"name": tuner.DEFAULT_IMPL,
+                                  "impl": tuner.DEFAULT_IMPL,
+                                  "rem_dtype": None, "rem_amax": False,
+                                  "block_group": 1},
+                       "costs": []}
+        win = dict(rec["winner"])
+        self.tuning = {
+            "winner": win,
+            "source": source,
+            "stale_reason": None if source == "artifact" else reason,
+            "costs": rec.get("costs", []),
+            "emitted": False,
+        }
+        # fill tuner-chosen transport/group defaults — never override
+        # an explicit user pin (a pinned value restricted the grid)
+        repl = {}
+        if cfg.rem_dtype is None and win.get("rem_dtype"):
+            repl["rem_dtype"] = win["rem_dtype"]
+            repl["rem_amax"] = bool(win.get("rem_amax"))
+        if win["impl"] == "block" and cfg.block_group <= 1 \
+                and int(win.get("block_group", 1)) > 1:
+            repl["block_group"] = int(win["block_group"])
+        if repl:
+            self.cfg = dataclasses.replace(self.cfg, **repl)
+            self._eval_cfg = dataclasses.replace(self._eval_cfg, **repl)
+        return win["impl"]
 
     # ---------------- data placement ----------------------------------
 
     @classmethod
     def prewarm_tables(cls, sg: ShardedGraph, cfg: ModelConfig) -> None:
         """Build and disk-cache the kernel tables for (sg, cfg) WITHOUT
-        constructing the full trainer — no device uploads, no pp
-        precompute. The scarce-TPU workflow: the O(E) host builds run
-        while the chip is unavailable, so the next real run only loads
-        npz (docs/PERF_NOTES.md tunnel notes)."""
+        constructing the full trainer — no full-graph device uploads,
+        no pp precompute. The scarce-TPU workflow: the O(E) host builds
+        run while the chip is unavailable, so the next real run only
+        loads npz (docs/PERF_NOTES.md tunnel notes). spmm_impl='auto'
+        additionally runs the tuner's micro-bench campaign (small
+        sampled slice on the current backend) and persists tuning.json
+        into the artifact, then warms the winner's tables — this is
+        the artifact-build-time tuning entry point."""
         if getattr(sg, "cache_dir", None) is None:
             raise ValueError(
                 "prewarm_tables needs a disk-backed artifact "
@@ -471,16 +477,17 @@ class Trainer:
             # and returns early — block would silently warm nothing
             cacheable = cfg.spmm_impl in ("auto", "bucket")
         else:
-            cacheable = cfg.spmm_impl in ("bucket", "block")
+            cacheable = cfg.spmm_impl in ("auto", "bucket", "block")
         if not cacheable:
             raise ValueError(
                 f"spmm_impl={cfg.spmm_impl!r} does not disk-cache "
-                "tables (only bucket/block — and the gat kernel — do); "
-                "nothing to prewarm")
+                "tables (only auto/bucket/block — and the gat kernel — "
+                "do); nothing to prewarm")
         self = cls.__new__(cls)
         self.sg = sg
         self.cfg = dataclasses.replace(cfg, sorted_edges=True)
-        self._setup_pallas_spmm()
+        self._eval_cfg = self.cfg
+        self._setup_spmm()
 
     def _put_data(self, skip_edges: bool = False) -> Dict[str, jax.Array]:
         sg = self.sg
@@ -501,8 +508,6 @@ class Trainer:
                 np.arange(sg.n_max)[None, :] < sg.inner_count[:, None]
             ).astype(np.float32),
         }
-        if self._pallas_tables is not None:
-            arrs.update(self._pallas_tables)
         if self._bucket_tables is not None:
             arrs.update(self._bucket_tables)
         if self._block_tables is not None:
@@ -566,9 +571,8 @@ class Trainer:
         same mesh (the sharded evaluator's use_pp input).
 
         Aggregates through bucket/block kernel tables when `data`
-        carries them (the raw edge list then never needs to reach the
-        device at all); the pallas kernel is excluded — its VMEM gate
-        was checked for the layer widths, not the raw feature width."""
+        carries them — the raw edge list then never needs to reach the
+        device at all."""
         sg = sg if sg is not None else self.sg
         data = data if data is not None else self.data
         n_max = sg.n_max
@@ -614,10 +618,6 @@ class Trainer:
                 pp, mesh=self.mesh,
                 in_specs=(jax.tree_util.tree_map(lambda _: spec, d_in),),
                 out_specs=spec,
-                # fused block kernel in interpret mode: same VMA
-                # mismatch relaxation as the train step (see _make_step)
-                check_vma=not ("blk_a_bits_t" in d_in
-                               and jax.default_backend() == "cpu"),
             )
         )
         return fn(d_in)
@@ -645,13 +645,6 @@ class Trainer:
         # transport — their cost is irrelevant and raw feature ranges
         # can exceed e4m3's +-448
         rem_dtype = cfg.rem_dtype if transport else None
-        if "spmm_esrc" in d:
-            from ..ops.pallas_spmm import make_device_spmm_fn
-
-            return make_device_spmm_fn(
-                d, n_max, n_src_rows, self._pallas_max_e,
-                getattr(self, "_pallas_interpret", False), cfg.spmm_chunk,
-            )
         if "bkt_fwd_inv" in d:
             from ..ops.bucket_spmm import make_device_bucket_spmm_fn
 
@@ -667,8 +660,6 @@ class Trainer:
                 d, d["in_deg"], n_max, n_src_rows, self._block_tile,
                 chunk_edges=cfg.spmm_chunk, rem_dtype=rem_dtype,
                 rem_amax=cfg.rem_amax and transport,
-                interpret=jax.default_backend() == "cpu",
-                axis_name=PARTS_AXIS if "blk_a_bits_t" in d else None,
             )
         return None
 
@@ -706,8 +697,6 @@ class Trainer:
         pipeline = tcfg.enable_pipeline
         glayers = list(self._graph_layer_range())
         momentum = tcfg.corr_momentum
-        use_pallas = self._pallas_tables is not None
-        pallas_interp = getattr(self, "_pallas_interpret", False)
         # trace-time gates for the numerics guardrails: the tripwire
         # adds a handful of isfinite reductions; loss scaling adds the
         # scale multiply + the overflow-skip select. Both off -> the
@@ -965,14 +954,6 @@ class Trainer:
                 lambda _: PartitionSpec(PARTS_AXIS), self.state["comm"]
             ),
         }
-        # pallas interpret mode (CPU testing) hits an internal VMA
-        # mismatch in jax's HLO interpreter; relax the check there only
-        # (same for the fused block kernel, whose interpreted
-        # dynamic_slice mixes varying and unvaried operands)
-        fused_interp = (self._block_tables is not None
-                        and "blk_a_bits_t" in self._block_tables
-                        and jax.default_backend() == "cpu")
-        check_vma = not ((use_pallas and pallas_interp) or fused_interp)
         # every step metric is a replicated scalar (post-psum); the
         # tripwire counts and overflow flag ride the same contract
         metric_spec = {"loss": PartitionSpec(), "grad_norm": PartitionSpec()}
@@ -987,7 +968,6 @@ class Trainer:
             in_specs=(state_spec, data_spec, PartitionSpec(),
                       PartitionSpec()),
             out_specs=(state_spec, metric_spec),
-            check_vma=check_vma,
         )
 
         def multi(state, data, rngs, scale):
@@ -1005,7 +985,6 @@ class Trainer:
             in_specs=(state_spec, data_spec, PartitionSpec(),
                       PartitionSpec()),
             out_specs=(state_spec, metric_spec),
-            check_vma=check_vma,
         )
         self._multi_step = jax.jit(smapped_multi, donate_argnums=(0,))
         return jax.jit(smapped, donate_argnums=(0,))
@@ -1025,9 +1004,7 @@ class Trainer:
 
     def _current_impl(self) -> str:
         """The aggregation kernel the step is currently built on (the
-        RESOLVED impl — 'auto' never survives _setup_pallas_spmm)."""
-        if self._pallas_tables is not None:
-            return "pallas"
+        RESOLVED impl — 'auto' never survives _setup_spmm)."""
         if self._block_tables is not None:
             return "block"
         if self._bucket_tables is not None:
@@ -1049,11 +1026,11 @@ class Trainer:
         self.cfg = dataclasses.replace(self.cfg, spmm_impl=to_impl)
         self._eval_cfg = dataclasses.replace(self._eval_cfg,
                                              spmm_impl=to_impl)
-        self._setup_pallas_spmm()
+        self._setup_spmm()
         keep = {k: v for k, v in self.data.items()
-                if not k.startswith(("spmm_", "bkt_", "blk_", "gat_"))}
+                if not k.startswith(("bkt_", "blk_", "gat_"))}
         tables_active = False
-        for t in (self._pallas_tables, self._bucket_tables,
+        for t in (self._bucket_tables,
                   self._block_tables, self._gat_tables):
             if t is not None:
                 tables_active = True
@@ -1345,6 +1322,22 @@ class Trainer:
                 config={"model": dataclasses.asdict(self.cfg),
                         "train": dataclasses.asdict(self.tcfg)},
                 device=device_info(), mesh=mesh_info(self.mesh))
+        # ---- tuner decision (set at _setup_spmm for spmm_impl='auto'):
+        # surface WHY this kernel dispatches, once per run ----
+        if getattr(self, "tuning", None) is not None and \
+                not self.tuning.get("emitted"):
+            self.tuning["emitted"] = True
+            w = self.tuning["winner"]
+            log_fn(f"spmm auto-tuner: kernel={w['name']} "
+                   f"(source={self.tuning['source']}"
+                   + (f", {self.tuning['stale_reason']}"
+                      if self.tuning.get("stale_reason") else "")
+                   + ")")
+            if metrics is not None:
+                metrics.tuning(
+                    winner=dict(w), source=self.tuning["source"],
+                    stale_reason=self.tuning.get("stale_reason"),
+                    costs=self.tuning.get("costs", []))
         halo_bytes = self.est_halo_bytes_per_epoch()
         best_val, best_params, best_norm, best_epoch = 0.0, None, None, -1
         durs = []

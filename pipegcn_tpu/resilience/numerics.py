@@ -25,11 +25,14 @@ subsystem instead of a post-hoc NaN in a results file
    contracted `numerics` record.
 
 3. **Kernel fallback ladder** (`fallback_ladder` + trainer wiring): a
-   TPU-backend / Pallas compile-or-first-dispatch crash downgrades the
+   TPU-backend compile-or-first-dispatch crash downgrades the
    aggregation kernel block -> bucket -> sorted-XLA automatically, with
    a contracted `fallback` record, instead of killing the run — the
    Dorylus-style graceful degradation the block-kernel products-shape
-   crash (VERDICT r5 "What's weak" 3) demanded.
+   crash (VERDICT r5 "What's weak" 3) demanded. The ladder is the
+   safety net UNDER the measured auto-tuner dispatch (ops/tuner.py):
+   the tuner picks the fastest measured kernel, the ladder guarantees
+   a crashing pick degrades instead of killing the run.
 """
 
 from __future__ import annotations
@@ -215,7 +218,6 @@ class KernelFallbackError(RuntimeError):
 # least performant but most battle-tested formulation; if THAT crashes
 # the failure is not the kernel's.
 _LADDER = {
-    "pallas": "bucket",
     "block": "bucket",
     "bucket": "xla",
     "auto": None,    # resolved by the trainer to what auto picked

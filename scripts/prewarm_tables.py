@@ -1,14 +1,22 @@
 #!/usr/bin/env python
 """Pre-build + disk-cache kernel tables for a partition artifact.
 
-Host-side only (no device work): run while the TPU tunnel is down so
-the next bench/microbench on the real chip skips the minutes-long O(E)
-table builds (docs/PERF_NOTES.md tunnel notes). One invocation per
-kernel configuration; the cache key (Trainer._cached_tables) encodes
-(impl, tile, width, nnz, group).
+Mostly host-side: run while the TPU tunnel is down so the next
+bench/microbench on the real chip skips the minutes-long O(E) table
+builds (docs/PERF_NOTES.md tunnel notes). One invocation per kernel
+configuration; the cache key (Trainer._cached_tables) encodes
+(impl, tile, width, nnz, group, merge).
+
+--impl auto additionally runs the SpMM auto-tuner's micro-bench
+campaign on the current backend (small sampled slice — the one part of
+prewarm that does touch the device) and persists the tuning.json
+sidecar into the artifact, then warms the winner's tables. Run it on
+the backend you will train on: the table signature pins the backend,
+so a CPU-prewarmed table is (correctly) rejected on TPU.
 
 Usage: python scripts/prewarm_tables.py --impl block --group 4
        [--part partitions/bench-reddit-1-c2-s1024] [--block-nnz N]
+       python scripts/prewarm_tables.py --impl auto   # tune + warm
 """
 
 import argparse
@@ -25,12 +33,15 @@ def main():
     ap.add_argument("--part",
                     default="partitions/bench-reddit-1-c2-s1024")
     ap.add_argument("--impl", default="block",
-                    choices=["block", "bucket", "gat"])
+                    choices=["auto", "block", "bucket", "gat"])
     ap.add_argument("--group", type=int, default=1)
     ap.add_argument("--block-nnz", type=int, default=0)
-    ap.add_argument("--fused", action="store_true",
-                    help="also warm the sublane-repacked A cache for "
-                         "the fused Pallas dense path (--block-fused)")
+    ap.add_argument("--bucket-merge", type=int, default=0)
+    ap.add_argument("--tuner-samples", type=int, default=200_000)
+    ap.add_argument("--retune", action="store_true",
+                    help="with --impl auto: delete any persisted "
+                         "tuning.json first and force a fresh "
+                         "micro-bench campaign")
     ap.add_argument("--hidden", type=int, default=256)
     args = ap.parse_args()
 
@@ -44,6 +55,13 @@ def main():
     if not os.path.isabs(args.part):
         args.part = os.path.join(REPO, args.part)
     sg = ensure(args.part, log=lambda m: print(m, file=sys.stderr))
+    if args.retune and args.impl == "auto":
+        from pipegcn_tpu.ops import tuner
+
+        p = tuner.tuning_path(sg.cache_dir)
+        if os.path.exists(p):
+            os.remove(p)
+            print(f"removed {p} (forcing re-tune)", file=sys.stderr)
     cfg = ModelConfig(
         model="gat" if args.impl == "gat" else "graphsage",
         layer_sizes=(sg.n_feat,) + (args.hidden,) * 3 + (sg.n_class,),
@@ -51,7 +69,8 @@ def main():
         train_size=sg.n_train_global,
         spmm_impl="bucket" if args.impl == "gat" else args.impl,
         block_nnz=args.block_nnz or None,
-        block_group=args.group, block_fused=args.fused,
+        block_group=args.group, bucket_merge=args.bucket_merge,
+        tuner_samples=args.tuner_samples,
         dtype="bfloat16",
     )
     t0 = time.perf_counter()
@@ -59,6 +78,15 @@ def main():
     print(f"warmed {args.impl} tables (group={args.group}, "
           f"nnz={args.block_nnz or 'auto'}) "
           f"in {time.perf_counter() - t0:.1f}s")
+    if args.impl == "auto":
+        from pipegcn_tpu.ops import tuner
+
+        rec, why = tuner.load_tuning(sg.cache_dir)
+        if rec is not None:
+            print(f"tuning.json winner: {rec['winner']['name']} "
+                  f"(backend {rec['signature']['backend']})")
+        else:
+            print(f"no tuning.json persisted ({why})", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -37,9 +37,6 @@ def main():
     ap.add_argument("--probe-traffic", action="store_true",
                     help="table-surgery decomposition of the dense "
                          "term: F-tile reads vs A reads vs MXU")
-    ap.add_argument("--fused", action="store_true",
-                    help="fused unpack+matmul Pallas dense path "
-                         "(ops/fused_block.py; needs --group > 1)")
     args = ap.parse_args()
 
     import jax
@@ -61,7 +58,7 @@ def main():
         train_size=sg.n_train_global, spmm_chunk=2_097_152,
         dtype="bfloat16", spmm_impl="block",
         block_nnz=args.block_nnz or None,
-        block_group=args.group, block_fused=args.fused,
+        block_group=args.group,
     )
     tr = Trainer(sg, cfg, TrainConfig(lr=0.01, n_epochs=1, eval=False))
     d = {k: v[0] for k, v in tr.data.items()}
@@ -74,8 +71,6 @@ def main():
     ).astype(jnp.bfloat16)
 
     from pipegcn_tpu.ops.block_spmm import make_device_block_spmm_fn
-
-    interp = jax.default_backend() == "cpu"
 
     def variant(name, keep):
         # The tables ride as jit ARGUMENTS, never closure constants:
@@ -90,7 +85,7 @@ def main():
         def apply(tables, in_deg, f):
             fn = make_device_block_spmm_fn(
                 tables, in_deg, n_max, n_src, tr._block_tile,
-                chunk_edges=cfg.spmm_chunk, interpret=interp)
+                chunk_edges=cfg.spmm_chunk)
             return fn(f)
 
         fwd = jax.jit(apply)
@@ -176,12 +171,12 @@ def main():
         # bf16 on the host — no device-side bit unpack, so the
         # [rows, K, T, S] elementwise transient (which XLA materializes
         # between HBM round-trips; it cannot fuse producers into a dot)
-        # disappears, at the price of 16x the A-read bytes. If packed
-        # is SLOWER than wide here, the transient dominates the A term
-        # and a fused Pallas unpack+matmul kernel is worth building
-        # (docs/PERF_NOTES.md round-3 session-2 hypothesis). Note the
-        # a0 surgery above does NOT isolate this: collapsing indices to
-        # block 0 still unpacks every slot.
+        # disappears, at the price of 16x the A-read bytes. (The
+        # fused unpack+matmul kernel this probe once motivated lost
+        # on-chip twice and was deleted — docs/PERF_NOTES.md "fused
+        # block kernel: negative result".) Note the a0 surgery above
+        # does NOT isolate this: collapsing indices to block 0 still
+        # unpacks every slot.
         if "blk_a_bits" in d:
             packed_bits = d.pop("blk_a_bits")
             # np.unpackbits is the exact inverse of pack_a_blocks
@@ -211,7 +206,6 @@ def main():
         rec = {
             "backend": jax.default_backend(),
             "group": args.group,
-            "fused": args.fused,
             "width": args.width,
             "full_fwd_s": full[0], "full_fwdbwd_s": full[1],
             "dense_fwd_s": dense[0], "dense_fwdbwd_s": dense[1],
@@ -225,8 +219,7 @@ def main():
         # keyed by backend/config so a CPU smoke run or a different
         # group/fused probe never clobbers the real TPU calibration
         # record
-        tag = (f"{jax.default_backend()}_g{args.group}"
-               + ("_fused" if args.fused else ""))
+        tag = f"{jax.default_backend()}_g{args.group}"
         out_path = os.path.join(REPO, "results",
                                 f"probe_traffic_{tag}.json")
         os.makedirs(os.path.dirname(out_path), exist_ok=True)

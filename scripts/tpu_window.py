@@ -56,6 +56,16 @@ QUEUE = [
     ("rem_probe",
      [sys.executable, "scripts/rem_probe.py"],
      2400, [_BENCH_PART]),
+    # run the SpMM auto-tuner's micro-bench campaign ON CHIP and
+    # persist tuning.json into the bench artifact: every later
+    # spmm-impl=auto step in this queue (and future rounds reusing the
+    # artifact) dispatches from a TPU-measured cost table instead of
+    # live-tuning inside its own window budget. --retune evicts any
+    # CPU-signed table; cheap (one sampled slice, 13 candidates).
+    ("spmm_tune",
+     [sys.executable, "scripts/prewarm_tables.py", "--impl", "auto",
+      "--retune"],
+     1800, [_BENCH_PART]),
     # calibrated-task convergence study (VERDICT item 2) THIRD so a
     # single ~45-min window covers the top-2 probes AND puts real
     # training hours on the accuracy claim (on chip this study is
@@ -69,10 +79,11 @@ QUEUE = [
       "--light-dir", "results/convergence_light/d492",
       "--time-budget", "1500"],
      2400, []),
-    # refresh the round-5 headline + results/last_tpu_bench.json
-    ("bench_u4_f8_r5",
-     [sys.executable, "bench.py", "--block-group", "4",
-      "--rem-dtype", "float8", "--no-compare"],
+    # refresh the headline + results/last_tpu_bench.json through the
+    # measured auto-tuner table (persisted by spmm_tune above); also
+    # runs the bucket-merge floor-lever before/after pass
+    ("bench_auto_tuned",
+     [sys.executable, "bench.py", "--no-compare"],
      3600, [_BENCH_PART]),
     # VERDICT r5 item 8: second shape point for the auto-kernel policy
     ("offshape_products",

@@ -261,42 +261,6 @@ def test_bitpacked_a_parity_and_selection():
     np.testing.assert_array_equal(unpacked, a)
 
 
-def test_auto_picks_block_on_clustered_large_shards(monkeypatch):
-    """'auto' beyond the VMEM regime: block when the layout has dense-
-    tile coverage (clustered community graph), bucket when it does not
-    (uniform random edges). Thresholds patched down to test scale."""
-    import pipegcn_tpu.parallel.trainer as tr
-    from pipegcn_tpu.ops.pallas_spmm import sharded_applicable
-    from pipegcn_tpu.partition import locality_clusters
-
-    monkeypatch.setattr(tr, "_AUTO_BLOCK_MIN_EDGES", 100)
-
-    def build(homophily, use_cluster):
-        g = synthetic_graph(num_nodes=600, avg_degree=10, n_feat=12,
-                            n_class=4, homophily=homophily, seed=31)
-        parts = partition_graph(g, 4, seed=0)
-        cl = locality_clusters(g, target_size=32, seed=0) \
-            if use_cluster else None
-        sg = ShardedGraph.build(g, parts, n_parts=4, cluster=cl)
-        cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
-                          dropout=0.0, train_size=sg.n_train_global,
-                          spmm_impl="auto", block_tile=32)
-        return Trainer(sg, cfg, TrainConfig(seed=1))
-
-    # force auto past the pallas VMEM gate so the large-shard choice runs
-    monkeypatch.setattr(
-        "pipegcn_tpu.ops.pallas_spmm.sharded_applicable",
-        lambda *a, **k: False)
-    monkeypatch.setattr(
-        tr, "sharded_applicable", lambda *a, **k: False, raising=False)
-
-    t_clustered = build(homophily=0.95, use_cluster=True)
-    t_uniform = build(homophily=0.0, use_cluster=False)
-    assert t_clustered._block_tables is not None
-    assert t_uniform._block_tables is None
-    assert t_uniform._bucket_tables is not None
-
-
 def test_group_union_extends_short_ladder():
     """An explicitly passed union-width ladder that tops out below the
     device's max union size is extended, not a hard failure — direct
@@ -346,76 +310,6 @@ def test_block_grouped_union_matches_dense(edges, group):
     g_r = jax.grad(lambda f: (ref_fn(f) ** 2).sum())(fbuf)
     np.testing.assert_allclose(np.asarray(g_u), np.asarray(g_r),
                                rtol=1e-5, atol=1e-6)
-
-
-@pytest.mark.parametrize("group", [2, 4])
-def test_block_fused_matches_grouped(edges, group):
-    """Fused Pallas union-gather path (interpret mode): exact agreement
-    with the XLA grouped path and the dense reference, forward and
-    gradient — incl. the non-128-multiple F pad/slice."""
-    from pipegcn_tpu.ops.block_spmm import pack_a_blocks
-    from pipegcn_tpu.ops.fused_block import repack_bits_sublane
-
-    src, dst, n_out, n_src = edges
-    # fused needs 0/1 A: drop duplicate edges
-    uniq = np.unique(dst * n_src + src)
-    dst, src = uniq // n_src, uniq % n_src
-    rng = np.random.default_rng(7)
-    fbuf = jnp.asarray(rng.standard_normal((n_src, 8)).astype(np.float32))
-    deg = jnp.asarray(
-        np.maximum(np.bincount(dst, minlength=n_out), 1).astype(np.float32)
-    )
-    plan = BlockPlan(src, dst, n_out, n_src, n_feat=8, tile=16,
-                     nnz_threshold=4, group=group)
-    assert plan.a_blocks.shape[0] > 0
-    arrs = {k: jnp.asarray(v) for k, v in plan_to_arrays(plan).items()}
-    bits = pack_a_blocks(plan.a_blocks)
-    del arrs["blk_a"]
-    arrs["blk_a_bits"] = jnp.asarray(bits)
-    fn_ref = make_block_spmm_fn(dict(arrs), deg, n_out, n_src, 16)
-    arrs["blk_a_bits_t"] = jnp.asarray(repack_bits_sublane(bits))
-    fn_fused = make_block_spmm_fn(arrs, deg, n_out, n_src, 16,
-                                  interpret=True)
-    out_f = fn_fused(fbuf)
-    np.testing.assert_allclose(np.asarray(out_f), np.asarray(fn_ref(fbuf)),
-                               rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(
-        np.asarray(out_f),
-        _ref_mean(src, dst, n_out, np.asarray(fbuf), deg),
-        rtol=1e-5, atol=1e-5)
-    g_f = jax.grad(lambda f: (fn_fused(f) ** 2).sum())(fbuf)
-    g_r = jax.grad(lambda f: (fn_ref(f) ** 2).sum())(fbuf)
-    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_r),
-                               rtol=1e-5, atol=1e-6)
-
-
-def test_trainer_block_fused_matches_xla():
-    """Trainer-level: --block-fused trains loss-for-loss with the
-    raw-edge XLA path on a clustered multi-device layout (table derive,
-    shard_map stripping, and the custom VJP all exercised)."""
-    from pipegcn_tpu.partition import locality_clusters
-
-    g = synthetic_graph(num_nodes=600, avg_degree=10, n_feat=12,
-                        n_class=4, homophily=0.9, seed=25)
-    parts = partition_graph(g, 4, seed=0)
-    cluster = locality_clusters(g, target_size=64, seed=0)
-    sg = ShardedGraph.build(g, parts, n_parts=4, cluster=cluster)
-    losses, accs = {}, {}
-    for impl, fused in (("xla", False), ("block", True)):
-        # use_pp=True: the pp precompute runs the fused closure inside
-        # its own shard_map (the check_vma relaxation there)
-        cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
-                          dropout=0.0, train_size=sg.n_train_global,
-                          spmm_impl=impl, block_tile=32, block_group=4,
-                          block_fused=fused, use_pp=True)
-        t = Trainer(sg, cfg, TrainConfig(seed=4, enable_pipeline=True))
-        losses[impl] = [t.train_epoch(e) for e in range(6)]
-        # sharded eval's shard_map also traces the fused closure
-        accs[impl] = t.evaluate(g, "val_mask", sharded=True)
-        if impl == "block":
-            assert "blk_a_bits_t" in t._block_tables
-    np.testing.assert_allclose(losses["xla"], losses["block"], rtol=2e-4)
-    np.testing.assert_allclose(accs["xla"], accs["block"], atol=1e-6)
 
 
 def test_trainer_block_grouped_matches_xla():

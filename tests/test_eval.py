@@ -86,20 +86,6 @@ def test_sharded_eval_through_block_tables_matches():
     assert ev._dev_data["edge_src"] is t.data["edge_src"]  # dummies reused
 
 
-def test_sharded_eval_through_pallas_tables_matches():
-    # pallas interpret mode on the CPU mesh needs the evaluator's
-    # check_vma relaxation (same as the train step's)
-    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
-                        seed=34)
-    t = _trainer(g, spmm_impl="pallas")
-    assert t._edges_trimmed
-    for e in range(3):
-        t.train_epoch(e)
-    full = t.evaluate(g, "val_mask")
-    sharded = t.evaluate(g, "val_mask", sharded=True)
-    assert full == pytest.approx(sharded, abs=1e-9)
-
-
 def test_sharded_eval_matches_full_use_pp_and_batchnorm():
     g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12, n_class=5,
                         seed=32)

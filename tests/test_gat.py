@@ -112,8 +112,6 @@ def test_gat_chunked_matches_unchunked(graph):
 def test_gat_rejects_table_impls_and_bad_heads():
     with pytest.raises(ValueError, match="does not apply to gat"):
         ModelConfig(layer_sizes=(4, 8, 2), model="gat", spmm_impl="block")
-    with pytest.raises(ValueError, match="does not apply to gat"):
-        ModelConfig(layer_sizes=(4, 8, 2), model="gat", spmm_impl="pallas")
     with pytest.raises(ValueError, match="n_heads"):
         ModelConfig(layer_sizes=(4, 8, 2), model="gat", n_heads=0)
 

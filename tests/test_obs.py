@@ -28,12 +28,12 @@ from pipegcn_tpu.utils.timer import CommTimer
 
 # ---------------- schema -------------------------------------------------
 
-# FROZEN copy of the v3 contract (v2 + the numerics/fallback kinds the
-# numerical-robustness PR added, bumping the version to 3). If any
-# assert below fires, a field was removed or retyped without bumping
+# FROZEN copy of the v4 contract (v3 + the tuning kind the SpMM
+# auto-tuner PR added, bumping the version to 4). If any assert below
+# fires, a field was removed or retyped without bumping
 # SCHEMA_VERSION — consumers (bench trajectory, report CLI, timeline
 # CLI, scripts) would break silently.
-_V3_FIELDS = {
+_V4_FIELDS = {
     "run": {
         "event": "string", "schema_version": "integer",
         "time_unix": "number", "config": "object", "device": "object",
@@ -77,10 +77,14 @@ _V3_FIELDS = {
         "event": "string", "epoch": "integer", "from_impl": "string",
         "to_impl": "string",
     },
+    "tuning": {
+        "event": "string", "winner": "object", "source": "string",
+        "costs": "array",
+    },
 }
 
 
-def test_schema_v3_drift_guard():
+def test_schema_v4_drift_guard():
     current = {"run": obs_schema.RUN_FIELDS,
                "epoch": obs_schema.EPOCH_FIELDS,
                "eval": obs_schema.EVAL_FIELDS,
@@ -91,9 +95,10 @@ def test_schema_v3_drift_guard():
                "anatomy": obs_schema.ANATOMY_FIELDS,
                "staleness": obs_schema.STALENESS_FIELDS,
                "numerics": obs_schema.NUMERICS_FIELDS,
-               "fallback": obs_schema.FALLBACK_FIELDS}
-    if obs_schema.SCHEMA_VERSION == 3:
-        for kind, fields in _V3_FIELDS.items():
+               "fallback": obs_schema.FALLBACK_FIELDS,
+               "tuning": obs_schema.TUNING_FIELDS}
+    if obs_schema.SCHEMA_VERSION == 4:
+        for kind, fields in _V4_FIELDS.items():
             for name, tag in fields.items():
                 assert current[kind].get(name) == tag, (
                     f"schema field {kind}.{name} removed or retyped "
@@ -101,7 +106,7 @@ def test_schema_v3_drift_guard():
     else:
         # a bump legitimizes any field change; the contract is that the
         # version moved WITH the change
-        assert obs_schema.SCHEMA_VERSION > 3
+        assert obs_schema.SCHEMA_VERSION > 4
 
 
 def test_validate_record():
@@ -123,6 +128,20 @@ def test_validate_record():
                          "staleness_age": 1, "memory": None})
     # unknown event kinds are free-form
     validate_record({"event": "bench", "whatever": [1, 2]})
+
+
+def test_validate_tuning_record():
+    validate_record({"event": "tuning",
+                     "winner": {"name": "block-u4-bf16",
+                                "impl": "block"},
+                     "source": "artifact", "costs": [],
+                     "stale_reason": None})
+    with pytest.raises(ValueError, match="winner"):
+        validate_record({"event": "tuning", "source": "live",
+                         "costs": []})
+    with pytest.raises(ValueError, match="expected array"):
+        validate_record({"event": "tuning", "winner": {},
+                         "source": "live", "costs": {}})
 
 
 # ---------------- sink ---------------------------------------------------

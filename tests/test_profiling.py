@@ -421,5 +421,12 @@ def test_window_queue_declares_requirements():
     every entry is a 4-tuple."""
     tw = _load_tpu_window()
     by_name = {name: req for name, _, _, req in tw.QUEUE}
-    for step in ("epoch_anatomy", "rem_probe", "bench_u4_f8_r5"):
+    for step in ("epoch_anatomy", "rem_probe", "spmm_tune",
+                 "bench_auto_tuned"):
         assert tw._BENCH_PART in by_name[step]
+    # the round-4/5 gaters keep first claim on the window, and the
+    # on-chip tuner warm runs before the auto-dispatch bench
+    order = [name for name, _, _, _ in tw.QUEUE]
+    assert order.index("epoch_anatomy") < order.index("spmm_tune")
+    assert order.index("rem_probe") < order.index("spmm_tune")
+    assert order.index("spmm_tune") < order.index("bench_auto_tuned")

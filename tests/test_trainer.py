@@ -343,20 +343,24 @@ def test_identity_collectives_switch(graph):
     assert not np.allclose(real, ident, rtol=1e-6)
 
 
-def test_emulate_auto_never_picks_pallas(graph):
-    """emulate_parts + spmm_impl='auto' must route around the Pallas
-    CSR kernel (its grid cannot carry the emulation vmap batch axis —
-    TPU lowering rejects it, observed round 4); forcing 'pallas' under
-    emulation raises."""
+def test_emulate_auto_resolves_and_unknown_impl_rejected(graph):
+    """emulate_parts + spmm_impl='auto' resolves through the tuner
+    path (tune=False here: no table and no live micro-bench means the
+    loud deterministic default) and trains; an impl name outside the
+    shipped set raises instead of silently falling back — there is no
+    legacy dispatch path."""
     parts = partition_graph(graph, 4, seed=0)
     sg = ShardedGraph.build(graph, parts, n_parts=4)
     cfg = ModelConfig(layer_sizes=(12, 16, 4), norm="layer",
-                      train_size=sg.n_train_global, spmm_impl="auto")
+                      train_size=sg.n_train_global, spmm_impl="auto",
+                      tune=False)
     tc = TrainConfig(seed=0, emulate_parts=True)
-    t = Trainer(sg, cfg, tc)
-    assert t._pallas_tables is None
+    with pytest.warns(UserWarning, match="deterministic default"):
+        t = Trainer(sg, cfg, tc)
+    assert t.tuning["source"] == "default"
+    assert t._current_impl() == t.tuning["winner"]["impl"]
     assert np.isfinite(t.train_epoch(0))
-    with pytest.raises(ValueError, match="emulate_parts"):
+    with pytest.raises(ValueError, match="unknown spmm_impl"):
         Trainer(sg, dataclasses.replace(cfg, spmm_impl="pallas"), tc)
 
 

@@ -1,0 +1,249 @@
+"""SpMM auto-tuner tests (ops/tuner.py + Trainer._resolve_auto).
+
+The contract under test: spmm_impl='auto' resolves from a MEASURED
+cost table — the artifact's persisted tuning.json when trusted, a live
+micro-bench campaign otherwise — never from hand-coded shape
+thresholds. Covers the cost-table persistence round-trip through both
+artifact formats (v2 npz and v3 mmap), deterministic table-driven
+dispatch on two distinct synthetic shapes, and the loud live-retune
+fallback on stale/corrupt tables.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.ops import tuner
+from pipegcn_tpu.parallel import TrainConfig, Trainer
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+pytestmark = pytest.mark.tuning
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memo():
+    tuner.clear_memo()
+    yield
+    tuner.clear_memo()
+
+
+def _sharded(num_nodes=400, avg_degree=8, n_feat=12, n_class=4,
+             seed=11, n_parts=1, homophily=0.5):
+    g = synthetic_graph(num_nodes=num_nodes, avg_degree=avg_degree,
+                        n_feat=n_feat, n_class=n_class, seed=seed,
+                        homophily=homophily)
+    parts = partition_graph(g, n_parts, seed=0)
+    return ShardedGraph.build(g, parts, n_parts=n_parts)
+
+
+def _cfg(sg, **kw):
+    kw.setdefault("spmm_impl", "auto")
+    kw.setdefault("tuner_samples", 5000)
+    return ModelConfig(layer_sizes=(sg.n_feat, 16, sg.n_class),
+                       norm="layer", dropout=0.0,
+                       train_size=sg.n_train_global, **kw)
+
+
+def _trainer_width(cfg):
+    # the width Trainer._resolve_auto keys the signature on
+    return max(cfg.layer_sizes[:cfg.n_graph_layers])
+
+
+# ---------------- candidate grid (pure) -------------------------------
+
+
+def test_candidate_grid_full_and_pinned():
+    full = tuner.candidate_grid()
+    names = [c["name"] for c in full]
+    assert len(names) == len(set(names))  # distinct labels
+    assert "xla" in names
+    # every {impl} x {rem} x {group} combination is present
+    assert {"bucket", "bucket-bf16", "bucket-f8",
+            "bucket-f8amax"} <= set(names)
+    assert {"block", "block-u4", "block-u4-f8amax"} <= set(names)
+    # pinning the transport dtype or group RESTRICTS the grid — the
+    # tuner never overrides an explicit user choice
+    pinned = tuner.candidate_grid(rem_dtype="float8", rem_amax=False)
+    assert all(c["rem_dtype"] == "float8" for c in pinned
+               if c["impl"] != "xla")
+    grouped = tuner.candidate_grid(block_group=8)
+    assert all(c["block_group"] == 8 for c in grouped
+               if c["impl"] == "block")
+
+
+def test_sample_slice_preserves_degree_distribution():
+    sg = _sharded(num_nodes=2000, avg_degree=10, seed=7)
+    sample, info = tuner.sample_slice(sg, edge_budget=3000)
+    assert sample.num_parts == 1 and sample.halo_size == 0
+    assert info["sample_edges"] == int(sample.edge_count[0])
+    assert info["full_edges"] >= info["sample_edges"]
+    assert info["scale"] >= 1.0
+    # each sampled destination keeps its FULL in-edge list, so every
+    # sampled in-degree exists in the source shard's distribution
+    ec = int(sg.edge_count[0])
+    full_deg = np.bincount(np.asarray(sg.edge_dst[0][:ec]),
+                           minlength=sg.n_max)
+    full_counts = set(full_deg[full_deg > 0].tolist())
+    samp_dst = np.asarray(sample.edge_dst[0])
+    samp_deg = np.bincount(samp_dst)
+    assert set(samp_deg[samp_deg > 0].tolist()) <= full_counts
+
+
+# ---------------- round-trip through the artifact ---------------------
+
+
+@pytest.mark.parametrize("mmap", [False, True])
+def test_cost_table_roundtrip_artifact(tmp_path, mmap):
+    """Live tune -> tuning.json sidecar -> a fresh trainer over the
+    reloaded artifact dispatches from the persisted table (source
+    'artifact', identical winner) for BOTH artifact formats."""
+    sg = _sharded(seed=11)
+    path = str(tmp_path / ("art_v3" if mmap else "art_v2"))
+    sg.save(path, mmap=mmap)
+
+    sg1 = ShardedGraph.load(path)
+    t1 = Trainer(sg1, _cfg(sg1), TrainConfig(seed=0))
+    assert t1.tuning["source"] == "live"
+    win = dict(t1.tuning["winner"])
+    # the full measured table rode along: every candidate either timed
+    # or recorded its failure — a crash is a result, not a gap
+    costs = t1.tuning["costs"]
+    assert costs and all(
+        (c["spmm_fwdbwd_s"] is None) == (c["error"] is not None)
+        for c in costs)
+    ok = [c for c in costs if c["error"] is None]
+    assert win["name"] == min(
+        ok, key=lambda c: c["spmm_fwdbwd_s"])["name"]  # measured argmin
+    assert os.path.exists(tuner.tuning_path(path))
+    assert np.isfinite(t1.train_epoch(0))
+
+    tuner.clear_memo()  # force the second trainer onto the DISK table
+    sg2 = ShardedGraph.load(path)
+    t2 = Trainer(sg2, _cfg(sg2), TrainConfig(seed=0))
+    assert t2.tuning["source"] == "artifact"
+    assert t2.tuning["stale_reason"] is None
+    assert t2.tuning["winner"] == win
+    assert t2._current_impl() == win["impl"]
+
+
+# ---------------- table-driven dispatch (two shapes) ------------------
+
+
+def _plant_table(path, sg, cfg, winner):
+    """Persist a crafted tuning.json whose signature/checksum match
+    what Trainer._resolve_auto computes for (sg, cfg)."""
+    sig = tuner.signature_for(
+        width=_trainer_width(cfg), block_tile=cfg.block_tile,
+        bucket_merge=0, chunk_edges=cfg.spmm_chunk)
+    rec = {
+        "tuner_format": tuner.TUNER_FORMAT,
+        "source_edge_checksum":
+            int(sg.source_edge_checksum) & ((1 << 64) - 1),
+        "signature": sig,
+        "winner": winner,
+        "costs": [dict(winner, spmm_fwdbwd_s=1e-4,
+                       est_epoch_spmm_s=1e-3, error=None)],
+    }
+    tuner.save_tuning(path, rec)
+    return rec
+
+
+def test_table_driven_dispatch_two_shapes(tmp_path):
+    """Two distinct shapes (reddit-ish dense-degree vs products-ish
+    sparse-degree), each with a DIFFERENT planted measured winner: the
+    dispatch must follow each table — proof there is no shape
+    heuristic left to override the measurement."""
+    shapes = {
+        "reddit": (dict(num_nodes=500, avg_degree=20, seed=3),
+                   {"name": "bucket-bf16", "impl": "bucket",
+                    "rem_dtype": "bfloat16", "rem_amax": False,
+                    "block_group": 1}),
+        "products": (dict(num_nodes=600, avg_degree=5, seed=4),
+                     {"name": "xla", "impl": "xla", "rem_dtype": None,
+                      "rem_amax": False, "block_group": 1}),
+    }
+    for label, (shape, winner) in shapes.items():
+        sg = _sharded(**shape)
+        path = str(tmp_path / label)
+        sg.save(path)
+        sgl = ShardedGraph.load(path)
+        cfg = _cfg(sgl)
+        _plant_table(path, sgl, cfg, winner)
+        t = Trainer(sgl, cfg, TrainConfig(seed=0))
+        assert t.tuning["source"] == "artifact", label
+        assert t._current_impl() == winner["impl"], label
+        if winner["rem_dtype"]:
+            # the tuner-chosen transport filled the unpinned default
+            assert t.cfg.rem_dtype == winner["rem_dtype"], label
+        assert np.isfinite(t.train_epoch(0)), label
+
+
+# ---------------- stale / corrupt -> loud live fallback ---------------
+
+
+def test_stale_and_corrupt_tables_fall_back_to_live(tmp_path):
+    sg = _sharded(seed=21)
+    path = str(tmp_path / "art")
+    sg.save(path)
+
+    # corrupt sidecar: live re-tune with the reason recorded
+    with open(tuner.tuning_path(path), "w") as f:
+        f.write("{not json")
+    sg1 = ShardedGraph.load(path)
+    t1 = Trainer(sg1, _cfg(sg1), TrainConfig(seed=0))
+    assert t1.tuning["source"] == "live"
+    assert "corrupt" in t1.tuning["stale_reason"]
+    # the live result REPLACED the rot on disk
+    rec, why = tuner.load_tuning(path)
+    assert why is None and rec["winner"] == t1.tuning["winner"]
+
+    # stale checksum (artifact rebuilt from a different graph): the
+    # table is rejected with a loud reason and live tuning runs again
+    rec["source_edge_checksum"] = (rec["source_edge_checksum"] + 1) \
+        & ((1 << 64) - 1)
+    tuner.save_tuning(path, rec)
+    sg2 = ShardedGraph.load(path)
+    t2 = Trainer(sg2, _cfg(sg2), TrainConfig(seed=0))
+    assert t2.tuning["source"] == "live"
+    assert "checksum" in t2.tuning["stale_reason"]
+
+    # format drift is rejected the same way
+    rec2, _ = tuner.load_tuning(path)
+    rec2["tuner_format"] = tuner.TUNER_FORMAT + 1
+    tuner.save_tuning(path, rec2)
+    got, reason = tuner.load_tuning(path)
+    assert got is None and "format" in reason
+
+
+def test_multiprocess_never_live_tunes(tmp_path, monkeypatch):
+    """Without a trusted table, a multi-process run must take the
+    deterministic default (live timing noise would argmin different
+    kernels per rank and desync the SPMD program)."""
+    import jax
+
+    sg = _sharded(seed=31)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.warns(UserWarning, match="deterministic default"):
+        t = Trainer(sg, _cfg(sg), TrainConfig(seed=0))
+    assert t.tuning["source"] == "default"
+    assert t.tuning["winner"]["impl"] == tuner.DEFAULT_IMPL
+    assert t.tuning["costs"] == []
+
+
+def test_tuning_record_schema_contract():
+    """The trainer-emitted tuning dict must satisfy the contracted
+    obs record kind (tests/test_obs.py pins the v4 field list)."""
+    from pipegcn_tpu.obs.schema import validate_record
+
+    sg = _sharded(seed=41)
+    t = Trainer(sg, _cfg(sg, tune=False), TrainConfig(seed=0))
+    tu = t.tuning
+    validate_record({"event": "tuning", "winner": tu["winner"],
+                     "source": tu["source"], "costs": tu["costs"],
+                     "stale_reason": tu["stale_reason"]})
+    # and it is JSON-serializable end to end (lands in metrics JSONL)
+    json.dumps(tu["winner"]), json.dumps(tu["costs"])
