@@ -275,6 +275,24 @@ def main():
                     choices=["none", "bfloat16", "float8"],
                     help="gather-transport dtype for the remainder "
                          "(float8: e4m3/e5m2, f32 accumulation)")
+    ap.add_argument("--rng-impl", default="threefry",
+                    choices=["threefry", "rbg", "unsafe_rbg"],
+                    help="dropout PRNG implementation (floor lever 1)")
+    ap.add_argument("--dropout-bits", type=int, default=32,
+                    choices=[8, 32],
+                    help="dropout mask generation width (8 = one "
+                         "random byte per element)")
+    ap.add_argument("--halo-dtype", default="none",
+                    choices=["none", "bfloat16", "float8"],
+                    help="halo ppermute wire dtype (floor lever 2; "
+                         "pipelined runs only)")
+    ap.add_argument("--epoch-block", type=int, default=0,
+                    help="megastep dispatch size override "
+                         "(0 = --fused; floor lever 3)")
+    ap.add_argument("--comm-prefetch", action="store_true",
+                    help="issue the layer-0 halo collective at step "
+                         "top (floor lever 4; no-op under the "
+                         "headline's use_pp config)")
     ap.add_argument("--sweep-spmm", action="store_true",
                     help="also time every SpMM impl and report the winner")
     ap.add_argument("--probe-tries", type=int, default=0,
@@ -434,6 +452,7 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         tune=args.tune,
         tuner_samples=args.tuner_samples,
         rem_dtype=args.rem_dtype,  # 'none' normalized by ModelConfig
+        dropout_bits=args.dropout_bits,
     )
     blk = max(1, args.fused)
 
@@ -442,6 +461,12 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
             lr=0.01, n_epochs=args.blocks * blk,
             enable_pipeline=pipeline, seed=0, eval=False,
             fused_epochs=blk,
+            rng_impl=args.rng_impl,
+            # halo compression is pipelined-only (vanilla exchange is
+            # differentiated and must stay exact)
+            halo_dtype=args.halo_dtype if pipeline else "none",
+            epoch_block=args.epoch_block,
+            comm_prefetch=args.comm_prefetch,
         )
         return Trainer(sg, cfg, tcfg)
 
@@ -537,6 +562,9 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         "spmm_impl": args.spmm_impl,
         "pipeline": headline_pipeline,
         "loss": round(loss, 4) if np.isfinite(loss) else None,
+        "rng_impl": args.rng_impl,
+        "halo_dtype": args.halo_dtype if headline_pipeline else "none",
+        "epoch_block": args.epoch_block,
     }
     if trainer.fallbacks:
         # the kernel fallback ladder fired mid-measurement: the number
@@ -761,6 +789,73 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
             if lever.get("bucket") and lever.get("bucket-m8"):
                 extras["bucket_merge_delta_s"] = round(
                     lever["bucket"] - lever["bucket-m8"], 4)
+
+        # ---- non-SpMM floor levers: before/after per lever ------------
+        # Each lever is measured against the headline config with exactly
+        # one knob flipped, crash-isolated so one broken variant never
+        # costs the others or the in-hand headline:
+        #   rng-rbg       dropout PRNG threefry -> rbg
+        #   dropout-bits8 8-bit mask draws instead of 32-bit
+        #   halo-float8   fp8+amax halo wire (pipelined headline only)
+        #   unfused       force_blk=1: the megastep win read backwards
+        #                 (base IS the fused dispatch, so the delta is
+        #                 unfused - base)
+        #   prefetch-*    paired use_pp=False runs, since the layer-0
+        #                 exchange the prefetch hoists does not exist
+        #                 under the headline's use_pp=True config
+        if (((backend == "tpu" and not args.small)
+             or args.force_candidate)
+                and not extras.get("degraded")
+                and args.rng_impl == "threefry"
+                and args.dropout_bits == 32
+                and args.halo_dtype == "none"
+                and args.epoch_block == 0
+                and not args.comm_prefetch):
+            floor = {"base": round(epoch_s, 4)}
+
+            def _floor_lever(name, mkw=None, tkw=None, f_blk=0):
+                try:
+                    t0 = time.perf_counter()
+                    c = dataclasses.replace(cfg, **mkw) if mkw else cfg
+                    tr_l = Trainer(sg, c, TrainConfig(
+                        lr=0.01, n_epochs=args.blocks * blk,
+                        enable_pipeline=headline_pipeline, seed=0,
+                        eval=False, fused_epochs=blk, **(tkw or {})))
+                    s, _, _ = time_trainer(
+                        tr_l, max(3, args.blocks // 2),
+                        force_blk=f_blk or used_blk)
+                    floor[name] = round(s, 4)
+                    print(f"# floor lever {name}: {s:.4f}s/epoch "
+                          f"(total {time.perf_counter()-t0:.0f}s)",
+                          file=sys.stderr)
+                    del tr_l
+                except Exception as exc:  # noqa: BLE001
+                    floor[name] = None
+                    print(f"# floor lever {name} failed: {exc!r}",
+                          file=sys.stderr)
+
+            _floor_lever("rng-rbg", tkw=dict(rng_impl="rbg"))
+            _floor_lever("dropout-bits8", mkw=dict(dropout_bits=8))
+            if headline_pipeline:
+                _floor_lever("halo-float8",
+                             tkw=dict(halo_dtype="float8"))
+            if used_blk > 1:
+                _floor_lever("unfused", f_blk=1)
+            if headline_pipeline:
+                _floor_lever("prefetch-off", mkw=dict(use_pp=False))
+                _floor_lever("prefetch-on", mkw=dict(use_pp=False),
+                             tkw=dict(comm_prefetch=True))
+            extras["floor_levers"] = floor
+            # positive delta == the lever saves time vs its reference
+            for dkey, ref, var in (
+                    ("rng_impl_delta_s", "base", "rng-rbg"),
+                    ("dropout_bits_delta_s", "base", "dropout-bits8"),
+                    ("halo_dtype_delta_s", "base", "halo-float8"),
+                    ("epoch_block_delta_s", "unfused", "base"),
+                    ("comm_prefetch_delta_s", "prefetch-off",
+                     "prefetch-on")):
+                if floor.get(ref) and floor.get(var):
+                    extras[dkey] = round(floor[ref] - floor[var], 4)
 
         # ---- optional SpMM implementation sweep -----------------------
         if args.sweep_spmm:

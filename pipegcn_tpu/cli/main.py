@@ -322,6 +322,7 @@ def run(args) -> dict:
         tuner_samples=args.tuner_samples,
         rem_dtype=args.rem_dtype,  # 'none' normalized by ModelConfig
         rem_amax=args.rem_amax,
+        dropout_bits=args.dropout_bits,
         dtype=args.dtype,
     )
     tcfg = TrainConfig(
@@ -337,6 +338,10 @@ def run(args) -> dict:
         eval=args.eval,
         fused_epochs=args.fused_epochs,
         rng_impl=args.rng_impl,
+        dropout_reuse=args.dropout_reuse,
+        halo_dtype=args.halo_dtype,
+        epoch_block=args.epoch_block,
+        comm_prefetch=args.comm_prefetch,
         numerics_tripwire=args.numerics_tripwire,
         loss_scale=args.loss_scale,
     )

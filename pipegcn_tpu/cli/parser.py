@@ -173,11 +173,51 @@ def create_parser() -> argparse.ArgumentParser:
                         help="epochs per compiled dispatch (lax.scan); "
                              "amortizes host round-trips")
     parser.add_argument("--rng-impl", "--rng_impl",
-                        choices=["threefry", "rbg"], default="threefry",
-                        help="dropout PRNG: threefry (jax default) or "
+                        choices=["threefry", "rbg", "unsafe_rbg"],
+                        default="threefry",
+                        help="dropout PRNG: threefry (jax default), "
                              "rbg (hardware-RNG-backed, cheaper mask "
                              "generation on TPU; different but equally "
-                             "valid masks at the same seed)")
+                             "valid masks at the same seed), or "
+                             "unsafe_rbg (cheapest; weaker fold_in/split "
+                             "guarantees — fine for dropout noise, "
+                             "never for init)")
+    parser.add_argument("--dropout-bits", "--dropout_bits", type=int,
+                        choices=[8, 32], default=32,
+                        help="dropout mask generation width: 8 draws "
+                             "one random byte per element (quarter the "
+                             "generated bits; keep-prob quantized to "
+                             "1/256) instead of bernoulli's uniform-f32 "
+                             "compare")
+    parser.add_argument("--dropout-reuse", "--dropout_reuse", type=int,
+                        default=0,
+                        help="reuse each dropout mask for N consecutive "
+                             "epochs (the per-epoch key folds "
+                             "epoch//N), amortizing mask generation "
+                             "N-fold inside fused blocks; 0/1 = fresh "
+                             "mask every epoch")
+    parser.add_argument("--halo-dtype", "--halo_dtype",
+                        choices=["none", "bfloat16", "float8"],
+                        default="none",
+                        help="wire dtype of the halo ppermute payloads "
+                             "(pipelined mode only): bfloat16 halves "
+                             "ICI bytes per hop, float8 quarters them "
+                             "(e4m3 features / e5m2 bgrads, amax-scaled "
+                             "per distance block; decoded back to the "
+                             "compute dtype on receipt)")
+    parser.add_argument("--epoch-block", "--epoch_block", type=int,
+                        default=0,
+                        help="epochs per megastep dispatch (donated-"
+                             "carry lax.scan + one batched metrics "
+                             "harvest per block); overrides "
+                             "--fused-epochs when set, 0 = inherit it")
+    parser.add_argument("--comm-prefetch", "--comm_prefetch",
+                        action="store_true",
+                        help="issue the layer-0 halo collective at the "
+                             "top of the step so it overlaps the "
+                             "previous epoch's tail inside a fused "
+                             "block (pipelined, no --use-pp; "
+                             "numerically identical)")
     parser.add_argument("--local-reorder", "--local_reorder",
                         choices=["none", "cluster"], default="cluster",
                         help="local-id ordering within each partition: "

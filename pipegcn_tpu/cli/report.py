@@ -89,6 +89,15 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             if isinstance(r.get("halo_bytes"), int)]
     if halo:
         out["halo_bytes_per_epoch"] = max(halo)
+    # --halo-dtype compression: epochs carry the uncompressed figure
+    # alongside, so the report can show wire bytes before/after
+    halo_unc = [r["halo_bytes_uncompressed"] for r in epochs
+                if isinstance(r.get("halo_bytes_uncompressed"), int)]
+    if halo_unc:
+        out["halo_bytes_uncompressed_per_epoch"] = max(halo_unc)
+        if halo and max(halo):
+            out["halo_compression_ratio"] = round(
+                max(halo_unc) / max(halo), 4)
     ages = [r["staleness_age"] for r in epochs
             if isinstance(r.get("staleness_age"), int)]
     if ages:
@@ -221,6 +230,14 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             out["anatomy_flop_shares"] = {
                 k: round(v.get("flops", 0.0) / ef, 4)
                 for k, v in ph.items() if isinstance(v, dict)}
+            # the non-SpMM floor: everything the epoch spends that is
+            # NOT the aggregation kernel (ROADMAP item 1's target; the
+            # four --rng-impl/--halo-dtype/--epoch-block/--comm-prefetch
+            # levers attack exactly this share)
+            spmm = sum(v for k, v in out["anatomy_flop_shares"].items()
+                       if "spmm" in k)
+            out["anatomy_non_spmm_share"] = round(
+                max(0.0, 1.0 - spmm), 4)
     return out
 
 
@@ -252,6 +269,12 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
     row("loss delta", "loss_delta", "{:+.4f}")
     row("grad norm (last)", "grad_norm_last", "{:.4e}")
     row("halo bytes / epoch", "halo_bytes_per_epoch", "{:,}")
+    if s.get("halo_bytes_uncompressed_per_epoch") is not None:
+        lines.append("  {:<26} {:,} -> {:,} ({}x)".format(
+            "halo wire compression",
+            s["halo_bytes_uncompressed_per_epoch"],
+            s.get("halo_bytes_per_epoch", 0),
+            s.get("halo_compression_ratio", "?")))
     row("staleness age (max)", "staleness_age_max")
     row("memory peak", "memory_peak_bytes", "{:,} bytes")
     row("comm cost (standalone)", "comm_cost_s", "{:.4f} s")
@@ -283,6 +306,7 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
         lines.append("  {:<26} {}".format(
             "anatomy flop shares", ", ".join(
                 f"{k} {v:.1%}" for k, v in top)))
+        row("non-SpMM floor share", "anatomy_non_spmm_share", "{:.1%}")
         row("anatomy attributed", "anatomy_attributed_flops_fraction",
             "{:.1%}")
     row("MFU", "mfu_pct", "{:.2f} %")

@@ -105,6 +105,14 @@ class ModelConfig:
     # scale is applied after the (linear) aggregation. No-op unless
     # rem_dtype is 'float8'.
     rem_amax: bool = False
+    # dropout mask generation width (the RNG floor lever, with
+    # --rng-impl): 32 = jax.random.bernoulli (uniform f32 compare,
+    # reference parity); 8 = one random BYTE per element compared
+    # against round(rate*256) — a quarter of the generated bits and no
+    # f32 conversion, at the cost of quantizing the keep probability to
+    # 1/256 (invisible at the usual 0.5). Masks differ from 32-bit mode
+    # at the same seed (equally valid dropout noise).
+    dropout_bits: int = 32
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
 
     def __post_init__(self):
@@ -121,6 +129,9 @@ class ModelConfig:
         if self.bucket_merge < 0:
             raise ValueError(
                 f"bucket_merge must be >= 0, got {self.bucket_merge}")
+        if self.dropout_bits not in (8, 32):
+            raise ValueError(
+                f"dropout_bits must be 8 or 32, got {self.dropout_bits}")
         if self.model in ("gcn", "gat") and self.use_pp:
             # the pp precompute caches SAGE's mean-neighbor concat;
             # gcn/gat first layers aggregate like every other layer
@@ -390,13 +401,23 @@ def _gat_layer(fbuf, lp, edge_src, edge_dst, n_dst, n_heads, slope,
     return out.astype(out_dtype) + lp["b"].astype(out_dtype)
 
 
-def _dropout(rng, h, rate):
+def _dropout(rng, h, rate, bits: int = 32):
     if rate <= 0.0:
         return h
     # named scope: the RNG + mask traffic show up as their own phase in
-    # profiler traces / anatomy records (the floor term --rng-impl rbg
-    # targets)
+    # profiler traces / anatomy records (the floor terms --rng-impl rbg
+    # and --dropout-bits 8 target)
     with jax.named_scope("dropout"):
+        if bits == 8:
+            # one random byte per element: keep iff byte >= thresh,
+            # drop probability thresh/256 — the inverse scale uses the
+            # QUANTIZED keep probability so the mask stays unbiased
+            thresh = int(round(rate * 256.0))
+            thresh = min(max(thresh, 1), 255)
+            keep = jax.random.bits(rng, h.shape, jnp.uint8) >= jnp.uint8(
+                thresh)
+            keep_q = 1.0 - thresh / 256.0
+            return jnp.where(keep, h / keep_q, 0.0)
         keep = jax.random.bernoulli(rng, 1.0 - rate, h.shape)
         return jnp.where(keep, h / (1.0 - rate), 0.0)
 
@@ -501,7 +522,7 @@ def forward(
                     h = comm_update(i, h)
                     probe("halo_concat", h)
                 if training and cfg.dropout > 0:
-                    h = _dropout(sub, h, cfg.dropout)
+                    h = _dropout(sub, h, cfg.dropout, cfg.dropout_bits)
                 lp = params["layers"][i]
                 if cfg.use_pp and i == 0:
                     h = dense(h, lp["w"], lp["b"], out_dt)
@@ -557,7 +578,7 @@ def forward(
                          + dense(ah.astype(cdt), lp["w2"], lp["b2"], out_dt))
         else:
             if training and cfg.dropout > 0:
-                h = _dropout(sub, h, cfg.dropout)
+                h = _dropout(sub, h, cfg.dropout, cfg.dropout_bits)
             lp = params["layers"][i]
             h = dense(h, lp["w"], lp["b"], out_dt)
 

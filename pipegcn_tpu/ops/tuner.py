@@ -271,9 +271,18 @@ def _time_candidate(sample, cand: Dict[str, Any], width: int, *,
 
 
 def signature_for(*, width: int, block_tile: int, bucket_merge: int,
-                  chunk_edges: Optional[int]) -> Dict[str, Any]:
+                  chunk_edges: Optional[int],
+                  rng_impl: str = "threefry",
+                  halo_dtype: str = "none",
+                  epoch_block: int = 0) -> Dict[str, Any]:
     """Config signature a persisted table must match to be trusted.
-    Backend is part of it: CPU timings say nothing about the TPU."""
+    Backend is part of it: CPU timings say nothing about the TPU. The
+    floor-lever knobs (rng_impl / halo_dtype / epoch_block) are part of
+    it too: they reshape the step program around the SpMM, so a cost
+    table measured under one lever setting must not silently pick
+    kernels for another. Tables persisted before these keys existed
+    mismatch (exact-dict compare) and re-tune once — deliberate; the
+    keyword defaults match TrainConfig's for older call sites."""
     import jax
 
     return {
@@ -282,6 +291,9 @@ def signature_for(*, width: int, block_tile: int, bucket_merge: int,
         "block_tile": int(block_tile),
         "bucket_merge": int(bucket_merge),
         "chunk_edges": int(chunk_edges) if chunk_edges else 0,
+        "rng_impl": str(rng_impl or "threefry"),
+        "halo_dtype": str(halo_dtype or "none"),
+        "epoch_block": int(epoch_block or 0),
     }
 
 
@@ -289,6 +301,8 @@ def tune(sg, width: int, *, block_tile: int = 256,
          block_nnz: Optional[int] = None, block_group: int = 0,
          rem_dtype: str = "auto", rem_amax: bool = False,
          chunk_edges: Optional[int] = None, bucket_merge: int = 0,
+         rng_impl: str = "threefry", halo_dtype: str = "none",
+         epoch_block: int = 0,
          edge_budget: int = DEFAULT_EDGE_BUDGET, reps: int = 2,
          seed: int = 0,
          log: Optional[Callable[[str], None]] = None) -> Dict[str, Any]:
@@ -298,7 +312,9 @@ def tune(sg, width: int, *, block_tile: int = 256,
     trainer constructions over the same artifact pay once."""
     sig = signature_for(width=width, block_tile=block_tile,
                         bucket_merge=bucket_merge,
-                        chunk_edges=chunk_edges)
+                        chunk_edges=chunk_edges,
+                        rng_impl=rng_impl, halo_dtype=halo_dtype,
+                        epoch_block=epoch_block)
     checksum = int(getattr(sg, "source_edge_checksum", -1)) \
         & ((1 << 64) - 1)
     memo_key = (checksum, json.dumps(sig, sort_keys=True),

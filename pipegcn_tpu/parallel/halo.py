@@ -20,14 +20,39 @@ last epoch's halo features, injecting last epoch's boundary gradients
 into this epoch's backward (reference feature_buffer.py:153-163,228-236),
 and exposing this epoch's halo cotangent through a probe input so the
 train step can ship it to owners for the next epoch.
+
+Compressed transport (`--halo-dtype`): the ppermute payloads may travel
+in a narrower dtype than the compute dtype — the same bf16/fp8
+machinery the SpMM gather transport uses (ops/bucket_spmm.py
+transport_dtypes/transport_cast) applied to the ICI wire itself. Each
+distance block is cast on the sender, permuted narrow, and decoded
+back to the compute dtype on the receiver; fp8 payloads ship a
+per-block power-of-two inverse scale alongside (amax_transport_cast,
+the PR 5 range guard), so large activations are never statically
+saturated nor small cotangents flushed. Pipelined-mode only: the
+exchange there sits behind stop_gradient / an explicit cotangent ship,
+so the cast never lands inside a differentiated path.
 """
 
 from __future__ import annotations
 
 import contextlib
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..ops.bucket_spmm import amax_transport_cast, transport_dtypes
+
+
+def halo_transport_dtypes(halo_dtype: Optional[str]) -> Tuple:
+    """(feature, bgrad) wire dtypes for a --halo-dtype spec, following
+    the SpMM transport convention: activations e4m3, cotangents e5m2,
+    bf16 for both, None = uncompressed (the compute dtype)."""
+    if halo_dtype in (None, "", "none"):
+        return None, None
+    # reuse the rem-transport mapping ('bfloat16' | 'float8')
+    return transport_dtypes(halo_dtype)
 
 
 def _ensure_varying(x: jax.Array, axis_name: str) -> jax.Array:
@@ -78,17 +103,40 @@ def _ring_permute(blk: jax.Array, axis_name: str, perm) -> jax.Array:
     return jax.lax.ppermute(blk, axis_name, perm)
 
 
+def _permute_compressed(blk: jax.Array, axis_name: str, perm,
+                        transport_dt) -> jax.Array:
+    """Ring-permute one distance block, optionally in a narrow wire
+    dtype. fp8 payloads use the amax-clamped cast and ship the sender's
+    power-of-two inverse scale through the SAME permutation, so the
+    receiver decodes with its peer's scale — never its own. The result
+    is always back in blk's original dtype."""
+    if transport_dt is None:
+        return _ring_permute(blk, axis_name, perm)
+    out_dt = blk.dtype
+    y, inv = amax_transport_cast(blk, transport_dt)
+    y = _ring_permute(y, axis_name, perm)
+    if inv is None:
+        # bf16 wire: a straight cast round-trips through the permute
+        return y.astype(out_dt)
+    inv = _ring_permute(jnp.asarray(inv, jnp.float32), axis_name, perm)
+    return (y.astype(jnp.float32) * inv).astype(out_dt)
+
+
 def exchange_blocks(
     h: jax.Array,
     send_idx: jax.Array,
     send_mask: jax.Array,
     axis_name: str,
     num_parts: int,
+    transport_dt=None,
 ) -> jax.Array:
     """Gather boundary rows and ring-exchange them.
 
     h: [N, F] inner rows; send_idx/mask: [P-1, B]. Returns the halo block
     [(P-1)*B, F]: distance-d rows hold features owned by (r-d) mod P.
+    `transport_dt` (optional) narrows the ppermute payload to that wire
+    dtype (decoded back to h.dtype on arrival) — pipelined-mode halo
+    compression; leave None on differentiated paths.
 
     The whole gather->permute->concat runs under the "halo_exchange"
     named scope so --profile-dir traces attribute the ring collectives
@@ -100,7 +148,9 @@ def exchange_blocks(
             blk = jnp.take(h, send_idx[d - 1], axis=0, mode="clip")
             blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
             blocks.append(
-                _ring_permute(blk, axis_name, _fwd_perm(num_parts, d)))
+                _permute_compressed(blk, axis_name,
+                                    _fwd_perm(num_parts, d),
+                                    transport_dt))
         if not blocks:
             # P=1: no halo, but the empty result must still be marked
             # device-varying so it types consistently as carry state
@@ -134,13 +184,16 @@ def return_blocks(
     axis_name: str,
     num_parts: int,
     b_max: int,
+    transport_dt=None,
 ) -> jax.Array:
     """Route halo cotangents back to their owners.
 
     halo_grad: [(P-1)*B, F] in distance order. The distance-d block came
     from owner (r-d); after the reverse permute, the device holds — in the
     same [(P-1)*B, F] layout — the gradients its peers computed for the
-    rows listed in its own send_idx (block d-1 <- peer (r+d))."""
+    rows listed in its own send_idx (block d-1 <- peer (r+d)).
+    `transport_dt` narrows the wire payload like exchange_blocks — use
+    the cotangent dtype (e5m2 under float8) for gradient range."""
     with jax.named_scope("bgrad_return"):
         outs = []
         for d in range(1, num_parts):
@@ -148,7 +201,9 @@ def return_blocks(
                 halo_grad, (d - 1) * b_max, b_max, axis=0
             )
             outs.append(
-                _ring_permute(blk, axis_name, _bwd_perm(num_parts, d)))
+                _permute_compressed(blk, axis_name,
+                                    _bwd_perm(num_parts, d),
+                                    transport_dt))
         if not outs:
             # P=1 empty case: keep the varying type (see exchange_blocks)
             return _ensure_varying(jnp.zeros_like(halo_grad), axis_name)

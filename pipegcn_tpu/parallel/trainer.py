@@ -51,6 +51,7 @@ from ..train.optim import adam_init, adam_update
 from .halo import (
     exchange_blocks,
     halo_exchange,
+    halo_transport_dtypes,
     make_stale_concat,
     return_blocks,
 )
@@ -83,7 +84,30 @@ class TrainConfig:
     # differ from threefry at the same seed but are equally valid
     # dropout noise). A floor-shrink lever for the dropout-RNG share of
     # the non-SpMM epoch floor (scripts/epoch_anatomy.py measures it).
+    # 'unsafe_rbg' drops the fold_in/split guarantees too (fastest;
+    # fine for dropout, never for init).
     rng_impl: str = "threefry"
+    # reuse each dropout mask for N consecutive epochs (0/1 = fresh
+    # mask every epoch): the per-epoch key becomes fold_in(base,
+    # epoch // N), so N epochs share bits and the RNG share of the
+    # floor divides by N. Mild regularization change — the mask cycle
+    # repeats — acceptable for large N only with measurement.
+    dropout_reuse: int = 0
+    # halo ppermute wire dtype: 'none' (compute dtype), 'bfloat16', or
+    # 'float8' (e4m3 features / e5m2 bgrads, amax-scaled per block —
+    # parallel/halo.py). Pipelined mode only: the vanilla path
+    # differentiates through the exchange and must stay exact.
+    halo_dtype: str = "none"
+    # epochs per megastep dispatch (donated-carry lax.scan + ONE host
+    # metrics sync per block). 0 = inherit fused_epochs; otherwise
+    # overrides it as the block size ceiling in fit().
+    epoch_block: int = 0
+    # issue the layer-0 halo exchange at the top of the step (before
+    # loss/grad work) so its ppermute overlaps the previous epoch's
+    # tail inside a fused block. Numerically identical: layer 0's
+    # exchange payload is the (pre-scaled) input features, which are
+    # loop-invariant. Pipelined mode, no-pp only.
+    comm_prefetch: bool = False
     # ---- numerics guardrails (resilience/numerics.py) ----
     # in-graph non-finite tripwire: cheap per-phase isfinite counts
     # (halo concat / spmm / dense / norm / logits / loss / grads) ride
@@ -386,7 +410,10 @@ class Trainer:
         sig = tuner.signature_for(
             width=width, block_tile=cfg.block_tile,
             bucket_merge=getattr(cfg, "bucket_merge", 0),
-            chunk_edges=cfg.spmm_chunk)
+            chunk_edges=cfg.spmm_chunk,
+            rng_impl=getattr(self.tcfg, "rng_impl", "threefry"),
+            halo_dtype=getattr(self.tcfg, "halo_dtype", "none"),
+            epoch_block=int(getattr(self.tcfg, "epoch_block", 0)))
         cd = getattr(self.sg, "cache_dir", None)
         rec, reason = None, "no artifact directory (in-memory graph)"
         if cd:
@@ -409,6 +436,9 @@ class Trainer:
                     rem_amax=cfg.rem_amax,
                     chunk_edges=cfg.spmm_chunk,
                     bucket_merge=getattr(cfg, "bucket_merge", 0),
+                    rng_impl=getattr(self.tcfg, "rng_impl", "threefry"),
+                    halo_dtype=getattr(self.tcfg, "halo_dtype", "none"),
+                    epoch_block=int(getattr(self.tcfg, "epoch_block", 0)),
                     edge_budget=int(getattr(
                         cfg, "tuner_samples",
                         tuner.DEFAULT_EDGE_BUDGET)))
@@ -705,6 +735,22 @@ class Trainer:
         tripwire = bool(getattr(tcfg, "numerics_tripwire", True))
         ls_on = LossScaleConfig.parse(
             getattr(tcfg, "loss_scale", "off")).enabled
+        # halo wire compression (parallel/halo.py): pipelined mode only
+        # — the vanilla path differentiates through the exchange and a
+        # lossy cast there would silently bias gradients
+        halo_dt = getattr(tcfg, "halo_dtype", "none") or "none"
+        if halo_dt != "none" and not pipeline:
+            raise ValueError(
+                "halo_dtype compression requires enable_pipeline: the "
+                "vanilla exchange is differentiated and must stay exact")
+        feat_dt, bgrad_dt = halo_transport_dtypes(halo_dt)
+        # layer-0 prefetch: the layer-0 exchange payload is the
+        # (pre-scaled) input features — parameter-independent — so it
+        # can be issued at the very top of the step, overlapping the
+        # previous epoch's tail inside a fused block. use_pp has no
+        # layer-0 exchange at all.
+        prefetch = (pipeline and bool(getattr(tcfg, "comm_prefetch", False))
+                    and not cfg.use_pp and 0 in glayers)
 
         def step(state, data, rng, scale):
             # strip the leading size-1 device axis of sharded blocks
@@ -732,6 +778,22 @@ class Trainer:
                     for i in glayers
                 }
 
+                if prefetch:
+                    # issue the layer-0 ring collective before any
+                    # loss/grad work: its payload is the (gcn-scaled)
+                    # input features, reproduced here exactly as the
+                    # forward presents them to comm_update(0, ·)
+                    with jax.named_scope("halo_prefetch"):
+                        h0 = d["feat"].astype(cdt)
+                        if cfg.model == "gcn":
+                            ds0 = jnp.sqrt(d["in_deg"].astype(jnp.float32))
+                            h0 = (h0.astype(jnp.float32)
+                                  / ds0[: h0.shape[0], None]).astype(cdt)
+                        fresh_halo["0"] = exchange_blocks(
+                            h0, d["send_idx"], d["send_mask"],
+                            PARTS_AXIS, P, transport_dt=feat_dt,
+                        )
+
                 def comm_update(i, h):
                     k = str(i)
                     stale_halo = (
@@ -751,11 +813,15 @@ class Trainer:
                                        * scale).astype(cdt)
                     op = make_stale_concat(d["send_idx"], d["send_mask"], n_max)
                     fbuf = op(h, stale_halo, stale_bgrad, probes_in[k])
-                    # this epoch's exchange, consumed next epoch; aux only
-                    fresh_halo[k] = exchange_blocks(
-                        jax.lax.stop_gradient(h), d["send_idx"],
-                        d["send_mask"], PARTS_AXIS, P,
-                    )
+                    # this epoch's exchange, consumed next epoch; aux
+                    # only. Layer 0's was already issued at step top
+                    # when prefetching (identical payload).
+                    if k not in fresh_halo:
+                        fresh_halo[k] = exchange_blocks(
+                            jax.lax.stop_gradient(h), d["send_idx"],
+                            d["send_mask"], PARTS_AXIS, P,
+                            transport_dt=feat_dt,
+                        )
                     return fbuf
             else:
                 probes = {}
@@ -868,7 +934,8 @@ class Trainer:
                     k = str(i)
                     new_comm["halo"][k] = fresh_halo[k]
                     # ship this epoch's halo cotangents to their owners
-                    bg = return_blocks(probe_grads[k], PARTS_AXIS, P, b_max)
+                    bg = return_blocks(probe_grads[k], PARTS_AXIS, P,
+                                       b_max, transport_dt=bgrad_dt)
                     if ls_on:
                         # probe cotangents carry this epoch's loss
                         # scale; the carry stores them UNSCALED (see
@@ -1000,6 +1067,15 @@ class Trainer:
                                   impl=self.tcfg.rng_impl)
         return jax.random.PRNGKey(self.tcfg.seed + 17)
 
+    def _epoch_rng_fold(self, epoch):
+        """The value folded into the base key for `epoch` (host int or
+        traced). dropout_reuse=N>1 maps N consecutive epochs onto one
+        fold value, so they draw the SAME dropout masks and the RNG
+        bits are generated once per N epochs after CSE inside a fused
+        block — the mask-reuse floor lever. 0/1 = fresh every epoch."""
+        reuse = int(getattr(self.tcfg, "dropout_reuse", 0) or 0)
+        return epoch // reuse if reuse > 1 else epoch
+
     # ---------------- kernel fallback dispatch guard -------------------
 
     def _current_impl(self) -> str:
@@ -1109,7 +1185,8 @@ class Trainer:
             self.restore_state(snap)
 
     def train_epoch(self, epoch: int) -> float:
-        rng = jax.random.fold_in(self._epoch_rng_base(), epoch)
+        rng = jax.random.fold_in(self._epoch_rng_base(),
+                                 self._epoch_rng_fold(epoch))
         scale = jnp.float32(self.loss_scaler.scale)
         self.state, m = self._dispatch(
             lambda: self._step(self.state, self.data, rng, scale))
@@ -1135,13 +1212,19 @@ class Trainer:
         dispatch, so host round-trip cost is amortized k-fold and XLA
         may overlap across epoch boundaries. Returns the k losses."""
         base = self._epoch_rng_base()
-        rngs = jax.vmap(lambda e: jax.random.fold_in(base, e))(
+        rngs = jax.vmap(
+            lambda e: jax.random.fold_in(base, self._epoch_rng_fold(e)))(
             jnp.arange(start_epoch, start_epoch + k)
         )
         scale = jnp.float32(self.loss_scaler.scale)
         self.state, ms = self._dispatch(
             lambda: self._multi_step(self.state, self.data, rngs, scale))
-        self._last_metrics = ms  # [k] arrays; see train_epoch
+        # ONE host sync for the whole block: pull every [k]-metric in a
+        # single device_get instead of per-array transfers when fit()
+        # later indexes loss/grad_norm/numerics per epoch (the megastep
+        # harvest half of the dispatch-amortization lever)
+        ms = jax.device_get(ms)
+        self._last_metrics = ms  # [k] numpy arrays; see train_epoch
         self.last_epoch = start_epoch + k  # see train_epoch
         return np.asarray(ms["loss"])
 
@@ -1339,6 +1422,11 @@ class Trainer:
                     stale_reason=self.tuning.get("stale_reason"),
                     costs=self.tuning.get("costs", []))
         halo_bytes = self.est_halo_bytes_per_epoch()
+        # with --halo-dtype compression active, record the uncompressed
+        # figure alongside so the report can print the wire ratio
+        halo_unc = self.est_halo_bytes_per_epoch(compressed=False)
+        halo_extra = ({"halo_bytes_uncompressed": halo_unc}
+                      if halo_unc != halo_bytes else {})
         best_val, best_params, best_norm, best_epoch = 0.0, None, None, -1
         durs = []
         eval_durs = []
@@ -1462,7 +1550,12 @@ class Trainer:
                                 body["overlap_fraction"], **extras)
             return body
 
-        fused = max(1, int(getattr(tcfg, "fused_epochs", 1)))
+        # megastep block size: --epoch-block overrides --fused-epochs
+        # when set (same scan machinery; the separate knob lets the
+        # floor-lever sweep vary block size without touching the
+        # numerics-labeled fused_epochs config)
+        fused = max(1, int(getattr(tcfg, "epoch_block", 0)
+                           or getattr(tcfg, "fused_epochs", 1)))
         # per-epoch work (logs/eval/checkpoint/profiler) happens at these
         # period boundaries; fused blocks must not cross one
         periods = [tcfg.log_every]
@@ -1749,6 +1842,7 @@ class Trainer:
                                 1 if tcfg.enable_pipeline and e_j > 0
                                 else 0),
                             memory=mem,
+                            **halo_extra,
                         )
                 # ---- staleness probe: relative drift between the
                 # stale halo features this epoch consumed (snapshotted
@@ -2203,15 +2297,24 @@ class Trainer:
         return {k: float(v) for k, v in ca.items()
                 if isinstance(v, (int, float))}
 
-    def est_halo_bytes_per_epoch(self) -> int:
+    def est_halo_bytes_per_epoch(self, compressed: bool = True) -> int:
         """Estimated halo wire bytes per epoch: per exchanged graph
         layer, every device ships its halo block forward and the
         boundary gradients back (2x). This is the metrics records'
         `halo_bytes` field; est_ici_bytes_per_epoch adds the gradient
-        all-reduce on top."""
+        all-reduce on top. `compressed=True` (default) accounts for the
+        --halo-dtype wire narrowing (1 byte under float8, 2 under
+        bfloat16); compressed=False gives the uncompressed figure the
+        report's compression-ratio line compares against."""
         if self.P == 1:
             return 0
         item = 4 if self.cfg.compute_dtype == jnp.float32 else 2
+        if compressed:
+            hdt = getattr(self.tcfg, "halo_dtype", "none") or "none"
+            if hdt == "float8":
+                item = 1
+            elif hdt == "bfloat16":
+                item = min(item, 2)
         total = 0
         for i in self._graph_layer_range():
             total += 2 * self.P * self.sg.halo_size * self._layer_width(i) \
@@ -2248,6 +2351,12 @@ class Trainer:
         spec = PartitionSpec(PARTS_AXIS)
 
         cdt = self.cfg.compute_dtype
+        # probe through the same wire dtypes as the train step, so the
+        # timed exchange moves the same bytes (incl. --halo-dtype
+        # compression in pipelined mode)
+        feat_dt, bgrad_dt = halo_transport_dtypes(
+            getattr(self.tcfg, "halo_dtype", "none")
+            if self.tcfg.enable_pipeline else "none")
 
         def comm_fn(feat, send_idx, send_mask):
             feat, send_idx, send_mask = feat[0], send_idx[0], send_mask[0]
@@ -2258,7 +2367,8 @@ class Trainer:
                 # the same bytes the train step's halo transport does
                 h = feat[:, :1].astype(cdt) * jnp.ones((1, w), cdt)
                 blocks = exchange_blocks(h, send_idx, send_mask,
-                                         PARTS_AXIS, P)
+                                         PARTS_AXIS, P,
+                                         transport_dt=feat_dt)
                 outs.append(blocks.sum())
             return jnp.stack(outs).sum()[None] if outs else \
                 jnp.zeros((1,), jnp.float32)
@@ -2281,7 +2391,8 @@ class Trainer:
                 hg = feat[:1, :1].astype(cdt) * jnp.ones(
                     ((P - 1) * self.sg.b_max, w), cdt)
                 outs.append(
-                    return_blocks(hg, PARTS_AXIS, P, self.sg.b_max).sum())
+                    return_blocks(hg, PARTS_AXIS, P, self.sg.b_max,
+                                  transport_dt=bgrad_dt).sum())
             return jnp.stack(outs).sum()[None] if outs else \
                 jnp.zeros((1,), jnp.float32)
 

@@ -56,6 +56,14 @@ QUEUE = [
     ("rem_probe",
      [sys.executable, "scripts/rem_probe.py"],
      2400, [_BENCH_PART]),
+    # round-8: non-SpMM floor levers measured before/after on chip —
+    # the floor_levers pass inside bench.py flips one knob at a time
+    # (rng-rbg, dropout-bits8, halo-float8, unfused-vs-megastep,
+    # prefetch pair) against the same headline and publishes per-lever
+    # *_delta_s keys in the BENCH json
+    ("floor_levers",
+     [sys.executable, "bench.py", "--no-compare", "--force-candidate"],
+     3600, [_BENCH_PART]),
     # run the SpMM auto-tuner's micro-bench campaign ON CHIP and
     # persist tuning.json into the bench artifact: every later
     # spmm-impl=auto step in this queue (and future rounds reusing the

@@ -1,0 +1,212 @@
+"""Tier-1 tests for the round-8 non-SpMM floor levers: cheap dropout
+RNG (rng_impl / dropout_bits / dropout_reuse), compressed halo wire
+transport (halo_dtype), megastep dispatch (epoch_block), and the
+layer-0 comm prefetch — all on the virtual 8-device CPU mesh."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+
+
+@pytest.fixture(scope="module")
+def sharded():
+    g = synthetic_graph(num_nodes=400, avg_degree=8, n_feat=12,
+                        n_class=4, seed=11)
+    parts = partition_graph(g, 4, seed=0)
+    return ShardedGraph.build(g, parts, n_parts=4)
+
+
+def _mk(sg, *, dropout=0.0, use_pp=False, dropout_bits=32, **tkw):
+    cfg = ModelConfig(layer_sizes=(sg.n_feat, 16, sg.n_class),
+                      norm="layer", dropout=dropout, use_pp=use_pp,
+                      train_size=sg.n_train_global,
+                      dropout_bits=dropout_bits)
+    return Trainer(sg, cfg, TrainConfig(**tkw))
+
+
+# ---------------------------------------------------------------- RNG --
+
+def test_rng_impl_deterministic_and_tracks_threefry(sharded):
+    """Each PRNG impl is deterministic at a fixed seed (two fresh
+    trainers produce identical loss sequences) and a short run stays
+    finite with losses tracking the threefry run: the impls draw
+    different mask streams, so per-epoch losses differ but must stay
+    within dropout-noise tolerance and keep converging."""
+    ref = None
+    for impl in ("threefry", "rbg", "unsafe_rbg"):
+        ta = _mk(sharded, dropout=0.3, seed=9, enable_pipeline=True,
+                 rng_impl=impl)
+        tb = _mk(sharded, dropout=0.3, seed=9, enable_pipeline=True,
+                 rng_impl=impl)
+        la = np.asarray([ta.train_epoch(e) for e in range(12)])
+        lb = np.asarray([tb.train_epoch(e) for e in range(12)])
+        np.testing.assert_allclose(la, lb, rtol=1e-6)  # deterministic
+        assert np.isfinite(la).all()
+        if ref is None:
+            ref = la  # threefry baseline
+        else:
+            # measured spread on this graph is <= ~0.06 absolute; a
+            # different mask stream must not change the trajectory class
+            np.testing.assert_allclose(la[:5], ref[:5], rtol=0.1,
+                                       atol=0.08)
+        assert la[-1] < la[0] * 0.5  # converges
+
+
+def test_rng_impls_draw_distinct_mask_streams(sharded):
+    """threefry and rbg must actually produce different dropout masks:
+    identical losses would mean the flag is dead."""
+    lt = _mk(sharded, dropout=0.3, seed=9,
+             rng_impl="threefry").train_epoch(1)
+    lr = _mk(sharded, dropout=0.3, seed=9,
+             rng_impl="rbg").train_epoch(1)
+    assert abs(float(lt) - float(lr)) > 1e-6
+
+
+def test_dropout_bits8_trains_and_validates(sharded):
+    """8-bit mask draws: config validation rejects widths other than
+    8/32, and the quantized keep-probability path converges."""
+    with pytest.raises(ValueError, match="dropout_bits"):
+        ModelConfig(layer_sizes=(12, 16, 4), dropout_bits=16)
+    t = _mk(sharded, dropout=0.3, dropout_bits=8, seed=9,
+            enable_pipeline=True)
+    losses = [t.train_epoch(e) for e in range(12)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_dropout_reuse_reuses_masks_across_epochs(sharded):
+    """dropout_reuse=N folds epoch//N into the dropout key: with frozen
+    params (lr=0) epochs inside one reuse window see the same mask
+    (identical loss), across windows a fresh one."""
+    t = _mk(sharded, dropout=0.5, seed=9, lr=0.0, dropout_reuse=2)
+    l0, l1, l2 = (float(t.train_epoch(e)) for e in range(3))
+    assert l0 == pytest.approx(l1, rel=1e-6)  # same window, same mask
+    assert abs(l2 - l0) > 1e-6  # next window redraws
+
+
+# --------------------------------------------------- compressed halo --
+
+@pytest.mark.parametrize("halo_dtype", ["bfloat16", "float8"])
+def test_compressed_halo_keeps_staleness_semantics(sharded, halo_dtype):
+    """Wire-only halo compression must not disturb the staleness-1
+    carry protocol: epoch 0 consumes zero buffers (loss identical to
+    the uncompressed pipelined run), and with frozen params the warm
+    epochs reproduce the vanilla loss to wire precision — through the
+    custom-VJP stale concat AND the unscaled-bgrad return path."""
+    tu = _mk(sharded, seed=3, lr=0.0, enable_pipeline=True)
+    lu = [tu.train_epoch(e) for e in range(4)]
+    tc = _mk(sharded, seed=3, lr=0.0, enable_pipeline=True,
+             halo_dtype=halo_dtype)
+    lc = [tc.train_epoch(e) for e in range(4)]
+    # epoch 0: carry is zeros — compression of the wire cannot change it
+    np.testing.assert_allclose(lc[0], lu[0], rtol=1e-6)
+    # warm epochs reproduce the vanilla frozen loss to wire precision
+    lv = float(_mk(sharded, seed=3, lr=0.0).train_epoch(0))
+    np.testing.assert_allclose(lc[2], lv, rtol=1e-3)
+    np.testing.assert_allclose(lc[3], lv, rtol=1e-3)
+
+
+@pytest.mark.parametrize("halo_dtype", ["bfloat16", "float8"])
+def test_compressed_halo_training_tracks_f32_wire(sharded, halo_dtype):
+    """Live training (bgrads cross the compressed wire every epoch)
+    must track the f32-wire run closely; measured drift on this graph
+    is <= ~2e-4 per epoch for fp8."""
+    t0 = _mk(sharded, seed=3, enable_pipeline=True)
+    tc = _mk(sharded, seed=3, enable_pipeline=True,
+             halo_dtype=halo_dtype)
+    l0 = np.asarray([t0.train_epoch(e) for e in range(10)])
+    lc = np.asarray([tc.train_epoch(e) for e in range(10)])
+    assert np.isfinite(lc).all()
+    np.testing.assert_allclose(lc, l0, rtol=0.02, atol=0.01)
+    assert lc[-1] < lc[0] * 0.5
+
+
+def test_halo_dtype_requires_pipeline(sharded):
+    """The vanilla exchange is differentiated and must stay exact:
+    compression without enable_pipeline is a config error."""
+    with pytest.raises(ValueError, match="enable_pipeline"):
+        _mk(sharded, seed=3, halo_dtype="bfloat16").train_epoch(0)
+
+
+def test_compressed_halo_reports_reduced_wire_bytes(sharded):
+    """est_halo_bytes_per_epoch must reflect the wire dtype; the
+    uncompressed estimate stays available for the metrics record."""
+    t8 = _mk(sharded, seed=3, enable_pipeline=True, halo_dtype="float8")
+    comp = t8.est_halo_bytes_per_epoch()
+    unc = t8.est_halo_bytes_per_epoch(compressed=False)
+    assert comp * 4 == unc  # f32 -> fp8 wire is 4x smaller
+    t0 = _mk(sharded, seed=3, enable_pipeline=True)
+    assert t0.est_halo_bytes_per_epoch() == unc
+
+
+# ------------------------------------------- megastep + comm prefetch --
+
+def test_epoch_block_megastep_matches_singles(sharded):
+    """fit() under epoch_block=N dispatches N-epoch megasteps with one
+    metrics harvest per block — numerically identical to single-epoch
+    training (same per-epoch rng folds, pipelined carry included)."""
+    ta = _mk(sharded, dropout=0.3, seed=9, enable_pipeline=True)
+    la = [ta.train_epoch(e) for e in range(6)]
+    tb = _mk(sharded, dropout=0.3, seed=9, enable_pipeline=True,
+             n_epochs=6, epoch_block=3, log_every=100)
+    tb.fit(log_fn=lambda m: None)
+    lb = np.asarray(tb._last_metrics["loss"])
+    np.testing.assert_allclose(la[3:], lb, rtol=1e-5)
+    pa = jax.tree_util.tree_leaves(jax.device_get(ta.state["params"]))
+    pb = jax.tree_util.tree_leaves(jax.device_get(tb.state["params"]))
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_comm_prefetch_bit_parity(sharded):
+    """Hoisting the layer-0 exchange to step top must be a pure
+    reordering: the exchanged tensor is parameter-independent, so
+    losses and params match the non-prefetch run exactly."""
+    t0 = _mk(sharded, seed=3, dropout=0.2, enable_pipeline=True)
+    t1 = _mk(sharded, seed=3, dropout=0.2, enable_pipeline=True,
+             comm_prefetch=True)
+    l0 = [t0.train_epoch(e) for e in range(5)]
+    l1 = [t1.train_epoch(e) for e in range(5)]
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    p0 = jax.tree_util.tree_leaves(jax.device_get(t0.state["params"]))
+    p1 = jax.tree_util.tree_leaves(jax.device_get(t1.state["params"]))
+    for a, b in zip(p0, p1):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
+
+
+def test_comm_prefetch_noop_under_use_pp(sharded):
+    """use_pp precomputes the layer-0 aggregate, so there is no layer-0
+    exchange to hoist: the flag must be inert, not crash."""
+    t = _mk(sharded, seed=3, use_pp=True, enable_pipeline=True,
+            comm_prefetch=True)
+    losses = [t.train_epoch(e) for e in range(5)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+# ------------------------------------------------------------- tuner --
+
+def test_tuner_signature_includes_floor_lever_knobs():
+    """The persisted tuning-table signature must key on the new knobs
+    so a table measured under one RNG/halo/dispatch regime is not
+    trusted under another; defaults must keep old call sites stable."""
+    from pipegcn_tpu.ops import tuner
+
+    base = tuner.signature_for(width=16, block_tile=128, bucket_merge=0,
+                               chunk_edges=0)
+    assert base["rng_impl"] == "threefry"
+    assert base["halo_dtype"] == "none"
+    assert base["epoch_block"] == 0
+    alt = tuner.signature_for(width=16, block_tile=128, bucket_merge=0,
+                              chunk_edges=0, rng_impl="rbg",
+                              halo_dtype="float8", epoch_block=8)
+    assert alt != base
+    assert (alt["rng_impl"], alt["halo_dtype"], alt["epoch_block"]) == \
+        ("rbg", "float8", 8)

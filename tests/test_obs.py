@@ -364,6 +364,39 @@ def test_report_cli_summarizes_run(cli_metrics_run, capsys):
     assert s["staleness_age_max"] == 1
 
 
+def test_report_json_pins_floor_share_and_halo_compression(tmp_path,
+                                                           capsys):
+    """--json shape pin for the round-8 floor fields: compressed-halo
+    runs expose before/after wire bytes + ratio, and anatomy-bearing
+    runs expose the non-SpMM floor share (1 - spmm phase shares)."""
+    p = tmp_path / "floor.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        for e in range(3):
+            ml.epoch(epoch=e, step_time_s=0.5, loss=1.0 - 0.1 * e,
+                     grad_norm=0.5, halo_bytes=250, staleness_age=1,
+                     memory=None, halo_bytes_uncompressed=1000)
+        ml.anatomy(
+            phases={"spmm_fwd": {"flops": 60.0},
+                    "spmm_bwd": {"flops": 20.0},
+                    "dense": {"flops": 15.0},
+                    "norm": {"flops": 5.0}},
+            est_flops=100.0, attributed_flops_fraction=0.9)
+    rc = report_main([str(p), "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["halo_bytes_per_epoch"] == 250
+    assert s["halo_bytes_uncompressed_per_epoch"] == 1000
+    assert s["halo_compression_ratio"] == pytest.approx(4.0)
+    assert s["anatomy_non_spmm_share"] == pytest.approx(0.2)
+    # human-readable lines render the same facts
+    rc = report_main([str(p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "halo wire compression" in out
+    assert "non-SpMM floor share" in out
+
+
 def test_report_cli_tolerates_partial_files(tmp_path, capsys):
     """A crashed run's file (header + some epochs, no summary) still
     summarizes; a missing file errors with rc=1, not a traceback."""
