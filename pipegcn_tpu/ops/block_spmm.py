@@ -47,8 +47,10 @@ import numpy as np
 
 from .bucket_spmm import (
     _bucket_widths,
+    add_slab_plans,
     bucket_aggregate,
     build_tables_for_edges,
+    extract_run_plans,
     ladder_prefix,
 )
 
@@ -671,7 +673,8 @@ def make_block_spmm_fn(
         rem_in, rem_inv = _rem_cast(fbuf, rem_fwd_dt)
         rem = bucket_aggregate(
             rem_in, rem_mats("blkrem_fwd_"), d["blkrem_fwd_inv"],
-            chunk_edges=chunk_edges)
+            chunk_edges=chunk_edges,
+            run_plans=extract_run_plans(d, "blkrem_fwd"))
         if rem_inv is not None:
             rem = rem * rem_inv
         return (dense + rem) / deg_col
@@ -704,7 +707,8 @@ def make_block_spmm_fn(
             rem_in, rem_inv = gd, None
         rem = bucket_aggregate(
             rem_in, rem_mats("blkrem_bwd_"), d["blkrem_bwd_inv"],
-            chunk_edges=chunk_edges)
+            chunk_edges=chunk_edges,
+            run_plans=extract_run_plans(d, "blkrem_bwd"))
         if rem_inv is not None:
             rem = rem * rem_inv
         return ((dense + rem).astype(proto.dtype),)
@@ -752,11 +756,13 @@ def build_sharded_block_tables(sg, tile: int = 256,
                                byte_budget: int = DENSE_A_BYTE_BUDGET,
                                nnz_threshold: Optional[int] = None,
                                group: int = 1,
+                               slab: bool = False,
                                ) -> Tuple[Dict[str, np.ndarray], int]:
     """Stacked per-device hybrid plans (leading device axis), padded to
     shared shapes: same B (dense block count), same K (per-tile block
-    list width), same remainder bucket ladders/caps. Returns
-    (tables, tile)."""
+    list width), same remainder bucket ladders/caps. `slab` emits
+    streaming-slab plans for the remainder tables (bucket_spmm
+    add_slab_plans). Returns (tables, tile)."""
     P = sg.num_parts
     n_src_rows = sg.n_max + sg.halo_size
     # HBM budget for the per-device dense-A tensor: keep the densest
@@ -936,7 +942,11 @@ def build_sharded_block_tables(sg, tile: int = 256,
                     p.rem_bwd_mats[b], bwd_caps[b], sg.n_max)
         for k, v in arrs.items():
             tables.setdefault(k, []).append(v)
-    return {k: np.stack(v) for k, v in tables.items()}, tile
+    stacked = {k: np.stack(v) for k, v in tables.items()}
+    if slab:
+        add_slab_plans(stacked, ("blkrem_fwd", n_src_rows),
+                       ("blkrem_bwd", sg.n_max))
+    return stacked, tile
 
 
 def make_device_block_spmm_fn(d: Dict[str, jax.Array], in_deg: jax.Array,

@@ -156,19 +156,29 @@ def sample_slice(sg, edge_budget: int = DEFAULT_EDGE_BUDGET,
 
 def candidate_grid(*, block_group: int = 0,
                    rem_dtype: str = "auto",
-                   rem_amax: bool = False) -> List[Dict[str, Any]]:
+                   rem_amax: bool = False,
+                   slab: str = "auto") -> List[Dict[str, Any]]:
     """Viable kernel configs to time. An explicitly-pinned transport
     dtype (`rem_dtype` other than "auto") or group size (`block_group`
     > 1) restricts the grid to the pinned value — the tuner never
-    overrides an explicit user choice, it only fills defaults."""
+    overrides an explicit user choice, it only fills defaults.
+
+    `slab` extends the grid with the streaming-slab gather path
+    (bucket_spmm build_slab_plan): "auto" adds one measured slab twin
+    per kernel family (on the first transport variant — a full slab x
+    transport cross product would double the compile bill for a
+    row-structure lever that is independent of the cast); "on"/"off"
+    pin every candidate."""
     if rem_dtype == "auto":
         rems = [(None, False), ("bfloat16", False), ("float8", False),
                 ("float8", True)]
     else:
         rems = [(rem_dtype, rem_amax)]
     groups = [block_group] if block_group and block_group > 1 else [1, 4]
+    pin_slab = {"on": True, "off": False}.get(slab)
+    base_slab = bool(pin_slab)
 
-    def name(impl, rd, ra, g):
+    def name(impl, rd, ra, g, sl=False):
         parts = [impl]
         if impl == "block" and g > 1:
             parts.append(f"u{g}")
@@ -176,18 +186,30 @@ def candidate_grid(*, block_group: int = 0,
             parts.append("bf16")
         elif rd == "float8":
             parts.append("f8amax" if ra else "f8")
+        if sl:
+            parts.append("slab")
         return "-".join(parts)
 
     cands = [{"name": "xla", "impl": "xla", "rem_dtype": None,
-              "rem_amax": False, "block_group": 1}]
-    for rd, ra in rems:
-        cands.append({"name": name("bucket", rd, ra, 1), "impl": "bucket",
-                      "rem_dtype": rd, "rem_amax": ra, "block_group": 1})
-    for rd, ra in rems:
+              "rem_amax": False, "block_group": 1, "slab": False}]
+    for i, (rd, ra) in enumerate(rems):
+        slabs = [base_slab]
+        if pin_slab is None and i == 0:
+            slabs = [False, True]
+        for sl in slabs:
+            cands.append({"name": name("bucket", rd, ra, 1, sl),
+                          "impl": "bucket", "rem_dtype": rd,
+                          "rem_amax": ra, "block_group": 1, "slab": sl})
+    for i, (rd, ra) in enumerate(rems):
         for g in groups:
-            cands.append({"name": name("block", rd, ra, g),
-                          "impl": "block", "rem_dtype": rd,
-                          "rem_amax": ra, "block_group": g})
+            slabs = [base_slab]
+            if pin_slab is None and i == 0:
+                slabs = [False, True]
+            for sl in slabs:
+                cands.append({"name": name("block", rd, ra, g, sl),
+                              "impl": "block", "rem_dtype": rd,
+                              "rem_amax": ra, "block_group": g,
+                              "slab": sl})
     return cands
 
 
@@ -229,8 +251,9 @@ def _time_candidate(sample, cand: Dict[str, Any], width: int, *,
         from .bucket_spmm import (build_sharded_bucket_tables,
                                   make_device_bucket_spmm_fn)
 
-        tables = build_sharded_bucket_tables(sample,
-                                             min_width=bucket_merge)
+        tables = build_sharded_bucket_tables(
+            sample, min_width=bucket_merge,
+            slab=bool(cand.get("slab")))
         tabs = {k: jnp.asarray(v[0]) for k, v in tables.items()}
 
         def apply(tabs, deg, f):
@@ -244,7 +267,8 @@ def _time_candidate(sample, cand: Dict[str, Any], width: int, *,
 
         tables, tile = build_sharded_block_tables(
             sample, tile=block_tile, n_feat_hint=width,
-            nnz_threshold=block_nnz, group=cand["block_group"])
+            nnz_threshold=block_nnz, group=cand["block_group"],
+            slab=bool(cand.get("slab")))
         tabs = {k: jnp.asarray(v[0]) for k, v in tables.items()}
 
         def apply(tabs, deg, f):
@@ -274,15 +298,20 @@ def signature_for(*, width: int, block_tile: int, bucket_merge: int,
                   chunk_edges: Optional[int],
                   rng_impl: str = "threefry",
                   halo_dtype: str = "none",
-                  epoch_block: int = 0) -> Dict[str, Any]:
+                  epoch_block: int = 0,
+                  reorder: str = "none",
+                  layout_version: int = 1) -> Dict[str, Any]:
     """Config signature a persisted table must match to be trusted.
     Backend is part of it: CPU timings say nothing about the TPU. The
     floor-lever knobs (rng_impl / halo_dtype / epoch_block) are part of
     it too: they reshape the step program around the SpMM, so a cost
     table measured under one lever setting must not silently pick
-    kernels for another. Tables persisted before these keys existed
-    mismatch (exact-dict compare) and re-tune once — deliberate; the
-    keyword defaults match TrainConfig's for older call sites."""
+    kernels for another. So are the artifact's node layout
+    (reorder/layout_version): a cost table measured on the pre-reorder
+    gather streams must not pick kernels for the reordered ones.
+    Tables persisted before these keys existed mismatch (exact-dict
+    compare) and re-tune once — deliberate; the keyword defaults match
+    TrainConfig's / pre-reorder artifacts' for older call sites."""
     import jax
 
     return {
@@ -294,6 +323,8 @@ def signature_for(*, width: int, block_tile: int, bucket_merge: int,
         "rng_impl": str(rng_impl or "threefry"),
         "halo_dtype": str(halo_dtype or "none"),
         "epoch_block": int(epoch_block or 0),
+        "reorder": str(reorder or "none"),
+        "layout_version": int(layout_version or 1),
     }
 
 
@@ -302,7 +333,7 @@ def tune(sg, width: int, *, block_tile: int = 256,
          rem_dtype: str = "auto", rem_amax: bool = False,
          chunk_edges: Optional[int] = None, bucket_merge: int = 0,
          rng_impl: str = "threefry", halo_dtype: str = "none",
-         epoch_block: int = 0,
+         epoch_block: int = 0, slab: str = "auto",
          edge_budget: int = DEFAULT_EDGE_BUDGET, reps: int = 2,
          seed: int = 0,
          log: Optional[Callable[[str], None]] = None) -> Dict[str, Any]:
@@ -314,19 +345,21 @@ def tune(sg, width: int, *, block_tile: int = 256,
                         bucket_merge=bucket_merge,
                         chunk_edges=chunk_edges,
                         rng_impl=rng_impl, halo_dtype=halo_dtype,
-                        epoch_block=epoch_block)
+                        epoch_block=epoch_block,
+                        reorder=getattr(sg, "reorder", "none"),
+                        layout_version=getattr(sg, "layout_version", 1))
     checksum = int(getattr(sg, "source_edge_checksum", -1)) \
         & ((1 << 64) - 1)
     memo_key = (checksum, json.dumps(sig, sort_keys=True),
                 int(edge_budget), int(block_group),
-                str(rem_dtype), bool(rem_amax))
+                str(rem_dtype), bool(rem_amax), str(slab))
     hit = _MEMO.get(memo_key)
     if hit is not None:
         return hit
 
     sample, info = sample_slice(sg, edge_budget=edge_budget, seed=seed)
     cands = candidate_grid(block_group=block_group, rem_dtype=rem_dtype,
-                           rem_amax=rem_amax)
+                           rem_amax=rem_amax, slab=slab)
     costs: List[Dict[str, Any]] = []
     for cand in cands:
         entry = dict(cand)
@@ -358,16 +391,29 @@ def tune(sg, width: int, *, block_tile: int = 256,
         best = min(ok, key=lambda c: c["spmm_fwdbwd_s"])
     else:
         best = {"name": DEFAULT_IMPL, "impl": DEFAULT_IMPL,
-                "rem_dtype": None, "rem_amax": False, "block_group": 1}
+                "rem_dtype": None, "rem_amax": False, "block_group": 1,
+                "slab": False}
+    # the sample's gather-contiguity stat rides in the record: the
+    # number the reorder lever is supposed to move, next to the
+    # measured winner it produced (host numpy on the sample tables —
+    # noise next to the candidate compiles)
+    try:
+        from .bucket_spmm import (build_sharded_bucket_tables,
+                                  gather_contiguity)
+        contig = gather_contiguity(
+            build_sharded_bucket_tables(sample), sample.n_max)
+    except Exception:  # noqa: BLE001 — a stat, never a tuner failure
+        contig = None
     record = {
         "tuner_format": TUNER_FORMAT,
         "source_edge_checksum": checksum,
         "signature": sig,
-        "winner": {k: best[k] for k in
+        "winner": {k: best.get(k, False) for k in
                    ("name", "impl", "rem_dtype", "rem_amax",
-                    "block_group")},
+                    "block_group", "slab")},
         "costs": costs,
         "reps": int(reps),
+        "gather_contiguity": contig,
         "time_unix": time.time(),
         **info,
     }
@@ -427,3 +473,52 @@ def load_tuning(cache_dir: str, *,
         return None, (f"stale: signature {rec.get('signature')!r} != "
                       f"{signature!r}")[:300]
     return rec, None
+
+
+# ---------------------------------------------------------------------
+# --reorder auto resolution (measured, not a hand threshold)
+
+
+def choose_reorder(g, *, modes: Tuple[str, ...] = ("none", "degree-bfs"),
+                   edge_budget: int = DEFAULT_EDGE_BUDGET, reps: int = 2,
+                   log: Optional[Callable[[str], None]] = None
+                   ) -> Tuple[str, Dict[str, float]]:
+    """Pick the artifact reorder mode for ``--reorder auto`` by
+    MEASUREMENT: build a 1-part layout of ``g`` under each candidate
+    mode, sample a degree-distribution-preserving slice, and time the
+    bucket kernel's forward+backward on it — under the reordered
+    layouts both with and without the streaming-slab plan (the path
+    the reorder exists to enable), keeping each mode's best. Returns
+    (winning mode, {mode: seconds}); an unmeasurable campaign (every
+    candidate erroring) falls back to "none" — the layout every
+    artifact already has."""
+    from ..partition import ShardedGraph
+
+    width = int(g.ndata["feat"].shape[-1]) if "feat" in g.ndata else 64
+    parts = np.zeros(g.num_nodes, dtype=np.int32)
+    timings: Dict[str, float] = {}
+    for mode in modes:
+        sg1 = ShardedGraph.build(g, parts, n_parts=1, reorder=mode)
+        sample, _ = sample_slice(sg1, edge_budget=edge_budget)
+        best = None
+        for sl in ([False] if mode == "none" else [False, True]):
+            cand = {"name": "bucket-slab" if sl else "bucket",
+                    "impl": "bucket", "rem_dtype": None,
+                    "rem_amax": False, "block_group": 1, "slab": sl}
+            try:
+                t = _time_candidate(sample, cand, width, block_tile=256,
+                                    block_nnz=None, chunk_edges=None,
+                                    bucket_merge=0, reps=reps)
+            except Exception as exc:  # noqa: BLE001 — out-of-domain
+                if log:
+                    log(f"# choose_reorder: {mode} "
+                        f"({cand['name']}) FAILED: {exc!r}"[:160])
+                continue
+            best = t if best is None else min(best, t)
+        if best is not None:
+            timings[mode] = round(best, 6)
+            if log:
+                log(f"# choose_reorder: {mode:10s} {best * 1e3:8.2f} ms")
+    if not timings:
+        return "none", timings
+    return min(timings, key=timings.get), timings

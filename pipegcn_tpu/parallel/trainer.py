@@ -63,6 +63,18 @@ from .mesh import PARTS_AXIS, make_mesh
 # campaign — there are no hand-coded shape thresholds.
 
 
+def _pad_cols(a: np.ndarray, pad: int) -> np.ndarray:
+    """Zero-pad the trailing (feature) axis by `pad` columns — the
+    lane_pad 128-lane alignment. Zero columns contribute nothing to any
+    matmul or mean aggregation, so the padded program computes the same
+    outputs on the original columns."""
+    if not pad:
+        return a
+    a = np.asarray(a)
+    return np.concatenate(
+        [a, np.zeros(a.shape[:-1] + (pad,), a.dtype)], axis=-1)
+
+
 @dataclasses.dataclass
 class TrainConfig:
     lr: float = 1e-2
@@ -145,6 +157,22 @@ class Trainer:
         # training arrays from ShardedGraph are CSR-ordered per device
         self.cfg = dataclasses.replace(cfg, sorted_edges=True)
         self._eval_cfg = dataclasses.replace(cfg, sorted_edges=True)
+        # lane_pad: align the input feature slab to the 128-lane TPU
+        # boundary. Zero columns are appended host-side (see _pad_cols)
+        # and layer_sizes[0] grows to match, so every feature buffer the
+        # step donates — and every slab-gather dynamic_slice — moves
+        # whole (8, 128) tiles. Eval paths pad identically.
+        self._feat_pad = 0
+        if getattr(cfg, "lane_pad", False):
+            pad = (-cfg.layer_sizes[0]) % 128
+            if pad:
+                self._feat_pad = pad
+                sizes = (cfg.layer_sizes[0] + pad,) \
+                    + tuple(cfg.layer_sizes[1:])
+                self.cfg = dataclasses.replace(self.cfg,
+                                               layer_sizes=sizes)
+                self._eval_cfg = dataclasses.replace(self._eval_cfg,
+                                                     layer_sizes=sizes)
         self.tcfg = tcfg
         self.P = sg.num_parts
         self.emulated = tcfg.emulate_parts
@@ -191,7 +219,8 @@ class Trainer:
             self.data["edge_dst"] = jax.device_put(dummy, self._shard)
 
         rng = jax.random.PRNGKey(tcfg.seed)
-        params = init_params(rng, cfg)
+        # self.cfg, not the ctor arg: lane_pad rewrote layer_sizes[0]
+        params = init_params(rng, self.cfg)
         if self.emulated:
             # replicated-by-construction: stacked copies stand in for
             # shard_map's replicated spec (the psum'd update keeps every
@@ -199,10 +228,10 @@ class Trainer:
             stack = lambda t: jax.tree_util.tree_map(
                 lambda v: jnp.stack([v] * self.P), t)
             params, opt, norm = (stack(params), stack(adam_init(params)),
-                                 stack(init_norm_state(cfg)))
+                                 stack(init_norm_state(self.cfg)))
         else:
             opt = adam_init(params)
-            norm = init_norm_state(cfg)
+            norm = init_norm_state(self.cfg)
         self.state = {
             "params": jax.device_put(params, self._repl),
             "opt": jax.device_put(opt, self._repl),
@@ -243,7 +272,7 @@ class Trainer:
     # ---------------- spmm kernel selection ---------------------------
 
     # bump when any kernel-table layout changes: stale caches must miss
-    _TABLES_FORMAT = 5  # v5: x1.5-step bucket/K ladders (pad <= 1.5x)
+    _TABLES_FORMAT = 6  # v6: slab-gather run plans (res/src/pos/cnt keys)
 
     def _cached_tables(self, kind: str, build_fn):
         """Disk-cache derived kernel tables next to the partition
@@ -358,15 +387,32 @@ class Trainer:
         elif impl == "block":
             self._use_block()
 
+    def _slab_flag(self) -> bool:
+        """Resolve cfg.slab to a concrete on/off for table builds:
+        'on'/'off' are user pins, 'auto' takes the tuner winner's
+        measured slab decision when one exists (self.tuning set by
+        _resolve_auto) and stays off otherwise — slab plans only pay
+        off when the layout has contiguous runs, which is exactly what
+        the tuner measures per (reorder, shape)."""
+        mode = str(getattr(self.cfg, "slab", "auto"))
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        win = (self.tuning or {}).get("winner") or {}
+        return bool(win.get("slab"))
+
     def _use_bucket(self) -> None:
         from ..ops.bucket_spmm import (build_sharded_bucket_tables,
                                        validate_bucket_tables)
 
         merge = int(getattr(self.cfg, "bucket_merge", 0))
-        kind = "bucket" + (f"_m{merge}" if merge else "")
+        slab_on = self._slab_flag()
+        kind = ("bucket" + (f"_m{merge}" if merge else "")
+                + ("_slab" if slab_on else ""))
         self._bucket_tables = self._cached_tables(
             kind, lambda: build_sharded_bucket_tables(
-                self.sg, min_width=merge))
+                self.sg, min_width=merge, slab=slab_on))
         # the kernel's clip-mode gathers are sound only for
         # in-bounds tables; a rotted cache must fail HERE, loudly,
         # not clamp to wrong rows mid-epoch
@@ -380,13 +426,15 @@ class Trainer:
         tile = self.cfg.block_tile
         nnz = self.cfg.block_nnz
         grp = self.cfg.block_group
+        slab_on = self._slab_flag()
         key = (f"block_{tile}_{w_hint}" + (f"_n{nnz}" if nnz else "")
-               + (f"_u{grp}" if grp > 1 else ""))
+               + (f"_u{grp}" if grp > 1 else "")
+               + ("_slab" if slab_on else ""))
         self._block_tables = self._cached_tables(
             key,
             lambda: build_sharded_block_tables(
                 self.sg, tile=tile, n_feat_hint=w_hint,
-                nnz_threshold=nnz, group=grp)[0])
+                nnz_threshold=nnz, group=grp, slab=slab_on)[0])
         self._block_tile = tile
 
     def _resolve_auto(self) -> str:
@@ -413,7 +461,9 @@ class Trainer:
             chunk_edges=cfg.spmm_chunk,
             rng_impl=getattr(self.tcfg, "rng_impl", "threefry"),
             halo_dtype=getattr(self.tcfg, "halo_dtype", "none"),
-            epoch_block=int(getattr(self.tcfg, "epoch_block", 0)))
+            epoch_block=int(getattr(self.tcfg, "epoch_block", 0)),
+            reorder=str(getattr(self.sg, "reorder", "none")),
+            layout_version=int(getattr(self.sg, "layout_version", 1)))
         cd = getattr(self.sg, "cache_dir", None)
         rec, reason = None, "no artifact directory (in-memory graph)"
         if cd:
@@ -439,6 +489,7 @@ class Trainer:
                     rng_impl=getattr(self.tcfg, "rng_impl", "threefry"),
                     halo_dtype=getattr(self.tcfg, "halo_dtype", "none"),
                     epoch_block=int(getattr(self.tcfg, "epoch_block", 0)),
+                    slab=str(getattr(cfg, "slab", "auto")),
                     edge_budget=int(getattr(
                         cfg, "tuner_samples",
                         tuner.DEFAULT_EDGE_BUDGET)))
@@ -460,7 +511,7 @@ class Trainer:
                 rec = {"winner": {"name": tuner.DEFAULT_IMPL,
                                   "impl": tuner.DEFAULT_IMPL,
                                   "rem_dtype": None, "rem_amax": False,
-                                  "block_group": 1},
+                                  "block_group": 1, "slab": False},
                        "costs": []}
         win = dict(rec["winner"])
         self.tuning = {
@@ -468,6 +519,7 @@ class Trainer:
             "source": source,
             "stale_reason": None if source == "artifact" else reason,
             "costs": rec.get("costs", []),
+            "gather_contiguity": rec.get("gather_contiguity"),
             "emitted": False,
         }
         # fill tuner-chosen transport/group defaults — never override
@@ -523,7 +575,7 @@ class Trainer:
         sg = self.sg
         edge_dummy = np.zeros((self.P, 8), np.int32)
         arrs = {
-            "feat": sg.feat,
+            "feat": _pad_cols(sg.feat, self._feat_pad),
             "label": sg.label,
             "train_mask": sg.train_mask,
             "in_deg": sg.in_deg,
@@ -1089,6 +1141,17 @@ class Trainer:
             return "gat-bucket"
         return "xla"
 
+    def _slab_active(self) -> bool:
+        """True when the current kernel tables carry slab-gather run
+        plans (bkt_*res_/blkrem_*res_ keys) — the fallback ladder then
+        has an extra rung ABOVE the impl downgrade: same kernel, slab
+        plans stripped (cfg.slab='off'), so a dynamic_slice-path crash
+        does not cost the whole bucket/block kernel."""
+        for t in (self._bucket_tables, self._block_tables):
+            if t is not None and any("res_" in k for k in t):
+                return True
+        return False
+
     def downgrade_kernel(self, to_impl: str, reason: str) -> dict:
         """Rebuild the trainer one rung down the kernel fallback ladder
         (resilience/numerics.fallback_ladder): swap the kernel tables on
@@ -1104,7 +1167,7 @@ class Trainer:
                                              spmm_impl=to_impl)
         self._setup_spmm()
         keep = {k: v for k, v in self.data.items()
-                if not k.startswith(("bkt_", "blk_", "gat_"))}
+                if not k.startswith(("bkt_", "blk_", "blkrem_", "gat_"))}
         tables_active = False
         for t in (self._bucket_tables,
                   self._block_tables, self._gat_tables):
@@ -1150,7 +1213,8 @@ class Trainer:
         inject = self._inject_kernel_crash
         armed = ((not self._kernel_proven or inject)
                  and jax.process_count() == 1
-                 and (inject or fallback_ladder(self._current_impl())))
+                 and (inject or fallback_ladder(self._current_impl())
+                      or self._slab_active()))
         if not armed:
             # multi-process / ladder-exhausted: the injection flag must
             # not survive to poison an unrelated later dispatch
@@ -1174,6 +1238,17 @@ class Trainer:
                     if not is_kernel_error(exc):
                         raise
                     err = exc
+            if self._slab_active():
+                # first rung: same kernel, slab plans stripped — the
+                # streaming dynamic_slice path is the newest code and
+                # the cheapest thing to give up
+                self.cfg = dataclasses.replace(self.cfg, slab="off")
+                self._eval_cfg = dataclasses.replace(self._eval_cfg,
+                                                     slab="off")
+                self.downgrade_kernel(self._current_impl(),
+                                      "slab-off: " + repr(err)[:280])
+                self.restore_state(snap)
+                continue
             rungs = fallback_ladder(self._current_impl())
             if not rungs:
                 raise KernelFallbackError(
@@ -2505,7 +2580,10 @@ class Trainer:
             order = stable_argsort(g.dst)
             self._eval_cache[key] = {
                 "graph": g,  # strong ref: keeps id(g) valid while cached
-                "feat": jnp.asarray(g.ndata["feat"]),
+                # lane_pad trainers rewrote layer_sizes[0]; eval input
+                # must be padded to the same width
+                "feat": jnp.asarray(_pad_cols(
+                    g.ndata["feat"], getattr(self, "_feat_pad", 0))),
                 "label": g.ndata["label"],
                 "edge_src": jnp.asarray(g.src[order].astype(np.int32)),
                 "edge_dst": jnp.asarray(g.dst[order].astype(np.int32)),

@@ -149,6 +149,17 @@ class ShardedGraph:
     # -1 in artifacts saved before the field existed
     source_edge_checksum: int = -1
 
+    # locality reorder layout (partitioner.REORDER_MODES): which node
+    # renumbering this artifact's local ids follow. Pre-reorder
+    # artifacts default to "none"/layout v1 on load; new builds stamp
+    # LAYOUT_VERSION. reorder_perm[p, l] is the local id node (p, l)
+    # would have under reorder="none" (the base layout), reorder_inv
+    # its inverse; -1 on padding rows, None when reorder == "none".
+    reorder: str = "none"
+    layout_version: int = 1
+    reorder_perm: Optional[np.ndarray] = None
+    reorder_inv: Optional[np.ndarray] = None
+
     # set by load(): the artifact directory, which doubles as the cache
     # location for derived per-device kernel tables (bucket/block) so
     # repeat runs skip their O(E) host builds. Not serialized.
@@ -275,12 +286,52 @@ class ShardedGraph:
 
     # ------------------------------------------------------------------
     @staticmethod
+    def _local_ids(n: int, train_mask: np.ndarray, parts: np.ndarray,
+                   num_parts: int, cluster: Optional[np.ndarray],
+                   rkey: Optional[np.ndarray]):
+        """Local-id assignment: sort nodes by (part, ~is_train
+        [, reorder key][, cluster], global id) into contiguous per-part
+        train-first blocks. Returns (local_id, part_sizes)."""
+        keys = [np.arange(n)]
+        if cluster is not None:
+            keys.append(cluster.astype(np.int64))
+        if rkey is not None:
+            keys.append(np.asarray(rkey, dtype=np.int64))
+        keys += [~train_mask, parts]
+        order = np.lexsort(tuple(keys))
+        part_sizes = np.bincount(parts, minlength=num_parts)
+        part_starts = np.zeros(num_parts + 1, dtype=np.int64)
+        np.cumsum(part_sizes, out=part_starts[1:])
+        local_id = np.empty(n, dtype=np.int64)
+        local_id[order] = np.arange(n) - part_starts[parts[order]]
+        return local_id, part_sizes
+
+    @staticmethod
+    def _reorder_arrays(g: Graph, reorder: str, train_mask, parts,
+                        num_parts, cluster, local_id, n_max):
+        """(rkey-resolved reorder tag, perm, inv) for a build. The perm
+        maps the reordered layout back to the base (reorder='none')
+        layout so external consumers can translate local ids either
+        way; both are [P, n_max] int32, -1 on padding rows."""
+        if reorder in (None, "none"):
+            return "none", None, None
+        base_lid, _ = ShardedGraph._local_ids(
+            g.num_nodes, train_mask, parts, num_parts, cluster, None)
+        perm = np.full((num_parts, n_max), -1, np.int32)
+        inv = np.full((num_parts, n_max), -1, np.int32)
+        perm[parts, local_id] = base_lid.astype(np.int32)
+        inv[parts, base_lid] = local_id.astype(np.int32)
+        return reorder, perm, inv
+
+    @staticmethod
     def build(
         g: Graph,
         parts: np.ndarray,
         n_parts: Optional[int] = None,
         pad_to: int = 8,
         cluster: Optional[np.ndarray] = None,
+        reorder: str = "none",
+        reorder_seed: int = 0,
     ) -> "ShardedGraph":
         """Build the sharded layout from a graph and a partition assignment.
 
@@ -297,6 +348,15 @@ class ShardedGraph:
         ops/block_spmm.py exploits). Purely an ordering choice — every
         layout invariant (train-first, CSR edges, send lists) holds for
         any consistent order.
+
+        `reorder` (partitioner.REORDER_MODES) adds the locality
+        renumbering key BELOW the train segment and ABOVE the cluster
+        key: within each partition's train/non-train segments inner
+        nodes follow degree-bucket-major, BFS-locality-minor order so
+        the SpMM gather index streams collapse into contiguous runs
+        (ops/bucket_spmm slab plans). The base-layout permutation and
+        its inverse are stored on the result (reorder_perm/reorder_inv)
+        and ride the artifact.
         """
         n = g.num_nodes
         parts = parts.astype(np.int32)
@@ -309,17 +369,11 @@ class ShardedGraph:
         train_mask = g.ndata["train_mask"]
 
         # ---- local ids: train-first within each partition ------------
-        # sort nodes by (part, ~is_train[, cluster], global id) ->
-        # contiguous blocks
-        sort_keys = [np.arange(n), ~train_mask, parts]
-        if cluster is not None:
-            sort_keys.insert(1, cluster.astype(np.int64))
-        order = np.lexsort(tuple(sort_keys))
-        part_sizes = np.bincount(parts, minlength=num_parts)
-        part_starts = np.zeros(num_parts + 1, dtype=np.int64)
-        np.cumsum(part_sizes, out=part_starts[1:])
-        local_id = np.empty(n, dtype=np.int64)
-        local_id[order] = np.arange(n) - part_starts[parts[order]]
+        from .partitioner import reorder_key
+
+        rkey = reorder_key(g, reorder, seed=reorder_seed)
+        local_id, part_sizes = ShardedGraph._local_ids(
+            n, train_mask, parts, num_parts, cluster, rkey)
 
         inner_count = part_sizes.astype(np.int32)
         train_count = np.bincount(
@@ -365,17 +419,21 @@ class ShardedGraph:
         edge_src[edge_owner[e_order], pos_in_dev] = src_local_all[e_order]
         edge_dst[edge_owner[e_order], pos_in_dev] = dst_local_all[e_order]
 
+        reo = ShardedGraph._reorder_arrays(
+            g, reorder, train_mask, parts, num_parts, cluster,
+            local_id, n_max)
         return ShardedGraph._assemble(
             g, parts, local_id, num_parts, n_max, b_max, e_max,
             e_sizes, inner_count, train_count, send_counts,
-            edge_src, edge_dst, send_idx, send_mask,
+            edge_src, edge_dst, send_idx, send_mask, reorder=reo,
         )
 
     @staticmethod
     def _assemble(g, parts, local_id, num_parts, n_max, b_max, e_max,
                   e_sizes, inner_count, train_count, send_counts,
                   edge_src, edge_dst, send_idx, send_mask,
-                  node_chunk: Optional[int] = None) -> "ShardedGraph":
+                  node_chunk: Optional[int] = None,
+                  reorder=("none", None, None)) -> "ShardedGraph":
         """Per-device node-data scatter + dataclass construction — shared
         tail of build() and build_chunked(). `node_chunk` streams the
         feature scatter in row slices so a memmapped g.ndata['feat'] is
@@ -455,6 +513,13 @@ class ShardedGraph:
             in_deg=in_deg,
             global_nid=gnid,
             source_edge_checksum=ShardedGraph.edge_checksum(g),
+            reorder=reorder[0],
+            # reorder="none" IS the v1 layout bit-for-bit: keep version 1
+            # so existing tuning tables stay signature-valid for it
+            layout_version=(ShardedGraph.LAYOUT_VERSION
+                            if reorder[0] != "none" else 1),
+            reorder_perm=reorder[1],
+            reorder_inv=reorder[2],
         )
 
     # ------------------------------------------------------------------
@@ -465,6 +530,8 @@ class ShardedGraph:
         n_parts: Optional[int] = None,
         pad_to: int = 8,
         cluster: Optional[np.ndarray] = None,
+        reorder: str = "none",
+        reorder_seed: int = 0,
         edge_chunk: int = _EDGE_CHUNK,
         node_chunk: int = 1 << 20,
     ) -> "ShardedGraph":
@@ -495,15 +562,11 @@ class ShardedGraph:
         train_mask = np.asarray(g.ndata["train_mask"])
 
         # ---- local ids (O(N), same as build) --------------------------
-        sort_keys = [np.arange(n), ~train_mask, parts]
-        if cluster is not None:
-            sort_keys.insert(1, cluster.astype(np.int64))
-        order = np.lexsort(tuple(sort_keys))
-        part_sizes = np.bincount(parts, minlength=num_parts)
-        part_starts = np.zeros(num_parts + 1, dtype=np.int64)
-        np.cumsum(part_sizes, out=part_starts[1:])
-        local_id = np.empty(n, dtype=np.int64)
-        local_id[order] = np.arange(n) - part_starts[parts[order]]
+        from .partitioner import reorder_key
+
+        rkey = reorder_key(g, reorder, seed=reorder_seed)
+        local_id, part_sizes = ShardedGraph._local_ids(
+            n, train_mask, parts, num_parts, cluster, rkey)
         inner_count = part_sizes.astype(np.int32)
         train_count = np.bincount(
             parts[train_mask], minlength=num_parts
@@ -560,11 +623,14 @@ class ShardedGraph:
             edge_src[r, :e_r] = edge_src[r, :e_r][o]
             edge_dst[r, :e_r] = edge_dst[r, :e_r][o]
 
+        reo = ShardedGraph._reorder_arrays(
+            g, reorder, train_mask, parts, num_parts, cluster,
+            local_id, n_max)
         return ShardedGraph._assemble(
             g, parts, local_id, num_parts, n_max, b_max, e_max,
             e_sizes, inner_count, train_count, send_counts,
             edge_src, edge_dst, ss["send_idx"], ss["send_mask"],
-            node_chunk=node_chunk,
+            node_chunk=node_chunk, reorder=reo,
         )
 
     # ------------------------------------------------------------------
@@ -586,6 +652,13 @@ class ShardedGraph:
     # per-part files, helper/utils.py:132-144)
     FORMAT_VERSION = 2
     MMAP_FORMAT_VERSION = 3
+
+    # layout contract version (orthogonal to the storage format above):
+    # v1 = pre-reorder local-id contract; v2 = reorder-aware — the
+    # manifest carries the reorder tag and, when reorder != "none", the
+    # permutation arrays. v1 artifacts load as reorder="none".
+    LAYOUT_VERSION = 2
+    _REORDER_ARRAYS = ["reorder_perm", "reorder_inv"]
 
     def save(self, path: str, mmap: bool = False,
              trim_edges: bool = False) -> None:
@@ -613,16 +686,23 @@ class ShardedGraph:
             "n_class": self.n_class,
             "multilabel": self.multilabel,
             "source_edge_checksum": self.source_edge_checksum,
+            "reorder": self.reorder,
+            "layout_version": self.layout_version,
         }
         if trim_edges:
             manifest["trimmed_edges"] = True
+        # the permutation arrays exist only on reordered layouts, so
+        # they are saved conditionally — pre-reorder readers of the
+        # fixed _ARRAYS list stay compatible either way
+        extra = [k for k in self._REORDER_ARRAYS
+                 if getattr(self, k) is not None]
         # arrays first, manifest last: exists() keys off the manifest, so
         # a reader polling a shared filesystem (multi-host prepare) never
         # observes a half-written artifact
         if mmap:
             adir = os.path.join(path, "arrays")
             os.makedirs(adir, exist_ok=True)
-            for k in self._ARRAYS:
+            for k in self._ARRAYS + extra:
                 if trim_edges and k in ("edge_src", "edge_dst"):
                     arr = getattr(self, k)
                     for r in range(self.num_parts):
@@ -634,7 +714,7 @@ class ShardedGraph:
         else:
             np.savez_compressed(
                 os.path.join(path, "arrays.npz"),
-                **{k: getattr(self, k) for k in self._ARRAYS},
+                **{k: getattr(self, k) for k in self._ARRAYS + extra},
             )
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=2)
@@ -655,7 +735,14 @@ class ShardedGraph:
                     continue
                 arrays[k] = np.load(os.path.join(adir, f"{k}.npy"),
                                     mmap_mode="r")
-            return ShardedGraph(**manifest, cache_dir=path, **arrays)
+            for k in ShardedGraph._REORDER_ARRAYS:
+                p = os.path.join(adir, f"{k}.npy")
+                if os.path.exists(p):
+                    arrays[k] = np.load(p, mmap_mode="r")
+            sg = ShardedGraph(**manifest, cache_dir=path, **arrays)
+            if sg.reorder != "none":
+                sg.validate_layout()
+            return sg
         if version != ShardedGraph.FORMAT_VERSION:
             raise ValueError(
                 f"partition artifact at {path} has format v{version}, "
@@ -664,8 +751,72 @@ class ShardedGraph:
                 f"(delete the directory or drop --skip-partition)"
             )
         arrays = np.load(os.path.join(path, "arrays.npz"))
-        return ShardedGraph(**manifest, cache_dir=path,
-                            **{k: arrays[k] for k in ShardedGraph._ARRAYS})
+        keys = ShardedGraph._ARRAYS + [k for k in
+                                       ShardedGraph._REORDER_ARRAYS
+                                       if k in arrays.files]
+        sg = ShardedGraph(**manifest, cache_dir=path,
+                          **{k: arrays[k] for k in keys})
+        if sg.reorder != "none":
+            sg.validate_layout()
+        return sg
+
+    def validate_layout(self) -> None:
+        """Loud host-side boundary-slot / permutation validation (the
+        same contract as ops.bucket_spmm.validate_bucket_tables): every
+        send-list entry must name a real inner node of its sender, and
+        a reordered layout's permutation arrays must be present and
+        mutually inverse per rank. Raises a named ValueError on the
+        first violated invariant — a silent mismatch here becomes
+        garbage halo rows (wrong features exchanged), not a crash."""
+        P = self.num_parts
+        for r in range(P):
+            ic = int(self.inner_count[r])
+            for d in range(P - 1):
+                c = int(self.send_counts[r, d])
+                if not c:
+                    continue
+                idx = np.asarray(self.send_idx[r, d, :c])
+                if idx.min() < 0 or idx.max() >= ic:
+                    raise ValueError(
+                        f"boundary-slot validation: send_idx[r={r}, "
+                        f"dist={d + 1}] references local id "
+                        f"{int(idx.min())}..{int(idx.max())} outside "
+                        f"[0, {ic}) — send lists and node layout "
+                        f"disagree (stale or mismatched reorder "
+                        f"permutation?)")
+        has_perm = self.reorder_perm is not None
+        if (self.reorder != "none") != has_perm or \
+                has_perm == (self.reorder_inv is None):
+            raise ValueError(
+                f"boundary-slot validation: reorder tag "
+                f"{self.reorder!r} but permutation arrays "
+                f"{'present' if has_perm else 'absent'} — layout "
+                f"metadata is inconsistent (rebuild the artifact)")
+        if not has_perm:
+            return
+        perm = np.asarray(self.reorder_perm)
+        inv = np.asarray(self.reorder_inv)
+        want = (P, self.n_max)
+        if perm.shape != want or inv.shape != want:
+            raise ValueError(
+                f"boundary-slot validation: reorder permutation shape "
+                f"{perm.shape}/{inv.shape} != {want} — permutation/"
+                f"table mismatch (artifact built for another layout?)")
+        ar = np.arange(self.n_max)
+        for r in range(P):
+            ic = int(self.inner_count[r])
+            p_r, i_r = perm[r, :ic], inv[r, :ic]
+            if not (np.array_equal(np.sort(p_r), ar[:ic])
+                    and np.array_equal(i_r[p_r], ar[:ic])):
+                raise ValueError(
+                    f"boundary-slot validation: reorder_perm/"
+                    f"reorder_inv of rank {r} are not mutually inverse "
+                    f"permutations of [0, {ic}) — permutation/table "
+                    f"mismatch")
+            if ic < self.n_max and not (perm[r, ic:] == -1).all():
+                raise ValueError(
+                    f"boundary-slot validation: reorder_perm rank {r} "
+                    f"padding rows not -1 — permutation/table mismatch")
 
     @staticmethod
     def exists(path: str) -> bool:

@@ -178,6 +178,70 @@ def locality_clusters(
 _CHUNKED_ADJ_EDGES = 50_000_000
 
 
+# locality reorder modes for ShardedGraph local renumbering. "auto" is
+# resolved by measurement (ops/tuner.choose_reorder), never stored: an
+# artifact's layout tag is always one of these concrete modes.
+REORDER_MODES = ("none", "degree", "bfs", "degree-bfs")
+
+
+def reorder_suffix(mode: str) -> str:
+    """Artifact-name fragment identifying the reorder layout. 'none'
+    maps to '' so pre-reorder artifact names stay valid cache keys."""
+    if mode not in REORDER_MODES:
+        raise ValueError(f"unknown reorder mode: {mode!r} "
+                         f"(expected one of {REORDER_MODES})")
+    return "" if mode == "none" else f"-r{mode}"
+
+
+def reorder_key(g: Graph, mode: str, seed: int = 0):
+    """Per-node int64 sort key realizing the locality reordering.
+
+    ShardedGraph.build inserts this key into its local-id lexsort below
+    the (partition, train-segment) keys, so within each partition's
+    train and non-train segments inner nodes are renumbered:
+
+      'degree'     — degree-bucket-major (power-of-two in-degree
+                     buckets, hubs first), global-id-minor;
+      'bfs'        — BFS-locality order (graph neighbors get nearby
+                     local ids, so neighbor-gather index streams of the
+                     SpMM kernels collapse into contiguous runs);
+      'degree-bfs' — degree-bucket-major, BFS-locality-minor: bucket
+                     structure aligned with ops/bucket_spmm's ladder
+                     AND run-friendly gather streams inside each bucket.
+
+    Returns None for 'none' (layout unchanged). The key is a pure
+    ordering choice — ShardedGraph permutes features/labels/masks/CSR/
+    send-lists coherently, so training semantics are untouched.
+    """
+    if mode in (None, "none"):
+        return None
+    if mode not in REORDER_MODES:
+        raise ValueError(f"unknown reorder mode: {mode!r} "
+                         f"(expected one of {REORDER_MODES})")
+    n = g.num_nodes
+    minor = np.arange(n, dtype=np.int64)
+    if mode in ("bfs", "degree-bfs"):
+        rng = np.random.default_rng(seed)
+        if g.num_edges > _CHUNKED_ADJ_EDGES:
+            indptr, indices = _csr_adjacency_chunked(g)
+            adj = sp.csr_matrix(
+                (np.ones(indices.shape[0], np.int8), indices, indptr),
+                shape=(n, n))
+        else:
+            adj = _sym_adj(g)
+        order = _bfs_order(adj, rng)
+        minor = np.empty(n, dtype=np.int64)
+        minor[order] = np.arange(n, dtype=np.int64)
+    if mode == "bfs":
+        return minor
+    # hubs first: the highest-degree rows are gathered most often, so
+    # packing them into the lowest local ids concentrates the hot
+    # working set into one compact, streamable id range
+    deg = g.in_degrees().astype(np.int64)
+    bucket = np.floor(np.log2(np.maximum(deg, 1))).astype(np.int64)
+    return (int(bucket.max()) - bucket) * n + minor
+
+
 def _csr_adjacency_chunked(g: Graph, symmetric: bool = False,
                            chunk: int = 32_000_000):
     """Self-loop-free CSR adjacency (indptr int64, indices int32) built

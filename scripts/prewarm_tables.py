@@ -43,6 +43,12 @@ def main():
                          "tuning.json first and force a fresh "
                          "micro-bench campaign")
     ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--reorder", default="none",
+                    choices=["none", "degree", "bfs", "degree-bfs"],
+                    help="prewarm the locality-REORDERED layout of "
+                         "--part instead (suffix -r<mode>); the O(E) "
+                         "artifact build happens here, host-side, so "
+                         "tpu_window's reorder_slab preflight passes")
     args = ap.parse_args()
 
     from pipegcn_tpu.models import ModelConfig
@@ -54,6 +60,11 @@ def main():
 
     if not os.path.isabs(args.part):
         args.part = os.path.join(REPO, args.part)
+    if args.reorder != "none" and not args.part.endswith(
+            f"-r{args.reorder}"):
+        from pipegcn_tpu.partition.partitioner import reorder_suffix
+
+        args.part += reorder_suffix(args.reorder)
     sg = ensure(args.part, log=lambda m: print(m, file=sys.stderr))
     if args.retune and args.impl == "auto":
         from pipegcn_tpu.ops import tuner

@@ -39,6 +39,10 @@ LOG_DIR = os.path.join(REPO, "results", "tpu_window")
 # the bench-artifact the Reddit-shape probes all assume (built by
 # scripts/build_bench_artifact.py or any prior bench run)
 _BENCH_PART = "partitions/bench-reddit-1-c2-s1024"
+# its degree-bfs-reordered twin (same graph, locality-aware node
+# order): scripts/prewarm_tables.py --reorder degree-bfs builds it
+# host-side while the tunnel is down
+_BENCH_PART_R = "partitions/bench-reddit-1-c2-s1024-rdegree-bfs"
 
 # (name, argv, timeout_s, requires) — priority order: most load-bearing
 # first (round-5 order: VERDICT r4 items 1-3 lead). bench.py
@@ -64,6 +68,17 @@ QUEUE = [
     ("floor_levers",
      [sys.executable, "bench.py", "--no-compare", "--force-candidate"],
      3600, [_BENCH_PART]),
+    # round-9: the reorder x slab layout levers measured before/after
+    # on chip — bench.py's reorder_slab pass times the same shape
+    # under none / degree-bfs / degree-bfs+slab and publishes
+    # reorder_delta_s / slab_delta_s in the BENCH json. Preflight
+    # demands the REORDERED artifact too: the degree-bfs layout is an
+    # O(E) host-side build that must never burn window minutes
+    # (prewarm_tables.py --reorder degree-bfs leaves it on disk).
+    ("reorder_slab",
+     [sys.executable, "bench.py", "--no-compare", "--reorder",
+      "degree-bfs"],
+     3600, [_BENCH_PART, _BENCH_PART_R]),
     # run the SpMM auto-tuner's micro-bench campaign ON CHIP and
     # persist tuning.json into the bench artifact: every later
     # spmm-impl=auto step in this queue (and future rounds reusing the
