@@ -333,6 +333,26 @@ def main():
     ap.add_argument("--force-candidate", action="store_true",
                     help=argparse.SUPPRESS)  # CPU test hook for the
     # candidate-config pass (normally TPU-gated)
+    ap.add_argument("--serve", action="store_true",
+                    help="measure the online serving runtime instead of "
+                         "training: open-loop load against the "
+                         "compiled-once engine; headline metric is "
+                         "sustained QPS with p50/p99 latency "
+                         "(docs/SERVING.md)")
+    ap.add_argument("--serve-secs", type=float, default=10.0,
+                    help="seconds of open-loop serve load")
+    ap.add_argument("--serve-qps", type=float, default=100.0,
+                    help="target query arrival rate for --serve")
+    ap.add_argument("--serve-max-batch", type=int, default=64,
+                    help="top of the serve padded batch ladder")
+    ap.add_argument("--serve-max-delay-ms", type=float, default=5.0,
+                    help="max queueing delay before a partial serve "
+                         "batch flushes")
+    ap.add_argument("--serve-update-every", type=float, default=0.5,
+                    help="seconds between synthetic feature-update "
+                         "churn batches under --serve (0 disables)")
+    ap.add_argument("--serve-refresh-every", type=float, default=0.5,
+                    help="seconds between serve logits recomputes")
     ap.add_argument(_STAGE_FLAG, type=int, default=0, dest="stage",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -453,7 +473,7 @@ def main():
             raise
         _reexec_degraded(args.stage, repr(exc)[:300])
         return
-    if result.get("loss") is None:
+    if result.get("loss") is None and not result.get("serve"):
         # the headline trained to a non-finite loss (the offshape-
         # products NaN class, VERDICT "Next round" item 1): the JSON
         # above is printed for diagnosis but the exit status must be
@@ -487,6 +507,10 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         slab=args.slab,
         lane_pad=args.lane_pad,
     )
+    if getattr(args, "serve", False):
+        return _measure_serve(args, backend, device_kind, n_parts,
+                              degraded, sg, cfg)
+
     blk = max(1, args.fused)
 
     def build_trainer(pipeline: bool) -> "Trainer":
@@ -1058,6 +1082,81 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                 ml.event("bench", **result)
         except OSError as exc:
             print(f"# metrics sink unavailable: {exc}", file=sys.stderr)
+    print(json.dumps(result))
+    return result
+
+
+def _measure_serve(args, backend, device_kind, n_parts, degraded, sg,
+                   cfg):
+    """bench.py --serve: sustained QPS + latency of the online serving
+    runtime under the open-loop load generator. The result carries
+    `serve: true` so main() knows there is no training loss to gate on."""
+    from pipegcn_tpu.parallel import Trainer, TrainConfig
+    from pipegcn_tpu.serve import ServingEngine, run_serving_loop
+
+    # serving measures the halo0-cache inference path with live feature
+    # churn: use_pp folds raw features trainer-side (and disables
+    # updates), so the serve leg runs without it; dropout is inert at
+    # inference either way
+    scfg = dataclasses.replace(cfg, use_pp=False, dropout=0.0)
+    t0 = time.perf_counter()
+    trainer = Trainer(sg, scfg, TrainConfig(
+        lr=0.01, n_epochs=0, enable_pipeline=False, seed=0, eval=False))
+    engine = ServingEngine.for_trainer(
+        trainer, max_batch=args.serve_max_batch)
+    warm_s = engine.warmup()
+    print(f"# serve setup {time.perf_counter()-t0:.1f}s "
+          f"(engine warm in {warm_s:.1f}s, ladder {engine.ladder})",
+          file=sys.stderr)
+
+    ml = None
+    if args.metrics_out:
+        from pipegcn_tpu.obs import MetricsLogger, device_info
+
+        try:
+            ml = MetricsLogger(args.metrics_out)
+            ml.run_header(config=vars(args), device=device_info(),
+                          mesh={"n_parts": n_parts})
+        except OSError as exc:
+            print(f"# metrics sink unavailable: {exc}", file=sys.stderr)
+            ml = None
+
+    summary = run_serving_loop(
+        engine, duration_s=args.serve_secs, qps=args.serve_qps,
+        max_delay_ms=args.serve_max_delay_ms,
+        update_every_s=args.serve_update_every,
+        refresh_every_s=args.serve_refresh_every,
+        seed=0, ml=ml)
+
+    rnd = lambda v, k=3: None if v is None else round(v, k)  # noqa: E731
+    result = {
+        "metric": "serve_qps",
+        "value": round(summary["qps"], 2),
+        "unit": "q/s",
+        "serve": True,
+        "backend": backend,
+        "device": device_kind,
+        "n_parts": n_parts,
+        "dtype": scfg.dtype,
+        "spmm_impl": args.spmm_impl,
+        "target_qps": args.serve_qps,
+        "n_queries": summary["n_queries"],
+        "duration_s": round(summary["duration_s"], 2),
+        "p50_ms": rnd(summary["p50_ms"]),
+        "p95_ms": rnd(summary["p95_ms"]),
+        "p99_ms": rnd(summary["p99_ms"]),
+        "batch_fill": rnd(summary["batch_fill"]),
+        "cache_hit_rate": rnd(summary["cache_hit_rate"]),
+        "staleness_age_max": summary["staleness_age_max"],
+        "warmup_s": round(warm_s, 2),
+    }
+    if degraded:
+        result["degraded"] = True
+    if ml is not None:
+        try:
+            ml.event("bench", **result)
+        finally:
+            ml.close()
     print(json.dumps(result))
     return result
 

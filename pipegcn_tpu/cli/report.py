@@ -252,6 +252,41 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                        if "spmm" in k)
             out["anatomy_non_spmm_share"] = round(
                 max(0.0, 1.0 - spmm), 4)
+
+    # ---- online serving windows (serve/, schema v5) ----
+    serving = [r for r in records if r.get("event") == "serving"]
+    if serving:
+        out["n_serving_records"] = len(serving)
+        qs = [r.get("queries") for r in serving]
+        qs = [q for q in qs if isinstance(q, int)]
+        total_q = sum(qs)
+        out["serving_queries"] = total_q
+        wins = [r.get("window_s") for r in serving]
+        total_w = sum(w for w in wins if isinstance(w, (int, float)))
+        if total_w > 0:
+            out["serving_qps"] = round(total_q / total_w, 2)
+        # query-weighted percentile means: an empty window (null
+        # percentiles) must not drag the latency picture
+        for key in ("p50_ms", "p95_ms", "p99_ms", "batch_fill",
+                    "cache_hit_rate"):
+            num = den = 0.0
+            for r in serving:
+                v, q = r.get(key), r.get("queries")
+                if isinstance(v, (int, float)) and isinstance(q, int) \
+                        and q > 0:
+                    num += v * q
+                    den += q
+            if den:
+                out[f"serving_{key}"] = round(num / den, 4)
+        ages = [r.get("staleness_age") for r in serving]
+        ages = [a for a in ages if isinstance(a, int)]
+        if ages:
+            out["serving_staleness_age_max"] = max(ages)
+        depths = [r.get("queue_depth") for r in serving]
+        depths = [d for d in depths if isinstance(d, int)]
+        if depths:
+            out["serving_queue_depth_max"] = max(depths)
+        out["serving_drained"] = any(r.get("final") for r in serving)
     return out
 
 
@@ -367,6 +402,25 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
     if s.get("kernel_fallbacks"):
         lines.append("  {:<26} {}".format(
             "kernel fallbacks", ", ".join(s["kernel_fallbacks"])))
+    # ---- online serving (docs/SERVING.md) ----
+    if s.get("n_serving_records"):
+        lines.append("  {:<26} {} windows, {} queries".format(
+            "serving", s["n_serving_records"],
+            s.get("serving_queries", 0)))
+        row("serving QPS", "serving_qps", "{:.2f} q/s")
+        if s.get("serving_p50_ms") is not None:
+            lines.append("  {:<26} p50 {:.2f} / p95 {:.2f} / p99 {:.2f} "
+                         "ms".format("serving latency",
+                                     s["serving_p50_ms"],
+                                     s.get("serving_p95_ms", 0.0),
+                                     s.get("serving_p99_ms", 0.0)))
+        row("serving batch fill", "serving_batch_fill", "{:.1%}")
+        row("serving cache hit rate", "serving_cache_hit_rate", "{:.1%}")
+        row("serving staleness (max)", "serving_staleness_age_max")
+        row("serving queue depth max", "serving_queue_depth_max")
+        if not s.get("serving_drained"):
+            lines.append(f"  {'!! serving shutdown':<26} no final "
+                         f"record — the run died without draining")
     row("best val", "best_val", "{:.4f}")
     row("best epoch", "best_epoch")
     row("test acc", "test_acc", "{:.4f}")

@@ -270,6 +270,37 @@ class MetricsLogger:
             **extra,
         })
 
+    def serving(self, window_s: float, queries: int, qps: float,
+                batch_fill: Optional[float], queue_depth: int,
+                p50_ms: Optional[float], p95_ms: Optional[float],
+                p99_ms: Optional[float],
+                cache_hit_rate: Optional[float], staleness_age: int,
+                **extra) -> Dict[str, Any]:
+        """One serving report window (serve/loadgen.run_serving_loop):
+        QPS, batch fill, queue depth, latency percentiles, cache hit
+        rate, and the max served staleness age. Hard-flushed — the
+        shutdown path's final record (extra ``final: true``) must
+        survive a SIGTERM'd load generator (scripts/chaos.sh serving
+        lane asserts exactly this)."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "serving",
+            "window_s": float(window_s),
+            "queries": int(queries),
+            "qps": float(qps),
+            "batch_fill": None if batch_fill is None else float(batch_fill),
+            "queue_depth": int(queue_depth),
+            "p50_ms": None if p50_ms is None else float(p50_ms),
+            "p95_ms": None if p95_ms is None else float(p95_ms),
+            "p99_ms": None if p99_ms is None else float(p99_ms),
+            "cache_hit_rate": (None if cache_hit_rate is None
+                               else float(cache_hit_rate)),
+            "staleness_age": int(staleness_age),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""

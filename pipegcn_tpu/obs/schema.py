@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 4  # v4: tuning record kind (SpMM auto-tuner decision)
+SCHEMA_VERSION = 5  # v5: serving record kind (online serving runtime)
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -168,6 +168,29 @@ TUNING_FIELDS: Dict[str, str] = {
     "costs": "array",              # measured per-candidate cost table
 }
 
+# one record per serving report window (serve/loadgen.run_serving_loop,
+# default every --serve-report-every seconds, plus one final record on
+# shutdown carrying the extra field `final: true`): the online-serving
+# health tuple. Latency percentiles are per-query wall times measured
+# submit -> batch-flush-complete (null in a window that served nothing);
+# batch_fill is mean served-rows / padded-bucket-rows over the window's
+# flushed batches; staleness_age is the max bounded-staleness age (in
+# applied update batches) any query in the window was served at — 0
+# means every answer reflected every accepted update (docs/SERVING.md).
+SERVING_FIELDS: Dict[str, str] = {
+    "event": "string",             # "serving"
+    "window_s": "number",          # report window wall-clock length
+    "queries": "integer",          # queries answered this window
+    "qps": "number",               # queries / window_s
+    "batch_fill": "number?",       # mean batch fill ratio in (0, 1]
+    "queue_depth": "integer",      # queued rows at snapshot time
+    "p50_ms": "number?",           # per-query latency percentiles
+    "p95_ms": "number?",
+    "p99_ms": "number?",
+    "cache_hit_rate": "number?",   # fully-fresh served fraction
+    "staleness_age": "integer",    # max served staleness (update batches)
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -181,6 +204,7 @@ _BY_EVENT = {
     "numerics": NUMERICS_FIELDS,
     "fallback": FALLBACK_FIELDS,
     "tuning": TUNING_FIELDS,
+    "serving": SERVING_FIELDS,
 }
 
 _JSON_TYPES = {

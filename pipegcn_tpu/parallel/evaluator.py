@@ -35,6 +35,25 @@ from .halo import halo_exchange
 from .mesh import PARTS_AXIS
 
 
+# bumped at TRACE time inside eval_fn: a delta of zero across repeated
+# evaluator constructions proves the cached program was reused instead
+# of recompiled (tests/test_eval.py pins this)
+EVAL_TRACE_COUNT = 0
+
+
+def _program_key(sg, dev_data, use_tables: bool, multilabel: bool):
+    """Cache key for the compiled sharded-eval program: everything the
+    traced computation depends on besides the trainer-fixed cfg/mesh —
+    graph shapes, the data pytree signature (keys + shapes + dtypes,
+    which also encodes the kernel impl via its table arrays), and the
+    metric flavor."""
+    return (
+        sg.n_max, sg.halo_size, bool(use_tables), bool(multilabel),
+        tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                     for k, v in dev_data.items())),
+    )
+
+
 def _covers_exactly(sg, g: Graph) -> bool:
     """True iff the training partitions were built from exactly graph
     `g` (the transductive case: the trainer's sharded data IS the eval
@@ -79,7 +98,20 @@ class ShardedEvaluator:
         multilabel = sg.multilabel
         self.multilabel = multilabel
 
+        # the compiled program is shared across evaluator instances
+        # through the trainer: repeated eval of same-signature graphs
+        # (convergence-study legs, serving warmup, foreign val/test
+        # graphs of one shape) pays compile once, not per construction
+        prog_key = _program_key(sg, self._dev_data, use_tables,
+                                multilabel)
+        cached = getattr(trainer, "_eval_program_cache", None)
+        if cached is not None and prog_key in cached:
+            self._run = cached[prog_key]
+            return
+
         def eval_fn(params, norm, data_in, mask):
+            global EVAL_TRACE_COUNT
+            EVAL_TRACE_COUNT += 1
             d = {k: v[0] for k, v in data_in.items()}
             label, mask = d["label"], mask[0]
 
@@ -147,6 +179,8 @@ class ShardedEvaluator:
             in_specs=(params_spec, norm_spec, data_spec, spec),
             out_specs=repl,
         ))
+        if cached is not None:
+            cached[prog_key] = self._run
 
     # ------------------------------------------------------------------
     @staticmethod

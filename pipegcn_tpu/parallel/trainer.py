@@ -256,6 +256,12 @@ class Trainer:
         self._step = self._build_step()
         self._eval_cache: Dict[int, Any] = {}
         self._sharded_eval_cache: Dict[int, Any] = {}
+        # compiled sharded-eval programs keyed on (shape, dtype, impl) —
+        # ShardedEvaluator instances come and go (one per eval graph id)
+        # but their jitted forward is identical whenever the data
+        # signature matches, so the program outlives the evaluator
+        # (compile-count pinned in tests/test_eval.py)
+        self._eval_program_cache: Dict[Any, Any] = {}
 
         @partial(jax.jit, static_argnames=("n",))
         def _eval_run(params, norm, feat, es, ed, deg, n):

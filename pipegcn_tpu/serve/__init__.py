@@ -1,0 +1,13 @@
+"""Online serving runtime (docs/SERVING.md).
+
+Compiled-once sharded inference over the partitioned graph, with
+micro-batched queries, incremental halo freshness, and schema-v5
+`serving` observability. Entry point: `python -m pipegcn_tpu.cli.serve`.
+"""
+
+from .batcher import (MicroBatcher, ServingStats, Ticket,  # noqa: F401
+                      bucket_for, bucket_ladder)
+from .cache import Layer0Cache  # noqa: F401
+from .engine import ServingEngine, TRACE_COUNTS, trace_counts  # noqa: F401
+from .freshness import FreshnessTracker, dirty_exchange_blocks  # noqa: F401
+from .loadgen import OpenLoopGenerator, run_serving_loop  # noqa: F401

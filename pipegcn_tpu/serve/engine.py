@@ -1,0 +1,392 @@
+"""Compiled-once sharded inference engine.
+
+The serving counterpart of `parallel/evaluator.py`: one donated-buffer,
+shard_map'd forward program over the partitioned graph — no dropout, no
+grads, no metric reduce — plus four tiny companion programs (full halo
+exchange, incremental dirty-row exchange, in-place feature patch, and
+the replicated query gather). All five are built ONCE per engine and
+traced once per input shape; the batcher's power-of-two ladder keeps
+the shape population finite, so after `warmup()` steady-state traffic
+never recompiles (pinned by the TRACE_COUNTS test in test_serve.py).
+
+Serving inherits every training-side kernel win by construction: the
+forward program aggregates through `trainer.make_device_spmm_closure`
+(the tuner's measured kernel choice over the PR-9 slab/reorder layout)
+and exchanges boundaries through the same send-lists as training.
+
+State owned by the engine (per device, sharded over PARTS_AXIS):
+  _feat   [P, n_max, F]      mutable feature shard (donated on patch)
+  _halo0  [P, (P-1)*B, F]    layer-0 halo cache in the SEND VIEW —
+                             compute dtype, GCN degree pre-scale
+                             applied — exactly the buffer forward()
+                             would exchange at layer 0
+  _logits [P, n_max, C]      f32 logits of every owned node
+
+Staleness ledger (docs/SERVING.md): `staleness_age` counts applied
+update batches whose effects the served logits do not yet reflect.
+apply_updates bumps it; refresh() collapses it to the halo lag (logits
+now see current features, boundary as-of the halo cache);
+refresh_boundary() zeroes the halo lag. age == 0 ⇔ fully fresh ⇔ a
+cache hit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..models.sage import forward
+from ..obs.trace import named_phase
+from ..parallel.halo import exchange_blocks, halo_exchange
+from ..parallel.mesh import PARTS_AXIS
+from ..parallel.trainer import _pad_cols
+from .batcher import MicroBatcher, ServingStats, bucket_for, bucket_ladder
+from .cache import Layer0Cache
+from .freshness import FreshnessTracker, dirty_exchange_blocks
+
+# Incremented at TRACE time inside each program body: a jit cache hit
+# leaves them untouched, so the delta across a traffic window counts
+# recompiles exactly. The no-recompile acceptance test pins these.
+TRACE_COUNTS: Dict[str, int] = {
+    "exchange": 0, "inc": 0, "refresh": 0, "patch": 0, "query": 0,
+}
+
+
+def trace_counts() -> Dict[str, int]:
+    return dict(TRACE_COUNTS)
+
+
+# data keys the inference program must NOT close over as static input:
+# feat is the mutable serving carry, the rest are training-only
+_NON_STATIC = ("feat", "label", "train_mask", "val_mask", "test_mask",
+               "row_mask")
+
+
+class ServingEngine:
+    """Persistent sharded inference over one Trainer's mesh + artifact.
+
+    Built once per (trainer, batch-shape-ladder); `for_trainer` caches
+    instances so repeated construction (bench legs, warm restarts in
+    the same process) reuses the compiled programs."""
+
+    def __init__(self, trainer, *, max_batch: int = 64,
+                 ladder_min: int = 8, max_update_rows: int = 256):
+        if trainer.emulated:
+            raise ValueError(
+                "serving requires a real device mesh; emulated trainers "
+                "stack partitions on one device and cannot serve")
+        self.trainer = trainer
+        sg = trainer.sg
+        self.sg = sg
+        self.cfg = trainer.cfg
+        self.P = trainer.P
+        self.n_max = sg.n_max
+        self.halo_size = sg.halo_size
+        self.n_class = sg.n_class
+        self.n_feat_raw = sg.n_feat
+        self.num_global_nodes = int((sg.global_nid >= 0).sum())
+        self.ladder = bucket_ladder(ladder_min, max_batch)
+        self.update_ladder = bucket_ladder(ladder_min, max_update_rows)
+        self.params_version = 0
+
+        # ---------------- host-side routing ---------------------------
+        # global nid -> (partition, local row); -1 rows are padding
+        nid = np.asarray(sg.global_nid)
+        self._q_part = np.full(self.num_global_nodes, -1, np.int32)
+        self._q_local = np.zeros(self.num_global_nodes, np.int32)
+        for p in range(self.P):
+            own = np.nonzero(nid[p] >= 0)[0]
+            self._q_part[nid[p, own]] = p
+            self._q_local[nid[p, own]] = own.astype(np.int32)
+
+        self.freshness = FreshnessTracker(self.P, self.n_max)
+        self.cache = Layer0Cache(sg.send_idx, sg.send_mask)
+        self._feat_lag = 0   # update batches not yet in _logits
+        self._halo_lag = 0   # update batches whose boundary rows are
+        #                      not yet in _halo0
+
+        # ---------------- device state --------------------------------
+        # private copy of the feature shard: serving patches it under
+        # donation, the trainer's training/eval buffer must stay intact
+        self._feat = jax.jit(
+            lambda x: x + jnp.zeros((), x.dtype),
+            out_shardings=trainer._shard)(trainer.data["feat"])
+        self._static = {k: v for k, v in trainer.data.items()
+                        if k not in _NON_STATIC}
+        self._params = trainer.state["params"]
+        self._norm = trainer.state["norm"]
+        self._logits = None
+
+        # ---------------- compiled programs ---------------------------
+        P, n_max, cfg = self.P, self.n_max, self.cfg
+        mesh = trainer.mesh
+        spec = PartitionSpec(PARTS_AXIS)
+        repl = PartitionSpec()
+        tm = jax.tree_util.tree_map
+        st_spec = tm(lambda _: spec, self._static)
+        params_spec = tm(lambda _: repl, self._params)
+        norm_spec = tm(lambda _: repl, self._norm)
+        is_gcn = cfg.model == "gcn"
+        cdt = cfg.compute_dtype
+
+        def send_view(f, in_deg):
+            # exactly forward()'s transform on the buffer it hands to
+            # comm_update at layer 0: cast to the compute dtype, then
+            # (GCN) the f32 symmetric-norm pre-scale cast back — the
+            # op sequence must match bit-for-bit or the cached halo
+            # diverges from a live exchange
+            h = f.astype(cdt)
+            if is_gcn:
+                d_sqrt = jnp.sqrt(in_deg.astype(jnp.float32))
+                h = (h.astype(jnp.float32)
+                     / d_sqrt[: h.shape[0], None]).astype(cdt)
+            return h
+
+        def exchange_fn(feat, d):
+            TRACE_COUNTS["exchange"] += 1
+            d = {k: v[0] for k, v in d.items()}
+            h = send_view(feat[0], d["in_deg"])
+            return exchange_blocks(h, d["send_idx"], d["send_mask"],
+                                   PARTS_AXIS, P)[None]
+
+        self._exchange_prog = jax.jit(jax.shard_map(
+            exchange_fn, mesh=mesh, in_specs=(spec, st_spec),
+            out_specs=spec))
+
+        def inc_fn(feat, halo0, dirty, d):
+            TRACE_COUNTS["inc"] += 1
+            d = {k: v[0] for k, v in d.items()}
+            h = send_view(feat[0], d["in_deg"])
+            new = dirty_exchange_blocks(
+                h, halo0[0], dirty[0], d["send_idx"], d["send_mask"],
+                PARTS_AXIS, P)
+            return new[None]
+
+        self._inc_prog = jax.jit(jax.shard_map(
+            inc_fn, mesh=mesh, in_specs=(spec, spec, spec, st_spec),
+            out_specs=spec), donate_argnums=(1,))
+
+        def refresh_fn(params, norm, feat, halo0, d):
+            TRACE_COUNTS["refresh"] += 1
+            d = {k: v[0] for k, v in d.items()}
+            f, h0 = feat[0], halo0[0]
+            # the first exchanged layer consumes the resident halo
+            # cache (the freshness carry); deeper layers exchange live
+            # exactly like the evaluator. Under use_pp layer 0 never
+            # exchanges, so every comm_update call is live.
+            first = None if cfg.use_pp else 0
+
+            def comm_update(i, h):
+                if i == first:
+                    return jnp.concatenate(
+                        [h, h0.astype(h.dtype)], axis=0)
+                return halo_exchange(h, d["send_idx"], d["send_mask"],
+                                     PARTS_AXIS, P)
+
+            spmm = trainer.make_device_spmm_closure(
+                d, n_max=n_max, n_src_rows=n_max + self.halo_size,
+                transport=False)
+            gat = trainer.make_device_gat_closure(
+                d, n_max=n_max, n_src_rows=n_max + self.halo_size,
+                transport=False)
+            with named_phase("serve_refresh"):
+                logits, _ = forward(
+                    params, cfg, f, d["edge_src"], d["edge_dst"],
+                    d["in_deg"], n_max, training=False, halo_eval=True,
+                    comm_update=comm_update, norm_state=norm,
+                    spmm_fn=spmm, gat_fn=gat)
+            return logits[None]
+
+        self._refresh_prog = jax.jit(jax.shard_map(
+            refresh_fn, mesh=mesh,
+            in_specs=(params_spec, norm_spec, spec, spec, st_spec),
+            out_specs=spec))
+
+        def patch_fn(feat, up, ul, uv):
+            TRACE_COUNTS["patch"] += 1
+            f = feat[0]
+            r = jax.lax.axis_index(PARTS_AXIS)
+            # rows owned elsewhere (and -1 padding) map out of bounds
+            # and are dropped by the scatter
+            idx = jnp.where(up == r, ul, f.shape[0])
+            f = f.at[idx].set(uv.astype(f.dtype), mode="drop")
+            return f[None]
+
+        self._patch_prog = jax.jit(jax.shard_map(
+            patch_fn, mesh=mesh, in_specs=(spec, repl, repl, repl),
+            out_specs=spec), donate_argnums=(0,))
+
+        def query_fn(logits, qp, ql):
+            TRACE_COUNTS["query"] += 1
+            lg = logits[0]
+            r = jax.lax.axis_index(PARTS_AXIS)
+            rows = jnp.take(lg, ql, axis=0, mode="clip")
+            rows = jnp.where((qp == r)[:, None], rows,
+                             jnp.zeros((), rows.dtype))
+            with named_phase("serve_query"):
+                # each queried row is non-zero on exactly its owner, so
+                # the psum both routes and replicates the answer
+                return jax.lax.psum(rows, PARTS_AXIS)
+
+        self._query_prog = jax.jit(jax.shard_map(
+            query_fn, mesh=mesh, in_specs=(spec, repl, repl),
+            out_specs=repl))
+
+        # the layer-0 halo cache starts fully fresh
+        self._halo0 = self._exchange_prog(self._feat, self._static)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def for_trainer(cls, trainer, **kw) -> "ServingEngine":
+        cache = getattr(trainer, "_serving_engines", None)
+        if cache is None:
+            cache = trainer._serving_engines = {}
+        key = tuple(sorted(kw.items()))
+        if key not in cache:
+            cache[key] = cls(trainer, **kw)
+        return cache[key]
+
+    # ---------------- params / warmup ---------------------------------
+
+    def load_params(self, params=None, norm=None) -> None:
+        """Swap serving weights (e.g. after a checkpoint restore on the
+        trainer); logits are stale until the next refresh()."""
+        self._params = self.trainer.state["params"] \
+            if params is None else params
+        self._norm = self.trainer.state["norm"] if norm is None else norm
+        self.params_version += 1
+        self._logits = None
+
+    def warmup(self, buckets=None) -> float:
+        """Trace the refresh program and every query-ladder bucket so
+        steady-state traffic replays compiled code. Returns seconds."""
+        t0 = time.monotonic()
+        if self._logits is None:
+            self.refresh()
+        for b in (buckets or self.ladder):
+            qp = np.full(b, -1, np.int32)
+            ql = np.zeros(b, np.int32)
+            np.asarray(self._query_prog(self._logits, qp, ql))
+        return time.monotonic() - t0
+
+    # ---------------- freshness path ----------------------------------
+
+    @property
+    def staleness_age(self) -> int:
+        return self._feat_lag
+
+    @property
+    def fully_fresh(self) -> bool:
+        return self._feat_lag == 0
+
+    def apply_updates(self, node_ids, values) -> int:
+        """Patch owned-node features in place (donated scatter), mark
+        the dirty-row bitmap, and invalidate layer-0 cache slots off
+        the send-lists. Returns the number of halo slots invalidated."""
+        if self.cfg.use_pp:
+            raise ValueError(
+                "feature updates are unsupported under use_pp: the "
+                "precompute folds raw features into a trainer-side "
+                "aggregate; serve with use_pp off (or rebuild the "
+                "engine) to ingest updates")
+        ids = np.atleast_1d(np.asarray(node_ids, np.int64))
+        vals = np.atleast_2d(np.asarray(values, np.float32))
+        if vals.shape != (ids.size, self.n_feat_raw):
+            raise ValueError(
+                f"values must be [{ids.size}, {self.n_feat_raw}], "
+                f"got {vals.shape}")
+        if ids.size and (ids.min() < 0
+                         or ids.max() >= self.num_global_nodes):
+            raise ValueError("node id out of range")
+        wide = _pad_cols(vals, self.trainer._feat_pad)
+        parts = self._q_part[ids]
+        local = self._q_local[ids]
+        touched = 0
+        top = self.update_ladder[-1]
+        for i0 in range(0, ids.size, top):
+            sl = slice(i0, min(i0 + top, ids.size))
+            n = sl.stop - sl.start
+            b = bucket_for(n, self.update_ladder)
+            up = np.full(b, -1, np.int32)
+            ul = np.zeros(b, np.int32)
+            uv = np.zeros((b, wide.shape[1]), np.float32)
+            up[:n], ul[:n], uv[:n] = parts[sl], local[sl], wide[sl]
+            self._feat = self._patch_prog(self._feat, up, ul, uv)
+        self.freshness.mark(parts, local)
+        touched = self.cache.invalidate_rows(parts, local)
+        self._feat_lag += 1
+        if touched:
+            self._halo_lag += 1
+        return touched
+
+    def refresh_boundary(self) -> int:
+        """Replay the send-list exchange for dirty rows only, merging
+        fresh values into the resident halo cache (bit-identical to a
+        full re-exchange — pinned by test). Returns slots refreshed."""
+        if not self.freshness.any:
+            return 0
+        n = self.cache.n_stale
+        self._halo0 = self._inc_prog(
+            self._feat, self._halo0, self.freshness.dirty, self._static)
+        self.freshness.clear()
+        self.cache.mark_fresh()
+        self._halo_lag = 0
+        return n
+
+    def full_boundary_exchange(self):
+        """Rebuild the whole halo block from scratch (the reference the
+        incremental path is pinned against; also the recovery hammer)."""
+        return self._exchange_prog(self._feat, self._static)
+
+    def refresh(self) -> None:
+        """Recompute the full logits shard from the current features +
+        halo cache. Served staleness collapses to the halo lag."""
+        self._logits = self._refresh_prog(
+            self._params, self._norm, self._feat, self._halo0,
+            self._static)
+        self._feat_lag = self._halo_lag
+
+    # ---------------- query path --------------------------------------
+
+    def query(self, node_ids, stats: Optional[ServingStats] = None
+              ) -> np.ndarray:
+        """Logits for global node ids, [n, n_class] f32. Pads to the
+        ladder bucket; chunks above the top bucket."""
+        ids = np.atleast_1d(np.asarray(node_ids, np.int64))
+        if ids.size and (ids.min() < 0
+                         or ids.max() >= self.num_global_nodes):
+            raise ValueError("node id out of range")
+        if self._logits is None:
+            self.refresh()
+        out = np.empty((ids.size, self.n_class), np.float32)
+        top = self.ladder[-1]
+        for i0 in range(0, ids.size, top):
+            sl = slice(i0, min(i0 + top, ids.size))
+            n = sl.stop - sl.start
+            b = bucket_for(n, self.ladder)
+            qp = np.full(b, -1, np.int32)
+            ql = np.zeros(b, np.int32)
+            qp[:n] = self._q_part[ids[sl]]
+            ql[:n] = self._q_local[ids[sl]]
+            out[sl] = np.asarray(
+                self._query_prog(self._logits, qp, ql))[:n]
+        hit = self.fully_fresh
+        self.cache.record_queries(ids.size, hit)
+        if stats is not None:
+            stats.note_serve(ids.size, hit, self.staleness_age)
+        return out
+
+    def make_batcher(self, stats: Optional[ServingStats] = None,
+                     max_delay_ms: float = 5.0,
+                     clock=time.monotonic) -> MicroBatcher:
+        return MicroBatcher(
+            run=lambda ids: self.query(ids, stats=stats),
+            max_batch=self.ladder[-1], max_delay_ms=max_delay_ms,
+            ladder_min=self.ladder[0], clock=clock,
+            observer=stats.note_batch if stats is not None else None)

@@ -1,0 +1,78 @@
+"""Incremental halo freshness: the staleness-1 carry repurposed.
+
+Training overlaps boundary communication with compute by consuming a
+one-step-stale halo carry. Serving flips the same machinery into a
+bounded-staleness freshness mechanism: feature updates patch the owned
+feature shard in place, a per-partition dirty-row bitmap records which
+rows changed, and `dirty_exchange_blocks` replays the send-list ring
+exchange for ONLY the dirty rows — merging the fresh values into the
+resident layer-0 halo cache and leaving clean slots byte-for-byte
+untouched. The result is pinned bit-identical to a full re-exchange
+(tests/test_serve.py::test_incremental_freshness_bit_identical).
+
+Transport note: the incremental exchange always ships uncompressed
+rows (no `halo_transport_dtypes` narrowing) — exactness against the
+full exchange is the contract here, and dirty-row volume is tiny.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.halo import _fwd_perm
+
+
+class FreshnessTracker:
+    """Host-side dirty-row bitmap, one bool per (partition, local row).
+    Marked by ServingEngine.apply_updates, consumed (as the mask fed to
+    `dirty_exchange_blocks`) and cleared by refresh_boundary."""
+
+    def __init__(self, num_parts: int, n_max: int):
+        self.dirty = np.zeros((num_parts, n_max), bool)
+
+    def mark(self, parts: np.ndarray, rows: np.ndarray) -> None:
+        self.dirty[np.asarray(parts), np.asarray(rows)] = True
+
+    @property
+    def any(self) -> bool:
+        return bool(self.dirty.any())
+
+    def counts(self) -> np.ndarray:
+        """Dirty rows per partition (observability)."""
+        return self.dirty.sum(axis=1)
+
+    def clear(self) -> None:
+        self.dirty[:] = False
+
+
+def dirty_exchange_blocks(h, halo, dirty, send_idx, send_mask,
+                          axis_name: str, num_parts: int):
+    """Inside-shard_map: re-exchange only dirty send-list rows and
+    merge them into the resident halo block `halo` ([(P-1)*B, F]).
+
+    Bit-identity argument vs `exchange_blocks`: a dirty, masked row
+    takes the identical take→where→ppermute path (same dtype, no
+    transport compression), so its merged value equals the full
+    exchange's; a clean masked row keeps its prior exact value; a
+    masked-off slot was zero at init and its dirty bit never fires.
+    """
+    if num_parts == 1:
+        return halo
+    rows_out, bits_out = [], []
+    for d in range(1, num_parts):
+        idx = send_idx[d - 1]
+        blk = jnp.take(h, idx, axis=0, mode="clip")
+        bit = jnp.take(dirty, idx, axis=0, mode="clip") & send_mask[d - 1]
+        blk = jnp.where(bit[:, None], blk, jnp.zeros((), blk.dtype))
+        perm = _fwd_perm(num_parts, d)
+        blk = jax.lax.ppermute(blk, axis_name, perm)
+        # bool collectives are flaky across backends; ship the bit as u8
+        bit = jax.lax.ppermute(bit.astype(jnp.uint8), axis_name, perm)
+        rows_out.append(blk)
+        bits_out.append(bit != 0)
+    fresh = jnp.concatenate(rows_out, axis=0)
+    bits = jnp.concatenate(bits_out, axis=0)
+    return jnp.where(bits[:, None], fresh.astype(halo.dtype), halo)

@@ -1,0 +1,168 @@
+"""Synthetic open-loop load generator + the shared serving loop.
+
+Open-loop means arrival times are fixed up front (Poisson process at
+the target QPS) and do NOT adapt to service time — the honest way to
+measure a serving system, since closed-loop generators hide overload
+by slowing down with the server (coordinated omission). `bench.py
+--serve` and `python -m pipegcn_tpu.cli.serve` both drive the same
+`run_serving_loop`, which owns the report / freshness-refresh / update-
+churn cadences and emits schema-v5 `serving` records.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from .batcher import ServingStats
+
+
+class OpenLoopGenerator:
+    """Deterministic (seeded) Poisson arrival schedule over random
+    node-id queries, with each query carrying `ids_per_query` ids."""
+
+    def __init__(self, num_nodes: int, qps: float, duration_s: float,
+                 ids_per_query: int = 1, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        n = max(1, int(round(qps * duration_s)))
+        gaps = rng.exponential(1.0 / max(qps, 1e-9), n)
+        self.arrivals = np.minimum(np.cumsum(gaps), duration_s)
+        self.queries = rng.integers(0, num_nodes, (n, ids_per_query),
+                                    dtype=np.int64)
+        self.duration_s = float(duration_s)
+
+    def __len__(self) -> int:
+        return len(self.arrivals)
+
+
+def run_serving_loop(engine, *, duration_s: float, qps: float,
+                     max_delay_ms: float = 5.0,
+                     ids_per_query: int = 1,
+                     report_every_s: float = 2.0,
+                     refresh_every_s: float = 0.5,
+                     update_every_s: float = 0.0,
+                     update_rows: int = 32,
+                     seed: int = 0,
+                     ml=None,
+                     stop: Optional[Callable[[], bool]] = None,
+                     clock: Callable[[], float] = time.monotonic,
+                     sleep: Callable[[float], None] = time.sleep) -> dict:
+    """Drive the engine under open-loop load; returns an aggregate
+    summary dict (qps, p50/p95/p99_ms, batch_fill, cache_hit_rate,
+    staleness_age_max, n_queries, n_records, drained).
+
+    Cadences: every `report_every_s` a `serving` record goes to `ml`
+    (a MetricsLogger, optional); every `refresh_every_s` the engine
+    recomputes logits (picking up applied updates); every
+    `update_every_s` (0 disables, forced off under use_pp) a synthetic
+    churn batch of `update_rows` random features is applied and the
+    dirty boundary rows incrementally re-exchanged.
+
+    `stop()` (optional) is polled between arrivals — the SIGTERM path:
+    on stop the loop drains the queue, emits a final record (extra
+    field `final: true`), and returns. Every accepted query is
+    answered before the function returns."""
+    stats = ServingStats(clock)
+    all_lat: list = []
+    fills: list = []
+
+    def observer(bucket, n_valid, lats):
+        stats.note_batch(bucket, n_valid, lats)
+        all_lat.extend(lats)
+        fills.append(n_valid / bucket)
+
+    batcher = engine.make_batcher(stats=stats,
+                                  max_delay_ms=max_delay_ms, clock=clock)
+    batcher._observer = observer
+    gen = OpenLoopGenerator(engine.num_global_nodes, qps, duration_s,
+                            ids_per_query=ids_per_query, seed=seed)
+    churn = np.random.default_rng(seed + 1)
+    do_updates = update_every_s > 0 and not engine.cfg.use_pp
+
+    t0 = clock()
+    next_report = t0 + report_every_s
+    next_refresh = t0 + refresh_every_s
+    next_update = t0 + update_every_s if do_updates else float("inf")
+    n_records = 0
+    total_q = 0
+    stale_max = 0
+    hits = misses = 0
+
+    def emit(now, final=False):
+        nonlocal n_records, total_q, stale_max, hits, misses
+        h, m = stats.hits, stats.misses
+        rec = stats.snapshot(queue_depth=batcher.queue_depth)
+        total_q += rec["queries"]
+        stale_max = max(stale_max, rec["staleness_age"])
+        hits += h
+        misses += m
+        if ml is not None:
+            extra = {"final": True} if final else {}
+            ml.serving(**rec, **extra)
+        n_records += 1
+
+    def tick(now):
+        nonlocal next_report, next_refresh, next_update
+        if do_updates and now >= next_update:
+            ids = churn.integers(0, engine.num_global_nodes,
+                                 update_rows, dtype=np.int64)
+            vals = churn.standard_normal(
+                (update_rows, engine.n_feat_raw)).astype(np.float32)
+            engine.apply_updates(ids, vals)
+            engine.refresh_boundary()
+            next_update = now + update_every_s
+        if now >= next_refresh:
+            engine.refresh()
+            next_refresh = now + refresh_every_s
+        if now >= next_report:
+            emit(now)
+            next_report = now + report_every_s
+
+    stopped = False
+    for t_arr, q in zip(gen.arrivals, gen.queries):
+        if stop is not None and stop():
+            stopped = True
+            break
+        target = t0 + t_arr
+        while True:
+            now = clock()
+            if now >= target:
+                break
+            batcher.pump(now)
+            tick(now)
+            if stop is not None and stop():
+                stopped = True
+                break
+            sleep(min(target - now, 0.0005))
+        if stopped:
+            break
+        batcher.submit(q)
+        now = clock()
+        batcher.pump(now)
+        tick(now)
+
+    # shutdown: answer everything accepted, then the final record —
+    # written through MetricsLogger.serving's hard_flush so it survives
+    # an unclean exit right after (the chaos drill's assertion)
+    batcher.drain()
+    emit(clock(), final=True)
+
+    lat = np.asarray(all_lat, np.float64) * 1000.0
+    dt = max(clock() - t0, 1e-9)
+    served = hits + misses
+    return {
+        "qps": float(total_q / dt),
+        "n_queries": int(total_q),
+        "duration_s": float(dt),
+        "p50_ms": float(np.percentile(lat, 50)) if lat.size else None,
+        "p95_ms": float(np.percentile(lat, 95)) if lat.size else None,
+        "p99_ms": float(np.percentile(lat, 99)) if lat.size else None,
+        "batch_fill": float(np.mean(fills)) if fills else None,
+        "cache_hit_rate": (float(hits / served) if served else None),
+        "staleness_age_max": int(stale_max),
+        "n_records": int(n_records),
+        "drained": batcher.queue_depth == 0,
+        "stopped_early": bool(stopped),
+    }

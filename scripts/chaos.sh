@@ -25,3 +25,15 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
 timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m numerics \
     -p no:cacheprovider "$@"
+
+# Serving lane (docs/SERVING.md): the serve kill drill — a live
+# `python -m pipegcn_tpu.cli.serve` process is SIGTERM'd mid-load and
+# must drain every accepted query and land a hard-flushed final
+# `serving` record before exiting 0 — plus the tier-1-safe serving
+# tests (padding-ladder no-recompile, incremental-freshness
+# bit-identity, cache invalidation) run standalone so a serving
+# regression fails the chaos lane even when someone trims the tier-1
+# selection.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serving \
+    -p no:cacheprovider "$@"

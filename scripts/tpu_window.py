@@ -108,6 +108,17 @@ QUEUE = [
     ("bench_auto_tuned",
      [sys.executable, "bench.py", "--no-compare"],
      3600, [_BENCH_PART]),
+    # round-10: the online serving runtime measured on chip — open-loop
+    # load against the compiled-once engine over the same bench
+    # artifact + tuned kernel tables; headline is sustained QPS with
+    # p50/p99 latency and live feature-update churn through the
+    # incremental freshness path (docs/SERVING.md). Cheap: one
+    # inference compile + 30 s of load.
+    ("serve_bench",
+     [sys.executable, "bench.py", "--serve", "--no-compare",
+      "--serve-secs", "30", "--serve-qps", "200",
+      "--metrics-out", "results/serve_bench_metrics.jsonl"],
+     1800, [_BENCH_PART]),
     # VERDICT r5 item 8: second shape point for the auto-kernel policy
     ("offshape_products",
      [sys.executable, "scripts/offshape_bench.py", "--shape",

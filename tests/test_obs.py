@@ -28,12 +28,12 @@ from pipegcn_tpu.utils.timer import CommTimer
 
 # ---------------- schema -------------------------------------------------
 
-# FROZEN copy of the v4 contract (v3 + the tuning kind the SpMM
-# auto-tuner PR added, bumping the version to 4). If any assert below
-# fires, a field was removed or retyped without bumping
+# FROZEN copy of the v5 contract (v4 + the serving kind the online
+# serving runtime PR added, bumping the version to 5). If any assert
+# below fires, a field was removed or retyped without bumping
 # SCHEMA_VERSION — consumers (bench trajectory, report CLI, timeline
 # CLI, scripts) would break silently.
-_V4_FIELDS = {
+_V5_FIELDS = {
     "run": {
         "event": "string", "schema_version": "integer",
         "time_unix": "number", "config": "object", "device": "object",
@@ -81,10 +81,17 @@ _V4_FIELDS = {
         "event": "string", "winner": "object", "source": "string",
         "costs": "array",
     },
+    "serving": {
+        "event": "string", "window_s": "number", "queries": "integer",
+        "qps": "number", "batch_fill": "number?",
+        "queue_depth": "integer", "p50_ms": "number?",
+        "p95_ms": "number?", "p99_ms": "number?",
+        "cache_hit_rate": "number?", "staleness_age": "integer",
+    },
 }
 
 
-def test_schema_v4_drift_guard():
+def test_schema_v5_drift_guard():
     current = {"run": obs_schema.RUN_FIELDS,
                "epoch": obs_schema.EPOCH_FIELDS,
                "eval": obs_schema.EVAL_FIELDS,
@@ -96,9 +103,10 @@ def test_schema_v4_drift_guard():
                "staleness": obs_schema.STALENESS_FIELDS,
                "numerics": obs_schema.NUMERICS_FIELDS,
                "fallback": obs_schema.FALLBACK_FIELDS,
-               "tuning": obs_schema.TUNING_FIELDS}
-    if obs_schema.SCHEMA_VERSION == 4:
-        for kind, fields in _V4_FIELDS.items():
+               "tuning": obs_schema.TUNING_FIELDS,
+               "serving": obs_schema.SERVING_FIELDS}
+    if obs_schema.SCHEMA_VERSION == 5:
+        for kind, fields in _V5_FIELDS.items():
             for name, tag in fields.items():
                 assert current[kind].get(name) == tag, (
                     f"schema field {kind}.{name} removed or retyped "
@@ -106,7 +114,7 @@ def test_schema_v4_drift_guard():
     else:
         # a bump legitimizes any field change; the contract is that the
         # version moved WITH the change
-        assert obs_schema.SCHEMA_VERSION > 4
+        assert obs_schema.SCHEMA_VERSION > 5
 
 
 def test_validate_record():
@@ -142,6 +150,20 @@ def test_validate_tuning_record():
     with pytest.raises(ValueError, match="expected array"):
         validate_record({"event": "tuning", "winner": {},
                          "source": "live", "costs": {}})
+
+
+def test_validate_serving_record():
+    validate_record({"event": "serving", "window_s": 2.0, "queries": 40,
+                     "qps": 20.0, "batch_fill": 0.5, "queue_depth": 0,
+                     "p50_ms": 1.2, "p95_ms": 3.4, "p99_ms": 5.6,
+                     "cache_hit_rate": 1.0, "staleness_age": 0})
+    # empty windows carry nullable latency/fill fields
+    validate_record({"event": "serving", "window_s": 2.0, "queries": 0,
+                     "qps": 0.0, "batch_fill": None, "queue_depth": 0,
+                     "p50_ms": None, "p95_ms": None, "p99_ms": None,
+                     "cache_hit_rate": None, "staleness_age": 0})
+    with pytest.raises(ValueError, match="missing field"):
+        validate_record({"event": "serving", "window_s": 2.0})
 
 
 # ---------------- sink ---------------------------------------------------
@@ -395,6 +417,53 @@ def test_report_json_pins_floor_share_and_halo_compression(tmp_path,
     out = capsys.readouterr().out
     assert "halo wire compression" in out
     assert "non-SpMM floor share" in out
+
+
+def test_report_json_pins_serving_summary(tmp_path, capsys):
+    """--json shape pin for the round-10 serving fields: windowed
+    `serving` records roll up to total QPS, query-weighted latency
+    percentiles / batch fill / cache hit rate, and a drained flag off
+    the hard-flushed final record."""
+    p = tmp_path / "serve.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        ml.serving(window_s=2.0, queries=40, qps=20.0, batch_fill=0.5,
+                   queue_depth=1, p50_ms=1.0, p95_ms=2.0, p99_ms=3.0,
+                   cache_hit_rate=1.0, staleness_age=0)
+        ml.serving(window_s=2.0, queries=120, qps=60.0, batch_fill=0.75,
+                   queue_depth=3, p50_ms=2.0, p95_ms=4.0, p99_ms=6.0,
+                   cache_hit_rate=0.5, staleness_age=2, final=True)
+    rc = report_main([str(p), "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["n_serving_records"] == 2
+    assert s["serving_queries"] == 160
+    assert s["serving_qps"] == pytest.approx(40.0)
+    # query-weighted means: (40*1 + 120*2) / 160
+    assert s["serving_p50_ms"] == pytest.approx(1.75)
+    assert s["serving_p99_ms"] == pytest.approx(5.25)
+    assert s["serving_batch_fill"] == pytest.approx(0.6875)
+    assert s["serving_cache_hit_rate"] == pytest.approx(0.625)
+    assert s["serving_staleness_age_max"] == 2
+    assert s["serving_queue_depth_max"] == 3
+    assert s["serving_drained"] is True
+    # human-readable lines render the same facts
+    rc = report_main([str(p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serving QPS" in out
+    assert "serving latency" in out
+    # without a final record the report flags the shutdown
+    q = tmp_path / "undrained.jsonl"
+    with MetricsLogger(q) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        ml.serving(window_s=2.0, queries=10, qps=5.0, batch_fill=None,
+                   queue_depth=0, p50_ms=None, p95_ms=None, p99_ms=None,
+                   cache_hit_rate=None, staleness_age=0)
+    summ = summarize_run(read_metrics(q))
+    assert summ["serving_drained"] is False
+    assert report_main([str(q)]) == 0
+    assert "!! serving shutdown" in capsys.readouterr().out
 
 
 def test_report_cli_tolerates_partial_files(tmp_path, capsys):
