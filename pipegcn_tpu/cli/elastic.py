@@ -1,0 +1,85 @@
+"""Elastic membership supervisor CLI.
+
+    python -m pipegcn_tpu.cli.elastic [supervisor flags] -- <train flags>
+
+Everything after ``--`` is a verbatim ``cli.main`` flag list (it must
+include ``--checkpoint-dir``); the supervisor launches the fleet,
+watches for rank death, redistributes partitions over the survivors
+and relaunches from the last good checkpoint (docs/RESILIENCE.md,
+"Elastic membership"). Exit code: 0 when training completed, 75 when
+the supervisor stopped resumably (max-restarts / restart-storm /
+SIGTERM) with the last checkpoint intact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..resilience.elastic import ElasticConfig, ElasticSupervisor
+
+
+def create_elastic_parser() -> argparse.ArgumentParser:
+    d = ElasticConfig()
+    ap = argparse.ArgumentParser(
+        prog="pipegcn_tpu.cli.elastic",
+        description="Supervise a multi-rank run; redistribute partitions "
+                    "over survivors when a rank dies.")
+    ap.add_argument("--max-restarts", type=int, default=d.max_restarts,
+                    help="hard cap on lifetime relaunches before a "
+                         "resumable stop (default %(default)s)")
+    ap.add_argument("--backoff-base", type=float, default=d.backoff_base_s,
+                    help="first relaunch delay, seconds; doubles per "
+                         "consecutive restart (default %(default)s)")
+    ap.add_argument("--backoff-max", type=float, default=d.backoff_max_s,
+                    help="relaunch delay ceiling, seconds "
+                         "(default %(default)s)")
+    ap.add_argument("--storm-window", type=float, default=d.storm_window_s,
+                    help="restart-storm sliding window, seconds "
+                         "(default %(default)s)")
+    ap.add_argument("--storm-threshold", type=int,
+                    default=d.storm_threshold,
+                    help="restarts inside the window that trip the "
+                         "circuit breaker (default %(default)s)")
+    ap.add_argument("--stable-s", type=float, default=d.stable_s,
+                    help="a generation surviving this long resets the "
+                         "backoff exponent (default %(default)s)")
+    ap.add_argument("--grace-extra", type=float, default=d.grace_extra_s,
+                    help="seconds past the watchdog horizon before "
+                         "wedged survivors are culled "
+                         "(default %(default)s)")
+    ap.add_argument("--metrics-out", default="",
+                    help="supervisor membership-record JSONL (default: "
+                         "<coord dir>/membership.jsonl)")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" not in argv:
+        create_elastic_parser().print_usage(sys.stderr)
+        print("error: expected '-- <cli.main train flags>' after the "
+              "supervisor flags", file=sys.stderr)
+        return 2
+    split = argv.index("--")
+    sup_argv, train_argv = argv[:split], argv[split + 1:]
+    sup = create_elastic_parser().parse_args(sup_argv)
+    cfg = ElasticConfig(
+        max_restarts=sup.max_restarts,
+        backoff_base_s=sup.backoff_base,
+        backoff_max_s=sup.backoff_max,
+        storm_window_s=sup.storm_window,
+        storm_threshold=sup.storm_threshold,
+        stable_s=sup.stable_s,
+        grace_extra_s=sup.grace_extra,
+        metrics_out=sup.metrics_out)
+    try:
+        return ElasticSupervisor(train_argv, cfg).run()
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,21 @@ def _maybe_init_distributed(args) -> None:
             f"bring-up.") from exc
 
 
+def _local_parts(args):
+    """Global partition ids this process will own under the contiguous
+    block assignment (node i gets [i*k, (i+1)*k)), or None when a
+    single process owns everything. Passed to ShardedGraph.load so an
+    elastic relaunch with a REDISTRIBUTED assignment validates its
+    per-rank artifact slices at load time (partition/halo.py), not
+    mid-epoch."""
+    n_nodes = math.ceil(args.n_partitions / args.parts_per_node)
+    if n_nodes <= 1:
+        return None
+    lo = args.node_rank * args.parts_per_node
+    hi = min(lo + args.parts_per_node, args.n_partitions)
+    return list(range(lo, hi))
+
+
 def prepare(args):
     """Load, partition (or reuse artifact), and return
     (sharded_graph, eval_graphs or None)."""
@@ -130,7 +145,7 @@ def prepare(args):
             eval_graphs = None
 
     if args.skip_partition and ShardedGraph.exists(part_path):
-        sg = ShardedGraph.load(part_path)
+        sg = ShardedGraph.load(part_path, parts=_local_parts(args))
         if sg.num_parts != args.n_partitions:
             raise ValueError(
                 f"partition artifact at {part_path} has "
@@ -145,7 +160,8 @@ def prepare(args):
             # the shared filesystem for the finished artifact so every
             # process trains on the SAME partition (the partitioner is
             # deterministic per host but not across toolchains)
-            sg = _await_partition_artifact(part_path, args.n_partitions)
+            sg = _await_partition_artifact(part_path, args.n_partitions,
+                                           parts=_local_parts(args))
         else:
             assert g is not None
             # inductive mode partitions the train subgraph only
@@ -177,7 +193,8 @@ def prepare(args):
 def _await_partition_artifact(part_path: str, n_partitions: int,
                               timeout_s: float = 3600.0,
                               poll_s: float = 2.0,
-                              max_poll_s: float = 30.0):
+                              max_poll_s: float = 30.0,
+                              parts=None):
     """Poll the shared filesystem for process 0's finished artifact.
 
     Exponential backoff with jitter: a 64-host pod polling a shared
@@ -206,7 +223,7 @@ def _await_partition_artifact(part_path: str, n_partitions: int,
         time.sleep(min(poll + random.uniform(0, poll * 0.25),
                        max(deadline - time.monotonic(), 0.1)))
         poll = min(poll * 1.6, max_poll_s)
-    sg = ShardedGraph.load(part_path)
+    sg = ShardedGraph.load(part_path, parts=parts)
     if sg.num_parts != n_partitions:
         raise ValueError(
             f"partition artifact at {part_path} has {sg.num_parts} parts, "
@@ -283,12 +300,22 @@ def run(args) -> dict:
     coord_dir = args.watchdog_dir or os.path.join(
         args.partition_dir,
         f"coord-{args.master_addr}-{args.port}")
+    # under elastic supervision (cli.elastic) the membership generation
+    # keys the heartbeat filenames, so a relaunched fleet never sees a
+    # previous incarnation's files (resilience/elastic.py)
+    try:
+        membership_gen = int(os.environ.get("PIPEGCN_MEMBERSHIP_GEN", -1))
+    except ValueError:
+        membership_gen = -1
+    if membership_gen >= 0:
+        print(f"elastic membership generation {membership_gen}")
     coord = Coordinator(
         cfg=CoordConfig(
             dir=coord_dir,
             watchdog_timeout=args.watchdog_timeout,
             desync_every=args.desync_check_every,
             desync_resync=args.desync_resync,
+            generation=membership_gen,
         ),
         log=print)
     coord.start()

@@ -287,6 +287,39 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         if depths:
             out["serving_queue_depth_max"] = max(depths)
         out["serving_drained"] = any(r.get("final") for r in serving)
+
+    # ---- elastic membership timeline (resilience/elastic.py, v6) ----
+    membership = [r for r in records if r.get("event") == "membership"]
+    if membership:
+        membership = sorted(
+            membership, key=lambda r: r.get("generation", -1)
+            if isinstance(r.get("generation"), int) else -1)
+        out["n_membership_records"] = len(membership)
+        gens = [r["generation"] for r in membership
+                if isinstance(r.get("generation"), int)]
+        if gens:
+            out["membership_last_generation"] = max(gens)
+        timeline = []
+        for r in membership:
+            a = r.get("assignment") or {}
+            timeline.append({
+                "generation": r.get("generation"),
+                "trigger": r.get("trigger"),
+                "n_members": (len(a.get("members", []))
+                              if isinstance(a.get("members"), list)
+                              else r.get("n_members")),
+                "parts_per_node": a.get("parts_per_node"),
+                "restart_latency_s": r.get("restart_latency_s"),
+            })
+        out["membership_timeline"] = timeline
+        lats = [r.get("restart_latency_s") for r in membership]
+        lats = [x for x in lats if isinstance(x, (int, float))]
+        if lats:
+            out["restart_latency_max_s"] = round(max(lats), 3)
+        stops = [r.get("trigger") for r in membership
+                 if r.get("trigger") in ("max-restarts", "restart-storm")]
+        if stops:
+            out["membership_stopped"] = stops[-1]
     return out
 
 
@@ -421,6 +454,26 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
         if not s.get("serving_drained"):
             lines.append(f"  {'!! serving shutdown':<26} no final "
                          f"record — the run died without draining")
+    # ---- elastic membership (docs/RESILIENCE.md) ----
+    if s.get("n_membership_records"):
+        lines.append("  {:<26} {} generations (last gen {})".format(
+            "membership", s["n_membership_records"],
+            s.get("membership_last_generation", "?")))
+        for t in s.get("membership_timeline", []):
+            lat = t.get("restart_latency_s")
+            lat_s = f", relaunched in {lat:.1f}s" \
+                if isinstance(lat, (int, float)) else ""
+            lines.append(
+                "  {:<26} gen {}: {} member(s) x {} part(s) "
+                "[{}]{}".format("", t.get("generation"),
+                                t.get("n_members", "?"),
+                                t.get("parts_per_node", "?"),
+                                t.get("trigger", "?"), lat_s))
+        row("restart latency (max)", "restart_latency_max_s", "{:.2f} s")
+        if s.get("membership_stopped"):
+            lines.append(f"  {'!! supervisor stopped':<26} "
+                         f"{s['membership_stopped']} — resume from the "
+                         f"last checkpoint manually")
     row("best val", "best_val", "{:.4f}")
     row("best epoch", "best_epoch")
     row("test acc", "test_acc", "{:.4f}")

@@ -301,6 +301,30 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def membership(self, generation: int, assignment: Dict[str, Any],
+                   trigger: str,
+                   restart_latency_s: Optional[float] = None,
+                   **extra) -> Dict[str, Any]:
+        """One elastic membership generation (resilience/elastic.py):
+        who owns which partitions and why the fleet was (re)launched.
+        `assignment` is Assignment.as_json(); restart_latency_s is the
+        death-detect -> relaunch wall time (None on the initial
+        launch). Hard-flushed — the supervisor may be SIGKILL'd
+        between generations and the ledger/metrics must never
+        disagree about how far membership advanced."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "membership",
+            "generation": int(generation),
+            "assignment": dict(assignment),
+            "trigger": str(trigger),
+            "restart_latency_s": (None if restart_latency_s is None
+                                  else float(restart_latency_s)),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 5  # v5: serving record kind (online serving runtime)
+SCHEMA_VERSION = 6  # v6: membership record kind (elastic membership)
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -191,6 +191,23 @@ SERVING_FIELDS: Dict[str, str] = {
     "staleness_age": "integer",    # max served staleness (update batches)
 }
 
+# one record per membership generation of an elastic-supervised run
+# (resilience/elastic.py): who owns which partitions and why the
+# fleet was (re)launched. assignment is Assignment.as_json() —
+# {n_parts, parts_per_node, n_nodes, members, parts: {member:
+# [partition ids]}, idle}. trigger: start | rank-death |
+# preempt-resume | rejoin | restart-all | supervisor-resume, or the
+# stop reasons max-restarts | restart-storm. restart_latency_s is the
+# death-detect -> relaunch wall time (null on the initial launch).
+# Extras the supervisor adds: n_members.
+MEMBERSHIP_FIELDS: Dict[str, str] = {
+    "event": "string",             # "membership"
+    "generation": "integer",       # monotonic across restarts (ledger)
+    "assignment": "object",        # partition -> member mapping
+    "trigger": "string",           # what caused this generation
+    "restart_latency_s": "number?",
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -205,6 +222,7 @@ _BY_EVENT = {
     "fallback": FALLBACK_FIELDS,
     "tuning": TUNING_FIELDS,
     "serving": SERVING_FIELDS,
+    "membership": MEMBERSHIP_FIELDS,
 }
 
 _JSON_TYPES = {

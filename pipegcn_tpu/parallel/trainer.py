@@ -1332,12 +1332,46 @@ class Trainer:
             out["comm"] = {}
         return out
 
+    def local_partition_ids(self) -> list:
+        """Global partition ids whose carry rows THIS process's devices
+        own under the mesh's process-major device order. This is the
+        elastic-membership redistribution mechanism in one line:
+        relaunching with a different world size moves these ids, and
+        restore_state re-device_puts the checkpointed FULL [P, ...]
+        carry under the new shardings — partition i's rows land on
+        whoever owns partition i now (resilience/elastic.py)."""
+        if jax.process_count() == 1:
+            return list(range(self.P))
+        pid = jax.process_index()
+        return [i for i, d in enumerate(self.mesh.devices.flat)
+                if i < self.P and d.process_index == pid]
+
     def restore_state(self, host_state: Dict[str, Any]) -> None:
         """Device-place a host-side state pytree (a checkpoint load or
         a sentinel last-good snapshot) with the trainer's shardings —
         the one way to put external state back under the donated-buffer
         step. Works identically for emulated trainers (their stacked
-        [P, ...] replicas ride the single-device shardings)."""
+        [P, ...] replicas ride the single-device shardings).
+
+        The comm carry is validated to span the FULL partition count
+        first: checkpoints always store all P rows (host_state's
+        allgather), which is exactly what makes an elastic resume
+        world-size independent — a partial carry means the caller
+        sliced per-rank state (use utils.checkpoint's
+        load_checkpoint_carry for that) and restoring it would
+        scatter the wrong partitions onto the mesh."""
+        def _check(path, a):
+            shape = np.shape(a)
+            if shape and shape[0] != self.P:
+                raise ValueError(
+                    f"comm carry leaf {jax.tree_util.keystr(path)} has "
+                    f"leading dim {shape[0]}, expected the full "
+                    f"partition count {self.P}: elastic restores need "
+                    f"the complete [P, ...] carry (this process now "
+                    f"owns partitions {self.local_partition_ids()})")
+            return a
+
+        jax.tree_util.tree_map_with_path(_check, host_state["comm"])
         self.state = {
             "params": jax.device_put(host_state["params"], self._repl),
             "opt": jax.device_put(host_state["opt"], self._repl),
@@ -1689,6 +1723,22 @@ class Trainer:
                 if fault_plan is not None and fault_plan.due("crash", epoch):
                     raise RuntimeError(
                         f"fault-injected crash at epoch {epoch}")
+                if fault_plan is not None and fault_plan.due("kill", epoch):
+                    # hard SIGKILL: no handlers, no atexit, no
+                    # checkpoint — the process vanishes like an
+                    # OOM-killed rank, so the PEERS' watchdog and the
+                    # elastic supervisor must do ALL the recovery
+                    import os as _os
+                    import signal as _signal
+                    import sys as _sys
+
+                    log_fn(f"fault-injected SIGKILL at epoch {epoch}")
+                    if metrics is not None:
+                        metrics.fault(kind="injected", epoch=epoch,
+                                      reason="kill")
+                    _sys.stdout.flush()
+                    _sys.stderr.flush()
+                    _os.kill(_os.getpid(), _signal.SIGKILL)
                 if fault_plan is not None and \
                         fault_plan.due("kernel-crash", epoch):
                     # the next dispatch raises a simulated TPU-backend

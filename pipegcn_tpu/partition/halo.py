@@ -165,6 +165,13 @@ class ShardedGraph:
     # repeat runs skip their O(E) host builds. Not serialized.
     cache_dir: Optional[str] = None
 
+    # set by load(parts=...): the global partition ids THIS process
+    # will own under the current elastic membership assignment
+    # (resilience/elastic.py); None = unsupervised / owns everything.
+    # Not serialized — the assignment is a property of the run, the
+    # artifact stays world-size independent.
+    local_parts: Optional[tuple] = None
+
     @property
     def halo_size(self) -> int:
         return (self.num_parts - 1) * self.b_max
@@ -720,7 +727,12 @@ class ShardedGraph:
             json.dump(manifest, f, indent=2)
 
     @staticmethod
-    def load(path: str) -> "ShardedGraph":
+    def load(path: str, parts=None) -> "ShardedGraph":
+        """Load an artifact; `parts` (optional) is the global partition
+        ids this process will own under the current elastic membership
+        assignment — validated immediately (validate_assignment) so a
+        redistributed relaunch pointed at a half-synced or mismatched
+        artifact fails AT LOAD, not mid-epoch inside a collective."""
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
         version = manifest.pop("format_version", 0)
@@ -742,6 +754,8 @@ class ShardedGraph:
             sg = ShardedGraph(**manifest, cache_dir=path, **arrays)
             if sg.reorder != "none":
                 sg.validate_layout()
+            if parts is not None:
+                sg.validate_assignment(parts)
             return sg
         if version != ShardedGraph.FORMAT_VERSION:
             raise ValueError(
@@ -758,7 +772,44 @@ class ShardedGraph:
                           **{k: arrays[k] for k in keys})
         if sg.reorder != "none":
             sg.validate_layout()
+        if parts is not None:
+            sg.validate_assignment(parts)
         return sg
+
+    def validate_assignment(self, parts) -> None:
+        """Assignment-aware artifact check for elastic membership
+        (resilience/elastic.py): `parts` must be distinct in-range
+        partition ids, and for a trim_edges v3 artifact every per-rank
+        edge file those partitions need must actually exist on THIS
+        host — after redistribution a process opens ranks it never
+        touched before, and a partially-synced shared filesystem must
+        fail loudly here instead of as a FileNotFoundError three
+        layers down. Records the set on ``local_parts``."""
+        ids = sorted(int(p) for p in parts)
+        if len(set(ids)) != len(ids):
+            raise ValueError(
+                f"assignment validation: duplicate partition ids in "
+                f"{list(parts)}")
+        if ids and (ids[0] < 0 or ids[-1] >= self.num_parts):
+            raise ValueError(
+                f"assignment validation: partition ids {ids} out of "
+                f"range [0, {self.num_parts}) — membership assignment "
+                f"and artifact disagree (stale ledger or wrong "
+                f"--n-partitions?)")
+        for key in ("edge_src", "edge_dst"):
+            arr = getattr(self, key)
+            if isinstance(arr, _RaggedEdges):
+                missing = [
+                    r for r in ids
+                    if not os.path.exists(os.path.join(
+                        arr._adir, f"{key}_r{r:03d}.npy"))]
+                if missing:
+                    raise ValueError(
+                        f"assignment validation: trimmed artifact is "
+                        f"missing {key} files for newly-assigned "
+                        f"partitions {missing} (half-synced artifact "
+                        f"directory?)")
+        self.local_parts = tuple(ids)
 
     def validate_layout(self) -> None:
         """Loud host-side boundary-slot / permutation validation (the

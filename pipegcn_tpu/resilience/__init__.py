@@ -24,6 +24,15 @@ all three (docs/RESILIENCE.md):
                  heartbeat watchdog (dead peers become PeerLost →
                  resumable exit 75 instead of an infinite collective
                  hang), and the param-digest desync detector
+  elastic.py     elastic membership — the cli.elastic supervisor that
+                 turns "survives preemption" into "trains through
+                 preemption": on rank death it re-plans the
+                 partition→rank assignment over the survivors
+                 (ceil(P/R') shards each), records the generation in a
+                 CRC-guarded membership ledger, and relaunches from
+                 the last good checkpoint; exponential backoff,
+                 --max-restarts and a restart-storm circuit breaker
+                 bound crash loops
 
 Checkpoint hardening (per-leaf digests, keep-last-N generations,
 corrupt-generation fallback) lives in utils/checkpoint.py; the fault /
@@ -42,6 +51,15 @@ from .coord import (
     PeerLost,
     digest_leaves,
 )
+from .elastic import (
+    Assignment,
+    ElasticConfig,
+    ElasticSupervisor,
+    LedgerCorrupt,
+    MembershipLedger,
+    RestartPolicy,
+    plan_assignment,
+)
 from .faults import FaultPlan, corrupt_latest_checkpoint
 from .numerics import (
     PHASES,
@@ -52,7 +70,8 @@ from .numerics import (
     first_nonfinite_phase,
     is_kernel_error,
 )
-from .preemption import EXIT_PREEMPTED, Preempted, PreemptionHandler
+from .preemption import (EXIT_PREEMPTED, Preempted, PreemptionHandler,
+                         classify_exit)
 from .sentinel import DivergenceError, DivergenceSentinel, SentinelConfig
 
 __all__ = [
@@ -69,6 +88,14 @@ __all__ = [
     "EXIT_PREEMPTED",
     "Preempted",
     "PreemptionHandler",
+    "classify_exit",
+    "Assignment",
+    "ElasticConfig",
+    "ElasticSupervisor",
+    "LedgerCorrupt",
+    "MembershipLedger",
+    "RestartPolicy",
+    "plan_assignment",
     "FaultPlan",
     "corrupt_latest_checkpoint",
     "Agreed",

@@ -232,10 +232,17 @@ class HeartbeatWatchdog:
                  timeout_s: float, interval_s: Optional[float] = None,
                  grace_s: Optional[float] = None,
                  on_deadline: Optional[Callable[[int, float], None]] = None,
-                 log: Callable[[str], None] = print):
+                 log: Callable[[str], None] = print,
+                 generation: int = -1):
         self.dir = directory
         self.rank = int(rank)
         self.n_ranks = int(n_ranks)
+        # elastic membership generation: -1 (unsupervised) keeps the
+        # legacy heartbeat-r<k> names; >= 0 keys the filenames on the
+        # generation so a relaunched fleet can never be poisoned by
+        # ghosts of a previous incarnation's files (the supervisor
+        # also unlinks heartbeat-* at launch — belt and braces)
+        self.generation = int(generation)
         self.timeout = float(timeout_s)
         self.interval = (float(interval_s) if interval_s
                          else max(self.timeout / 4.0, 0.2))
@@ -252,6 +259,9 @@ class HeartbeatWatchdog:
         self._start_time = 0.0
 
     def path_for(self, rank: int) -> str:
+        if self.generation >= 0:
+            return os.path.join(
+                self.dir, f"heartbeat-g{self.generation}-r{rank}")
         return os.path.join(self.dir, f"heartbeat-r{rank}")
 
     @property
@@ -356,6 +366,11 @@ class CoordConfig:
     # on agreed desync: resync every rank from rank 0's state instead
     # of aborting resumably
     desync_resync: bool = False
+    # elastic membership generation (resilience/elastic.py): keys the
+    # heartbeat filenames so files from a previous incarnation are
+    # invisible; -1 = unsupervised (legacy names). The CLI reads it
+    # from the PIPEGCN_MEMBERSHIP_GEN env the supervisor sets.
+    generation: int = -1
 
 
 class Coordinator:
@@ -414,7 +429,8 @@ class Coordinator:
             self.watchdog = HeartbeatWatchdog(
                 self.cfg.dir, self.rank, self.n_ranks,
                 self.cfg.watchdog_timeout,
-                on_deadline=self._on_hard_deadline, log=self.log)
+                on_deadline=self._on_hard_deadline, log=self.log,
+                generation=self.cfg.generation)
             self.watchdog.start()
 
     def stop(self) -> None:

@@ -30,6 +30,19 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 simulated TPU-backend error — exercises the kernel
                 fallback ladder (block -> bucket -> sorted-XLA) and its
                 contracted `fallback` record
+  kill          hard SIGKILL(self) at that epoch boundary — no
+                handlers, no atexit, no checkpoint: the process
+                vanishes like an OOM-killed or preempted-VM rank, so
+                the PEERS' watchdog (not the graceful SIGTERM path)
+                and the elastic supervisor's redistribution
+                (resilience/elastic.py) must do ALL the recovery.
+                ``kill@E:rN`` targets the generation's node rank N —
+                node ranks are re-dealt per membership generation
+  rejoin        ``rejoin@G``: the targeted member re-registers at
+                membership generation G. Inert inside the trainer —
+                the elastic SUPERVISOR reads it (via :meth:`schedule`)
+                and folds the member back into generation G's
+                assignment, rebalancing shards
 
 The optional ``:rN`` qualifier targets one rank (``jax.process_index``)
 so multi-process chaos drills can kill, desynchronize, or hang a single
@@ -59,10 +72,11 @@ import re
 from typing import List, Optional
 
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
-         "desync", "hang", "overflow", "kernel-crash")
+         "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin")
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire
-_BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash")
+_BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
+                   "kill")
 
 _ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::r(\d+))?$")
 
@@ -143,6 +157,14 @@ class FaultPlan:
                 e.consumed = True
                 return True
         return False
+
+    def schedule(self, kind: str) -> List[tuple]:
+        """Non-consuming (epoch-or-generation, rank) view of every
+        unconsumed entry of `kind`, REGARDLESS of rank targeting — the
+        elastic supervisor reads the ``rejoin`` schedule for ALL
+        members, not just the rank this plan was parsed for."""
+        return [(e.epoch, e.rank) for e in self._entries
+                if e.kind == kind and not e.consumed]
 
     def due_in(self, kind: str, lo: int, hi: int) -> Optional[int]:
         """Epoch (clamped into [lo, hi)) of a `kind` fault targeting

@@ -39,6 +39,24 @@ from typing import Optional
 EXIT_PREEMPTED = 75
 
 
+def classify_exit(returncode: int) -> str:
+    """Fold a child's exit status into the three outcomes the elastic
+    supervisor (resilience/elastic.py) acts on:
+
+      "completed"  0 — training finished, don't relaunch
+      "resumable"  75 — checkpointed and asked to be resumed (the
+                   survivor side of a PeerLost, or a preemption)
+      "dead"       anything else, including negative codes (killed by
+                   signal: SIGKILL'd, OOM'd, crashed) — a membership
+                   event: redistribute its partitions
+    """
+    if returncode == 0:
+        return "completed"
+    if returncode == EXIT_PREEMPTED:
+        return "resumable"
+    return "dead"
+
+
 class Preempted(Exception):
     """Raised at an epoch boundary after a shutdown request.
 

@@ -370,6 +370,109 @@ def checkpoint_exists(directory: str) -> bool:
     return bool(_candidates(directory))
 
 
+def _load_carry_from(path: str, template_comm: Any, parts: List[int]):
+    """One generation's comm-carry rows for `parts` (see
+    load_checkpoint_carry)."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template_comm)
+    rows = np.asarray(parts, np.int64)
+    try:
+        data = np.load(path)
+    except _READ_ERRORS as exc:
+        raise CheckpointCorrupt(
+            f"cannot open checkpoint {path}: {exc!r}") from exc
+    try:
+        digests = None
+        if _DIGEST_KEY in data.files:
+            try:
+                digests = json.loads(str(data[_DIGEST_KEY][()]))
+            except (*_READ_ERRORS, ValueError) as exc:
+                raise CheckpointCorrupt(
+                    f"unreadable digest manifest in {path}: {exc!r}"
+                ) from exc
+        leaves = []
+        for p, tmpl in paths:
+            key = "comm/" + _path_str(p)
+            bf16 = False
+            if _BF16_TAG + key in data.files:
+                key, bf16 = _BF16_TAG + key, True
+            elif key not in data.files:
+                raise KeyError(f"checkpoint {path} missing leaf {key}")
+            try:
+                arr = data[key]
+            except _READ_ERRORS as exc:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: member {key!r} unreadable "
+                    f"({exc!r})") from exc
+            # digest covers the FULL stored array: per-partition keying
+            # is row-sliced AFTER verification, so a torn row can never
+            # slip through just because another rank owns it
+            if digests is not None and key in digests \
+                    and _crc(arr) != digests[key]:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: digest mismatch for {key!r}")
+            if bf16:
+                arr = arr.view(_BF16)
+            if arr.ndim < 1 or arr.shape[0] <= int(rows.max(initial=0)):
+                raise ValueError(
+                    f"checkpoint leaf {key}: leading dim "
+                    f"{arr.shape[0] if arr.ndim else 0} cannot cover "
+                    f"partitions {parts}")
+            if tuple(arr.shape[1:]) != tuple(np.shape(tmpl)[1:]):
+                raise ValueError(
+                    f"checkpoint leaf {key}: per-partition shape "
+                    f"{arr.shape[1:]} != template {np.shape(tmpl)[1:]}")
+            arr = arr[rows]
+            tdt = np.asarray(tmpl).dtype
+            if arr.dtype != tdt:
+                arr = arr.astype(tdt)
+            leaves.append(arr)
+        epoch = (int(data["__epoch__"]) if "__epoch__" in data.files
+                 else -1)
+    finally:
+        data.close()
+    return jax.tree_util.tree_unflatten(treedef, leaves), epoch
+
+
+def load_checkpoint_carry(directory: str, template_comm: Any,
+                          parts: List[int]):
+    """Per-partition carry keying: ANY rank can load ANY shard's comm
+    carry from a full-state checkpoint. Returns (comm_tree, epoch)
+    where each leaf holds only rows ``parts`` of the stored [P, ...]
+    array (epoch -1 for a legacy pre-__epoch__ layout).
+
+    Checkpoints always store the FULL carry (host_state's allgather),
+    keyed ``comm/<tree path>`` with the leading axis being the
+    partition axis — so elastic redistribution
+    (resilience/elastic.py) needs no writer-side cooperation: a
+    process that inherits partitions {2, 3} after a membership change
+    slices its rows out of whatever generation survives, digests
+    verified, with the same newest-first generation fallback as
+    :func:`load_checkpoint`. `template_comm` supplies the tree
+    structure, dtypes and per-partition trailing shapes (its own
+    leading dim is ignored)."""
+    cands = _candidates(directory)
+    if not cands:
+        raise FileNotFoundError(f"no checkpoint in {directory}")
+    last_exc: Optional[CheckpointCorrupt] = None
+    for path in cands:
+        try:
+            tree, epoch = _load_carry_from(path, template_comm,
+                                           list(parts))
+            if last_exc is not None:
+                warnings.warn(
+                    f"carry restored from previous good checkpoint "
+                    f"generation {os.path.basename(path)}")
+            return tree, epoch
+        except CheckpointCorrupt as exc:
+            last_exc = exc
+            warnings.warn(
+                f"checkpoint generation {os.path.basename(path)} failed "
+                f"verification ({exc}); falling back")
+    raise CheckpointCorrupt(
+        f"every checkpoint generation in {directory} failed "
+        f"verification; last error: {last_exc}")
+
+
 def peek_epoch(directory: str):
     """Epoch of the newest readable checkpoint in `directory` without a
     state template (npz members load lazily, so only the scalar is

@@ -26,6 +26,19 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m numerics \
     -p no:cacheprovider "$@"
 
+# Elastic lane (docs/RESILIENCE.md "Elastic membership"): the
+# supervisor unit suite plus the two drills run standalone — the
+# crash-loop drill (kill@5 re-fires every generation; the supervisor
+# must stop at --max-restarts leaving a clean resumable checkpoint)
+# and the redistribution drill (kill -9 one of two supervised ranks ->
+# the survivor is relaunched owning BOTH partitions from the last good
+# checkpoint and completes every nominal epoch). Already inside the
+# faults marker above; re-run -k elastic so an elastic regression is
+# named even when the broad lane is trimmed.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults \
+    -k "elastic" -p no:cacheprovider "$@"
+
 # Serving lane (docs/SERVING.md): the serve kill drill — a live
 # `python -m pipegcn_tpu.cli.serve` process is SIGTERM'd mid-load and
 # must drain every accepted query and land a hard-flushed final

@@ -248,3 +248,87 @@ def test_two_process_desync_resync_drill(tmp_path):
         epochs = [x["epoch"] for x in recs[r]
                   if x.get("event") == "epoch"]
         assert sorted(epochs) == list(range(14))
+
+
+def test_elastic_kill_redistribution_drill(tmp_path):
+    """Acceptance (round 11): a SUPERVISED 2-rank run loses rank 1 to a
+    hard SIGKILL (kill@6:r1 — no handlers, no checkpoint, the process
+    just vanishes); the survivor's watchdog converts the dead
+    collective into exit 75, the elastic supervisor replans both
+    partitions onto the single survivor and relaunches it from the last
+    good checkpoint — the run then completes EVERY nominal epoch with
+    finite losses: membership gen 0 (2 members) -> gen 1 (1 member),
+    no epoch gap, all automatic."""
+    wd_timeout = 6.0
+    backoff = 0.5
+    grace_extra = 30.0
+    n_epochs = 12
+    ck = str(tmp_path / "ck")
+    mfile = str(tmp_path / "metrics.jsonl")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO,
+        "PYTHONUNBUFFERED": "1",
+    }
+    cmd = [
+        sys.executable, "-m", "pipegcn_tpu.cli.elastic",
+        "--max-restarts", "3", "--backoff-base", str(backoff),
+        "--grace-extra", str(grace_extra),
+        "--metrics-out", str(tmp_path / "sup.jsonl"),
+        "--",
+        "--dataset", "synthetic:400:6:8:3",
+        "--n-partitions", "2", "--parts-per-node", "1",
+        "--master-addr", "127.0.0.1",
+        "--n-epochs", str(n_epochs), "--n-hidden", "16",
+        "--dropout", "0.0", "--log-every", "1000",
+        "--fix-seed", "--seed", "7", "--no-eval",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--checkpoint-dir", ck, "--checkpoint-every", "2",
+        "--watchdog-timeout", str(wd_timeout),
+        "--fault-plan", "kill@6:r1",
+        "--metrics-out", mfile,
+    ]
+    t0 = time.time()
+    proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=540,
+                          capture_output=True, text=True)
+    elapsed = time.time() - t0
+    tail = (proc.stdout + proc.stderr)[-4000:]
+    assert proc.returncode == 0, f"supervisor exited " \
+        f"{proc.returncode} after {elapsed:.0f}s:\n{tail}"
+
+    # ---- membership: gen 0 (2 members) -> gen 1 (the survivor) ----
+    recs = [r for r in read_metrics(tmp_path / "sup.jsonl")
+            if r.get("event") == "membership"]
+    assert [r["generation"] for r in recs] == [0, 1], tail
+    assert recs[0]["trigger"] == "start"
+    assert recs[0]["assignment"]["parts"] == {"0": [0], "1": [1]}
+    assert recs[1]["trigger"] == "rank-death"
+    assert recs[1]["assignment"]["parts"] == {"0": [0, 1]}
+    # the redistribution landed within the watchdog horizon plus one
+    # backoff interval (the headline latency bound)
+    horizon = wd_timeout * 5 + grace_extra
+    assert 0.0 < recs[1]["restart_latency_s"] < horizon + backoff + 10, \
+        recs[1]
+
+    # ---- epoch continuity: rank 0's gen-0 records + the gen-1 solo
+    # run cover every nominal epoch exactly once, losses finite ----
+    epochs = {}
+    for gen in (0, 1):
+        p = tmp_path / f"metrics.g{gen}.m0.jsonl"
+        assert p.exists(), f"missing {p}:\n{tail}"
+        for x in read_metrics(p):
+            if x.get("event") == "epoch":
+                epochs.setdefault(x["epoch"], x["loss"])
+    assert sorted(epochs) == list(range(n_epochs)), sorted(epochs)
+    assert all(np.isfinite(v) for v in epochs.values())
+    # the kill fired where scheduled: gen 0 stops short of epoch 6
+    g0_epochs = [x["epoch"]
+                 for x in read_metrics(tmp_path / "metrics.g0.m0.jsonl")
+                 if x.get("event") == "epoch"]
+    assert max(g0_epochs) < 6
+
+    # ---- the handoff checkpoint is digest-valid and loadable ----
+    _assert_checkpoint_digest_valid(ck)
+    assert peek_epoch(ck) >= 6  # gen 1 kept checkpointing past resume

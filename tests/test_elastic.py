@@ -1,0 +1,602 @@
+"""Elastic membership tests (resilience/elastic.py + cli/elastic.py):
+assignment math, the CRC-guarded membership ledger, the restart policy
+(backoff / max-restarts / storm breaker), the kill/rejoin fault-plan
+grammar, generation-keyed heartbeats, per-partition carry keying, and
+the supervisor loop against a scripted fake fleet.
+
+Everything here is marked `faults` and stays tier-1-cheap except the
+crash-loop drill (additionally `slow`): a real cli.elastic subprocess
+whose train config SIGKILLs itself every generation, which must stop
+at --max-restarts with the last good checkpoint intact.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph import synthetic_graph
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.obs import read_metrics, validate_record
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition import ShardedGraph, partition_graph
+from pipegcn_tpu.resilience import (
+    EXIT_PREEMPTED,
+    Assignment,
+    ElasticConfig,
+    ElasticSupervisor,
+    FaultPlan,
+    HeartbeatWatchdog,
+    LedgerCorrupt,
+    MembershipLedger,
+    RestartPolicy,
+    classify_exit,
+    plan_assignment,
+)
+from pipegcn_tpu.resilience.elastic import (
+    GENERATION_ENV,
+    MEMBER_ENV,
+    _member_metrics_path,
+)
+from pipegcn_tpu.utils.checkpoint import (
+    latest_checkpoint_path,
+    load_checkpoint_carry,
+    peek_epoch,
+    save_checkpoint,
+)
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------- assignment math -------------------------------------
+
+
+def test_plan_assignment_even_and_ragged():
+    a = plan_assignment(4, [0, 1])
+    assert (a.parts_per_node, a.n_nodes) == (2, 2)
+    assert a.parts_of_node(0) == (0, 1)
+    assert a.parts_of_node(1) == (2, 3)
+    assert a.active_members() == (0, 1)
+    # ragged tail: 5 parts over 3 members -> 2+2+1
+    b = plan_assignment(5, [0, 1, 2])
+    assert (b.parts_per_node, b.n_nodes) == (2, 3)
+    assert b.parts_of_node(2) == (4,)
+    # one survivor inherits everything
+    c = plan_assignment(4, [3])
+    assert (c.parts_per_node, c.n_nodes) == (4, 1)
+    assert c.parts_of_node(0) == (0, 1, 2, 3)
+
+
+def test_plan_assignment_idle_spares_and_errors():
+    # more members than ceil-division needs: the tail idles
+    a = plan_assignment(3, [10, 11, 12, 13, 14])
+    assert (a.parts_per_node, a.n_nodes) == (1, 3)
+    assert a.active_members() == (10, 11, 12)
+    assert a.node_rank_of(12) == 2
+    assert a.node_rank_of(13) is None  # idle this generation
+    assert a.as_json()["idle"] == [13, 14]
+    # members are dedup'd + sorted (ledger identity, not launch order)
+    assert plan_assignment(2, [7, 7, 3]).members == (3, 7)
+    with pytest.raises(ValueError, match="zero members"):
+        plan_assignment(2, [])
+    with pytest.raises(ValueError, match="n_parts"):
+        plan_assignment(0, [0])
+
+
+def test_plan_assignment_always_covers_all_parts():
+    """Property: for any (P, R) the active nodes' blocks are a disjoint
+    cover of range(P) — the invariant that makes a redistribution safe
+    to resume from a full-carry checkpoint."""
+    for n_parts in (1, 2, 3, 4, 5, 8):
+        for n_members in (1, 2, 3, 4, 5):
+            a = plan_assignment(n_parts, range(n_members))
+            got = [p for i in range(a.n_nodes)
+                   for p in a.parts_of_node(i)]
+            assert got == list(range(n_parts)), (n_parts, n_members)
+            j = a.as_json()
+            assert sorted(sum(j["parts"].values(), [])) == \
+                list(range(n_parts))
+
+
+# ---------------- membership ledger -----------------------------------
+
+
+def test_ledger_roundtrip_and_monotonic(tmp_path):
+    led = MembershipLedger(str(tmp_path))
+    assert led.latest_generation() == -1 and led.latest() is None
+    a0 = plan_assignment(4, [0, 1])
+    led.append(generation=0, members=[0, 1], assignment=a0,
+               trigger="start")
+    led.append(generation=1, members=[0], assignment=plan_assignment(
+        4, [0]), trigger="rank-death", restart_latency_s=2.5)
+    assert led.generations() == [0, 1]
+    rec = led.read(1)
+    assert rec["members"] == [0]
+    assert rec["trigger"] == "rank-death"
+    assert rec["restart_latency_s"] == pytest.approx(2.5)
+    assert rec["assignment"]["parts"] == {"0": [0, 1, 2, 3]}
+    assert led.read(0)["restart_latency_s"] is None \
+        if "restart_latency_s" in led.read(0) else True
+    # monotonic ACROSS ledger objects: the counter lives on disk
+    led2 = MembershipLedger(str(tmp_path))
+    with pytest.raises(ValueError, match="monotonic"):
+        led2.append(generation=1, members=[0], assignment=a0,
+                    trigger="start")
+    led2.append(generation=5, members=[0], assignment=a0, trigger="x")
+    assert led.latest_generation() == 5
+
+
+def test_ledger_crc_rejects_tamper_and_falls_back(tmp_path):
+    led = MembershipLedger(str(tmp_path))
+    a = plan_assignment(2, [0, 1])
+    led.append(generation=0, members=[0, 1], assignment=a,
+               trigger="start")
+    led.append(generation=1, members=[0], assignment=a,
+               trigger="rank-death")
+    # flip a payload byte in gen 1 without touching the stored CRC
+    path = led.path_for(1)
+    rec = json.load(open(path))
+    rec["payload"]["trigger"] = "tampered"
+    json.dump(rec, open(path, "w"))
+    with pytest.raises(LedgerCorrupt, match="CRC"):
+        led.read(1)
+    # latest() walks back past the corrupt generation
+    assert led.latest()["generation"] == 0
+    # unparseable JSON is corrupt too, not a crash
+    with open(path, "w") as f:
+        f.write("{not json")
+    with pytest.raises(LedgerCorrupt):
+        led.read(1)
+
+
+def test_ledger_rejoin_requests(tmp_path):
+    led = MembershipLedger(str(tmp_path))
+    assert led.pending_rejoins() == []
+    led.request_rejoin(2)
+    led.request_rejoin(0)
+    assert led.pending_rejoins() == [0, 2]
+    led.clear_rejoin(2)
+    led.clear_rejoin(2)  # idempotent
+    assert led.pending_rejoins() == [0]
+
+
+# ---------------- restart policy --------------------------------------
+
+
+def test_restart_policy_backoff_doubles_and_caps():
+    now = [0.0]
+    pol = RestartPolicy(max_restarts=100, backoff_base_s=1.0,
+                        backoff_max_s=4.0, storm_threshold=100,
+                        stable_s=60.0, clock=lambda: now[0])
+    delays = []
+    for _ in range(5):
+        now[0] += 1000.0  # far apart: the storm window never fills
+        d = pol.decide()
+        assert d.action == "restart"
+        delays.append(d.delay_s)
+    assert delays == [1.0, 2.0, 4.0, 4.0, 4.0]
+    # a stable generation resets the exponent, not the total
+    pol.note_stable(120.0)
+    now[0] += 1000.0
+    assert pol.decide().delay_s == 1.0
+    assert pol.total == 6
+    # a short-lived generation does NOT reset
+    pol.note_stable(5.0)
+    now[0] += 1000.0
+    assert pol.decide().delay_s == 2.0
+
+
+def test_restart_policy_max_restarts_and_storm():
+    now = [0.0]
+    pol = RestartPolicy(max_restarts=2, storm_threshold=100,
+                        clock=lambda: now[0])
+    for _ in range(2):
+        now[0] += 1000.0
+        assert pol.decide().action == "restart"
+    now[0] += 1000.0
+    d = pol.decide()
+    assert (d.action, d.reason) == ("stop", "max-restarts")
+    # storm breaker: quick successive failures trip below the hard cap
+    pol2 = RestartPolicy(max_restarts=100, storm_window_s=60.0,
+                         storm_threshold=3, clock=lambda: now[0])
+    assert pol2.decide().action == "restart"
+    now[0] += 1.0
+    assert pol2.decide().action == "restart"
+    now[0] += 1.0
+    d2 = pol2.decide()
+    assert (d2.action, d2.reason) == ("stop", "restart-storm")
+    # ...but the same 3 failures spread past the window restart fine
+    pol3 = RestartPolicy(max_restarts=100, storm_window_s=60.0,
+                         storm_threshold=3, clock=lambda: now[0])
+    for _ in range(3):
+        now[0] += 100.0
+        assert pol3.decide().action == "restart"
+
+
+def test_classify_exit():
+    assert classify_exit(0) == "completed"
+    assert classify_exit(EXIT_PREEMPTED) == "resumable"
+    assert classify_exit(1) == "dead"
+    assert classify_exit(-9) == "dead"  # SIGKILL
+
+
+# ---------------- kill / rejoin grammar -------------------------------
+
+
+def test_kill_rejoin_grammar_and_schedule():
+    p = FaultPlan.parse("kill@6:r1,rejoin@2,rejoin@3:r5", rank=1)
+    # schedule() is the supervisor's NON-consuming all-ranks view
+    assert p.schedule("rejoin") == [(2, None), (3, 5)]
+    assert p.schedule("rejoin") == [(2, None), (3, 5)]
+    # kill is a boundary kind with the at-or-after + single-shot rules
+    assert not p.due("kill", 5)
+    assert p.due("kill", 6)
+    assert not p.due("kill", 6)
+    # rank targeting: a :r1 kill is inert on rank 0
+    q = FaultPlan.parse("kill@6:r1", rank=0)
+    assert not q.due("kill", 100)
+    with pytest.raises(ValueError, match="kind@epoch"):
+        FaultPlan.parse("kill@@6")
+
+
+def test_kill_boundary_resume_retirement():
+    """kill@E fires at the START of epoch E, so a resume at start_epoch
+    >= E retires it; a resume before E keeps it live (the crash-loop
+    drill depends on the latter: checkpoint-every 2 + kill@5 resumes at
+    epoch 4 and re-fires every generation)."""
+    p = FaultPlan.parse("kill@5")
+    p.skip_before(5)
+    assert p.remaining() == []
+    q = FaultPlan.parse("kill@5")
+    q.skip_before(4)
+    assert q.remaining() == ["kill@5"]
+
+
+# ---------------- generation-keyed heartbeats -------------------------
+
+
+def test_heartbeat_files_are_generation_keyed(tmp_path):
+    """Stale-heartbeat poisoning fix: a gen-1 watchdog neither reads
+    nor writes gen-0 files, so a relaunched fleet can't be tripped by
+    ghosts of the previous incarnation (nor keep a dead rank 'alive'
+    via its leftover file)."""
+    g0 = HeartbeatWatchdog(str(tmp_path), rank=0, n_ranks=2,
+                           timeout_s=5.0, generation=0)
+    g1 = HeartbeatWatchdog(str(tmp_path), rank=0, n_ranks=2,
+                           timeout_s=5.0, generation=1)
+    legacy = HeartbeatWatchdog(str(tmp_path), rank=0, n_ranks=2,
+                               timeout_s=5.0)
+    assert g0.path_for(1).endswith("heartbeat-g0-r1")
+    assert g1.path_for(1).endswith("heartbeat-g1-r1")
+    assert legacy.path_for(1).endswith("heartbeat-r1")
+    assert g0.path_for(1) != g1.path_for(1)
+    g0.beat()
+    assert os.path.exists(g0.path_for(0))
+    assert not os.path.exists(g1.path_for(0))
+
+
+# ---------------- per-partition carry keying --------------------------
+
+
+def test_carry_remap_parity(tmp_path):
+    """A full-state checkpoint row-slices into ANY partition subset:
+    the rows a post-redistribution process loads for its inherited
+    partitions are bit-identical to the writer's full carry, and the
+    trainer refuses a partial carry at restore (elastic restores must
+    go through the full [P, ...] form)."""
+    import jax
+
+    g = synthetic_graph(num_nodes=300, avg_degree=6, n_feat=8,
+                        n_class=3, seed=2)
+    parts = partition_graph(g, 4, seed=0)
+    sg = ShardedGraph.build(g, parts, n_parts=4)
+    cfg = ModelConfig(layer_sizes=(8, 16, 3), dropout=0.0,
+                      train_size=sg.n_train_global)
+    t = Trainer(sg, cfg, TrainConfig(n_epochs=3, enable_pipeline=True,
+                                     log_every=50))
+    t.fit(eval_graphs=None, log_fn=lambda s: None)
+    hs = t.host_state()
+    leaves_full = jax.tree_util.tree_leaves(hs["comm"])
+    assert leaves_full and all(l.shape[0] == 4 for l in leaves_full)
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, hs, 3)
+    # a survivor that inherits partitions {2, 3} after a membership
+    # change slices exactly those rows out of the full checkpoint
+    comm23, epoch = load_checkpoint_carry(ck, hs["comm"], [2, 3])
+    assert epoch == 3
+    for full, sub in zip(leaves_full,
+                         jax.tree_util.tree_leaves(comm23)):
+        np.testing.assert_array_equal(np.asarray(full)[[2, 3]], sub)
+    # the identity slice reproduces the writer's carry bit-for-bit
+    comm_all, _ = load_checkpoint_carry(ck, hs["comm"],
+                                        list(range(4)))
+    for full, sub in zip(leaves_full,
+                         jax.tree_util.tree_leaves(comm_all)):
+        np.testing.assert_array_equal(np.asarray(full), sub)
+    # restore_state validates the full-carry invariant loudly
+    assert t.local_partition_ids() == [0, 1, 2, 3]
+    partial = dict(hs)
+    partial["comm"] = comm23
+    with pytest.raises(ValueError, match="full partition count"):
+        t.restore_state(partial)
+    t.restore_state(hs)  # the full form round-trips
+
+
+# ---------------- supervisor loop (fake fleet) ------------------------
+
+
+class _FakeHandle:
+    def __init__(self, rc):
+        self.returncode = None
+        self._rc = rc
+
+    def poll(self):
+        self.returncode = self._rc
+        return self._rc
+
+    def send_signal(self, sig):
+        pass
+
+
+class _FakeFleet:
+    """Scripted popen: hands out exit codes in launch order and records
+    every (cmd, env, log_path) the supervisor constructed."""
+
+    def __init__(self, rcs):
+        self.rcs = list(rcs)
+        self.launches = []
+
+    def popen(self, cmd, env, log_path):
+        self.launches.append(
+            {"cmd": list(cmd), "env": dict(env), "log": log_path})
+        return _FakeHandle(self.rcs.pop(0))
+
+
+def _train_argv(tmp_path, n_parts=4, ppn=2, extra=()):
+    return [
+        "--dataset", "synthetic:300:6:8:3",
+        "--n-partitions", str(n_parts),
+        "--parts-per-node", str(ppn),
+        "--n-epochs", "6", "--n-hidden", "8", "--dropout", "0.0",
+        "--no-eval", "--fix-seed", "--seed", "7",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--metrics-out", str(tmp_path / "metrics.jsonl"),
+        *extra,
+    ]
+
+
+def _fast_cfg(**kw):
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("backoff_max_s", 0.0)
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("storm_threshold", 1000)
+    return ElasticConfig(**kw)
+
+
+def test_supervisor_requires_checkpoint_dir(tmp_path):
+    argv = _train_argv(tmp_path)
+    argv = [a for i, a in enumerate(argv)
+            if argv[i - 1] != "--checkpoint-dir"
+            and a != "--checkpoint-dir"]
+    with pytest.raises(ValueError, match="--checkpoint-dir"):
+        ElasticSupervisor(argv, _fast_cfg())
+
+
+def test_supervisor_redistributes_after_rank_death(tmp_path):
+    """The acceptance loop in miniature: gen 0 launches 2 members over
+    4 partitions; member 1 dies (SIGKILL rc) while member 0 exits 75;
+    gen 1 relaunches member 0 alone owning all 4 partitions and
+    completes. The ledger and membership metrics record both
+    generations."""
+    # gen 0: member 0 -> 75 (resumable), member 1 -> -9 (dead);
+    # gen 1: member 0 -> 0 (completed)
+    fleet = _FakeFleet([EXIT_PREEMPTED, -9, 0])
+    logs = []
+    sup = ElasticSupervisor(_train_argv(tmp_path), _fast_cfg(),
+                            popen=fleet.popen, log=logs.append)
+    assert sup.run() == 0
+    assert len(fleet.launches) == 3
+
+    led = MembershipLedger(sup.coord_dir)
+    assert led.generations() == [0, 1]
+    g0, g1 = led.read(0), led.read(1)
+    assert g0["trigger"] == "start" and g0["members"] == [0, 1]
+    assert g0["assignment"]["parts_per_node"] == 2
+    assert g1["trigger"] == "rank-death" and g1["members"] == [0]
+    assert g1["assignment"]["parts"] == {"0": [0, 1, 2, 3]}
+    assert g1["restart_latency_s"] >= 0.0
+
+    # the gen-1 child argv/env reflect the redistribution
+    last = fleet.launches[-1]
+    cmd = last["cmd"]
+    assert cmd[cmd.index("--parts-per-node") + 1] == "4"
+    assert cmd[cmd.index("--node-rank") + 1] == "0"
+    assert "--resume" not in cmd  # no checkpoint was ever written
+    assert last["env"][GENERATION_ENV] == "1"
+    assert last["env"][MEMBER_ENV] == "0"
+    mo = cmd[cmd.index("--metrics-out") + 1]
+    assert mo.endswith(".g1.m0.jsonl")
+
+    # membership metrics mirror the ledger and validate against v6
+    recs = [r for r in read_metrics(
+        os.path.join(sup.coord_dir, "membership.jsonl"))
+        if r.get("event") == "membership"]
+    assert [r["generation"] for r in recs] == [0, 1]
+    for r in recs:
+        validate_record(r)
+    assert recs[1]["trigger"] == "rank-death"
+
+
+def test_supervisor_stops_at_max_restarts(tmp_path):
+    """A config that kills every generation must stop resumable at the
+    cap, recording the stop in the membership stream — not thrash
+    forever."""
+    fleet = _FakeFleet([-9] * 10)
+    sup = ElasticSupervisor(
+        _train_argv(tmp_path, n_parts=2, ppn=2),
+        _fast_cfg(max_restarts=2), popen=fleet.popen,
+        log=lambda s: None)
+    assert sup.run() == EXIT_PREEMPTED
+    # gens 0, 1, 2 launched (2 restarts), then the cap stops gen 3
+    assert len(fleet.launches) == 3
+    led = MembershipLedger(sup.coord_dir)
+    assert led.generations() == [0, 1, 2]
+    # the sole member dying wholesale is a full-fleet retry
+    assert led.read(1)["trigger"] == "restart-all"
+    recs = [r for r in read_metrics(
+        os.path.join(sup.coord_dir, "membership.jsonl"))
+        if r.get("event") == "membership"]
+    assert recs[-1]["trigger"] == "max-restarts"
+    for r in recs:
+        validate_record(r)
+
+
+def test_supervisor_storm_breaker_stops(tmp_path):
+    fleet = _FakeFleet([-9] * 10)
+    sup = ElasticSupervisor(
+        _train_argv(tmp_path, n_parts=2, ppn=2),
+        _fast_cfg(max_restarts=100, storm_threshold=2,
+                  storm_window_s=3600.0),
+        popen=fleet.popen, log=lambda s: None)
+    assert sup.run() == EXIT_PREEMPTED
+    assert len(fleet.launches) == 2
+    recs = [r for r in read_metrics(
+        os.path.join(sup.coord_dir, "membership.jsonl"))
+        if r.get("event") == "membership"]
+    assert recs[-1]["trigger"] == "restart-storm"
+
+
+def test_supervisor_resumes_ledger_membership(tmp_path):
+    """A restarted supervisor resumes at latest-generation + 1 with the
+    last recorded membership — the generation counter lives in the
+    ledger filenames, not in any process."""
+    coord = str(tmp_path / "parts" / "coord-elastic")
+    led = MembershipLedger(coord)
+    led.append(generation=0, members=[0, 1], assignment=plan_assignment(
+        4, [0, 1]), trigger="start")
+    led.append(generation=1, members=[1], assignment=plan_assignment(
+        4, [1]), trigger="rank-death")
+    fleet = _FakeFleet([0])
+    sup = ElasticSupervisor(_train_argv(tmp_path), _fast_cfg(),
+                            popen=fleet.popen, log=lambda s: None)
+    assert sup.run() == 0
+    assert led.generations() == [0, 1, 2]
+    g2 = led.read(2)
+    assert g2["trigger"] == "supervisor-resume"
+    assert g2["members"] == [1]
+    assert fleet.launches[0]["env"][GENERATION_ENV] == "2"
+    assert fleet.launches[0]["env"][MEMBER_ENV] == "1"
+
+
+def test_supervisor_folds_in_scheduled_rejoin(tmp_path):
+    """rejoin@1:r2 in the fault plan: generation 1's assignment folds
+    member 2 back in after a preempt-resume event, rebalancing
+    (4 parts over 3 members -> 2 active nodes x 2 parts, member 2
+    idle spare)."""
+    fleet = _FakeFleet([EXIT_PREEMPTED, EXIT_PREEMPTED, 0, 0])
+    sup = ElasticSupervisor(
+        _train_argv(tmp_path, extra=("--fault-plan", "rejoin@1:r2")),
+        _fast_cfg(), popen=fleet.popen, log=lambda s: None)
+    assert sup.run() == 0
+    led = MembershipLedger(sup.coord_dir)
+    g1 = led.read(1)
+    assert g1["trigger"] == "rejoin"
+    assert g1["members"] == [0, 1, 2]
+    assert g1["assignment"]["idle"] == [2]
+    assert len(fleet.launches) == 4  # 2 + 2 (spare stays unlaunched)
+
+
+def test_supervisor_ledger_rejoin_request(tmp_path):
+    """A returning rank's on-disk rejoin-r<k>.json request is consumed
+    at the next membership event and cleared."""
+    coord = str(tmp_path / "parts" / "coord-elastic")
+    MembershipLedger(coord).request_rejoin(7)
+    fleet = _FakeFleet([EXIT_PREEMPTED, EXIT_PREEMPTED, 0, 0])
+    sup = ElasticSupervisor(_train_argv(tmp_path), _fast_cfg(),
+                            popen=fleet.popen, log=lambda s: None)
+    assert sup.run() == 0
+    led = MembershipLedger(coord)
+    assert led.read(1)["members"] == [0, 1, 7]
+    assert led.pending_rejoins() == []
+
+
+def test_supervisor_clears_stale_heartbeats(tmp_path):
+    """Launch hygiene half of the poisoning fix: heartbeat files from a
+    previous incarnation are unlinked before every generation."""
+    coord = str(tmp_path / "parts" / "coord-elastic")
+    os.makedirs(coord, exist_ok=True)
+    stale = os.path.join(coord, "heartbeat-r1")
+    open(stale, "w").close()
+    fleet = _FakeFleet([0, 0])
+    sup = ElasticSupervisor(_train_argv(tmp_path), _fast_cfg(),
+                            popen=fleet.popen, log=lambda s: None)
+    assert sup.run() == 0
+    assert not os.path.exists(stale)
+
+
+def test_member_metrics_path_naming():
+    assert _member_metrics_path("/x/m.jsonl", 2, 1) == "/x/m.g2.m1.jsonl"
+    assert _member_metrics_path("/x/m", 0, 3) == "/x/m.g0.m3.jsonl"
+
+
+def test_elastic_cli_requires_separator(capsys):
+    from pipegcn_tpu.cli.elastic import main as elastic_main
+
+    assert elastic_main(["--max-restarts", "3"]) == 2
+    assert "--" in capsys.readouterr().err
+
+
+# ---------------- crash-loop drill (subprocess, slow) ------------------
+
+
+@pytest.mark.slow
+def test_crash_loop_stops_at_max_restarts_with_checkpoint(tmp_path):
+    """Acceptance: a crash-looping config (kill@5 with checkpoint-every
+    2: the resume restarts at epoch 4 < 5, so the kill re-fires every
+    generation) stops at --max-restarts with rc 75, a clean resumable
+    epoch-4 checkpoint, and a ledger recording every generation."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PYTHONPATH": REPO,
+        "PYTHONUNBUFFERED": "1",
+    }
+    ck = str(tmp_path / "ck")
+    cmd = [
+        sys.executable, "-m", "pipegcn_tpu.cli.elastic",
+        "--max-restarts", "2", "--backoff-base", "0.1",
+        "--metrics-out", str(tmp_path / "sup.jsonl"),
+        "--",
+        "--dataset", "synthetic:300:6:8:3",
+        "--n-partitions", "2", "--parts-per-node", "2",
+        "--n-epochs", "10", "--n-hidden", "8", "--dropout", "0.0",
+        "--log-every", "1000", "--fix-seed", "--seed", "7", "--no-eval",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--checkpoint-dir", ck, "--checkpoint-every", "2",
+        "--fault-plan", "kill@5",
+        "--metrics-out", str(tmp_path / "metrics.jsonl"),
+    ]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=560,
+                          capture_output=True, text=True)
+    tail = (proc.stdout + proc.stderr)[-3000:]
+    assert proc.returncode == EXIT_PREEMPTED, tail
+    # gen 0 + 2 restarts, then the cap; the checkpoint survives at the
+    # last boundary the kill allows (epoch 4)
+    coord = str(tmp_path / "parts" / "coord-elastic")
+    led = MembershipLedger(coord)
+    assert led.generations() == [0, 1, 2], tail
+    assert led.read(0)["trigger"] == "start"
+    assert led.read(1)["trigger"] == "restart-all"
+    assert latest_checkpoint_path(ck) is not None
+    assert peek_epoch(ck) == 4
+    recs = [r for r in read_metrics(tmp_path / "sup.jsonl")
+            if r.get("event") == "membership"]
+    assert recs[-1]["trigger"] == "max-restarts"
+    assert "max-restarts" in tail

@@ -28,12 +28,12 @@ from pipegcn_tpu.utils.timer import CommTimer
 
 # ---------------- schema -------------------------------------------------
 
-# FROZEN copy of the v5 contract (v4 + the serving kind the online
-# serving runtime PR added, bumping the version to 5). If any assert
+# FROZEN copy of the v6 contract (v5 + the membership kind the elastic
+# membership PR added, bumping the version to 6). If any assert
 # below fires, a field was removed or retyped without bumping
 # SCHEMA_VERSION — consumers (bench trajectory, report CLI, timeline
 # CLI, scripts) would break silently.
-_V5_FIELDS = {
+_V6_FIELDS = {
     "run": {
         "event": "string", "schema_version": "integer",
         "time_unix": "number", "config": "object", "device": "object",
@@ -88,10 +88,15 @@ _V5_FIELDS = {
         "p95_ms": "number?", "p99_ms": "number?",
         "cache_hit_rate": "number?", "staleness_age": "integer",
     },
+    "membership": {
+        "event": "string", "generation": "integer",
+        "assignment": "object", "trigger": "string",
+        "restart_latency_s": "number?",
+    },
 }
 
 
-def test_schema_v5_drift_guard():
+def test_schema_v6_drift_guard():
     current = {"run": obs_schema.RUN_FIELDS,
                "epoch": obs_schema.EPOCH_FIELDS,
                "eval": obs_schema.EVAL_FIELDS,
@@ -104,9 +109,10 @@ def test_schema_v5_drift_guard():
                "numerics": obs_schema.NUMERICS_FIELDS,
                "fallback": obs_schema.FALLBACK_FIELDS,
                "tuning": obs_schema.TUNING_FIELDS,
-               "serving": obs_schema.SERVING_FIELDS}
-    if obs_schema.SCHEMA_VERSION == 5:
-        for kind, fields in _V5_FIELDS.items():
+               "serving": obs_schema.SERVING_FIELDS,
+               "membership": obs_schema.MEMBERSHIP_FIELDS}
+    if obs_schema.SCHEMA_VERSION == 6:
+        for kind, fields in _V6_FIELDS.items():
             for name, tag in fields.items():
                 assert current[kind].get(name) == tag, (
                     f"schema field {kind}.{name} removed or retyped "
@@ -114,7 +120,7 @@ def test_schema_v5_drift_guard():
     else:
         # a bump legitimizes any field change; the contract is that the
         # version moved WITH the change
-        assert obs_schema.SCHEMA_VERSION > 5
+        assert obs_schema.SCHEMA_VERSION > 6
 
 
 def test_validate_record():
@@ -464,6 +470,75 @@ def test_report_json_pins_serving_summary(tmp_path, capsys):
     assert summ["serving_drained"] is False
     assert report_main([str(q)]) == 0
     assert "!! serving shutdown" in capsys.readouterr().out
+
+
+def test_membership_record_roundtrip(tmp_path):
+    """MetricsLogger.membership writes a hard-flushed v6 record that
+    validates, carrying the supervisor's assignment verbatim."""
+    from pipegcn_tpu.resilience.elastic import plan_assignment
+
+    a = plan_assignment(4, [0, 1])
+    p = tmp_path / "m.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.membership(generation=0, assignment=a.as_json(),
+                      trigger="start", n_members=2)
+        ml.membership(generation=1,
+                      assignment=plan_assignment(4, [0]).as_json(),
+                      trigger="rank-death", restart_latency_s=3.25,
+                      n_members=1)
+    recs = [r for r in read_metrics(p) if r["event"] == "membership"]
+    assert len(recs) == 2
+    for r in recs:
+        validate_record(r)
+    assert recs[0]["restart_latency_s"] is None
+    assert recs[0]["assignment"]["parts"] == {"0": [0, 1], "1": [2, 3]}
+    assert recs[1]["trigger"] == "rank-death"
+    assert recs[1]["assignment"]["parts"] == {"0": [0, 1, 2, 3]}
+    # contract violations are loud
+    bad = dict(recs[0], generation="zero")
+    with pytest.raises(ValueError):
+        validate_record(bad)
+
+
+def test_report_json_pins_membership_summary(tmp_path, capsys):
+    """--json shape pin for the round-11 membership fields: the ledger's
+    generation records roll up to a timeline, the max restart latency,
+    and a stopped flag when the supervisor gave up."""
+    from pipegcn_tpu.resilience.elastic import plan_assignment
+
+    p = tmp_path / "elastic.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        ml.membership(generation=0,
+                      assignment=plan_assignment(2, [0, 1]).as_json(),
+                      trigger="start", n_members=2)
+        ml.membership(generation=1,
+                      assignment=plan_assignment(2, [0]).as_json(),
+                      trigger="rank-death", restart_latency_s=7.5,
+                      n_members=1)
+        ml.membership(generation=1,
+                      assignment=plan_assignment(2, [0]).as_json(),
+                      trigger="max-restarts", n_members=1)
+    rc = report_main([str(p), "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["n_membership_records"] == 3
+    assert s["membership_last_generation"] == 1
+    tl = s["membership_timeline"]
+    assert [t["generation"] for t in tl] == [0, 1, 1]
+    assert tl[0]["trigger"] == "start"
+    assert tl[0]["n_members"] == 2
+    assert tl[0]["parts_per_node"] == 1
+    assert tl[1]["restart_latency_s"] == pytest.approx(7.5)
+    assert s["restart_latency_max_s"] == pytest.approx(7.5)
+    assert s["membership_stopped"] == "max-restarts"
+    # human-readable lines render the same facts
+    rc = report_main([str(p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "membership" in out
+    assert "rank-death" in out
+    assert "!! supervisor stopped" in out
 
 
 def test_report_cli_tolerates_partial_files(tmp_path, capsys):

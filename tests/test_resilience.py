@@ -480,7 +480,7 @@ def test_await_partition_backoff(monkeypatch, capsys):
             return calls["n"] > 4
 
         @staticmethod
-        def load(path):
+        def load(path, parts=None):
             return FakeSG()
 
     monkeypatch.setattr(cli_main, "ShardedGraph", FakeShardedGraph)
