@@ -353,6 +353,16 @@ def main():
                          "churn batches under --serve (0 disables)")
     ap.add_argument("--serve-refresh-every", type=float, default=0.5,
                     help="seconds between serve logits recomputes")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="with --serve: run an N-replica serving FLEET "
+                         "(each replica its own process + mesh) behind "
+                         "the failover router, with a mid-load "
+                         "checkpoint hot-swap; headline metric is "
+                         "aggregate QPS (near-linear in N). 0 = "
+                         "single in-process engine")
+    ap.add_argument("--serve-max-queue", type=int, default=0,
+                    help="bound on queued query rows (overload sheds "
+                         "tickets); 0 = unbounded")
     ap.add_argument(_STAGE_FLAG, type=int, default=0, dest="stage",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -508,6 +518,9 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         lane_pad=args.lane_pad,
     )
     if getattr(args, "serve", False):
+        if getattr(args, "replicas", 0) > 0:
+            return _measure_fleet(args, backend, device_kind, n_parts,
+                                  degraded, sg, cfg)
         return _measure_serve(args, backend, device_kind, n_parts,
                               degraded, sg, cfg)
 
@@ -1126,6 +1139,7 @@ def _measure_serve(args, backend, device_kind, n_parts, degraded, sg,
         max_delay_ms=args.serve_max_delay_ms,
         update_every_s=args.serve_update_every,
         refresh_every_s=args.serve_refresh_every,
+        max_queue=args.serve_max_queue or None,
         seed=0, ml=ml)
 
     rnd = lambda v, k=3: None if v is None else round(v, k)  # noqa: E731
@@ -1148,6 +1162,8 @@ def _measure_serve(args, backend, device_kind, n_parts, degraded, sg,
         "batch_fill": rnd(summary["batch_fill"]),
         "cache_hit_rate": rnd(summary["cache_hit_rate"]),
         "staleness_age_max": summary["staleness_age_max"],
+        "n_shed": summary["n_shed"],
+        "conserved": summary["conserved"],
         "warmup_s": round(warm_s, 2),
     }
     if degraded:
@@ -1158,6 +1174,185 @@ def _measure_serve(args, backend, device_kind, n_parts, degraded, sg,
         finally:
             ml.close()
     print(json.dumps(result))
+    return result
+
+
+def _measure_fleet(args, backend, device_kind, n_parts, degraded, sg,
+                   cfg):
+    """bench.py --serve --replicas N: aggregate QPS of an N-replica
+    serving fleet (each replica a full mesh in its own process) behind
+    the failover router, with a mid-load checkpoint hot-swap so the
+    headline carries the measured `param_swap_ms` blip. Near-linear
+    aggregate QPS in N is the acceptance bar (docs/SERVING.md
+    "Fleet")."""
+    import glob
+    import shutil
+    import tempfile
+    import threading
+
+    from pipegcn_tpu.parallel import Trainer, TrainConfig
+    from pipegcn_tpu.serve.fleet import FleetManager, run_fleet_loop
+    from pipegcn_tpu.serve.router import Router
+    from pipegcn_tpu.utils.checkpoint import save_checkpoint
+
+    part_path = getattr(sg, "cache_dir", None)
+    if not part_path:
+        raise RuntimeError(
+            "--replicas needs an on-disk partition artifact (bench "
+            "always builds one; sg.cache_dir unset)")
+    scfg = dataclasses.replace(cfg, use_pp=False, dropout=0.0)
+
+    work_dir = tempfile.mkdtemp(prefix="bench-fleet-")
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    fleet_dir = os.path.join(work_dir, "fleet")
+
+    # one driver-side trainer supplies the checkpoint the replicas
+    # restore (generation 1) and hot-swap to (generation 2, published
+    # mid-load): the zero-downtime refresh path, end to end
+    t0 = time.perf_counter()
+    trainer = Trainer(sg, scfg, TrainConfig(
+        lr=0.01, n_epochs=0, enable_pipeline=False, seed=0, eval=False))
+    save_checkpoint(ckpt_dir, trainer.host_state(), 1)
+    print(f"# fleet setup: checkpoint generation 1 saved "
+          f"({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
+
+    hidden = cfg.layer_sizes[1]
+    n_layers = len(cfg.layer_sizes) - 1
+    child_args = [
+        "--partition-dir", os.path.dirname(os.path.abspath(part_path)),
+        # the forwarded graph name IS the full artifact basename
+        # (cluster suffix and all) — stop the replica's parser from
+        # re-appending its default -c<suffix>
+        "--graph-name", os.path.basename(part_path),
+        "--local-reorder", "none",
+        "--n-partitions", str(n_parts),
+        "--checkpoint-dir", ckpt_dir,
+        "--model", "graphsage",
+        "--n-hidden", str(hidden),
+        "--n-layers", str(n_layers),
+        "--norm", "layer", "--dropout", "0.0",
+        "--dtype", scfg.dtype,
+        "--spmm-impl", args.spmm_impl,
+        "--seed", "0",
+        "--serve-max-batch", str(args.serve_max_batch),
+        "--serve-report-every", "2.0",
+        "--fleet-swap-poll", "0.3",
+    ]
+    env = dict(os.environ)
+    if "xla_force_host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_parts}"
+        ).strip()
+    env.setdefault("PIPEGCN_PLATFORM", "cpu")
+    env.setdefault("JAX_PLATFORMS", env["PIPEGCN_PLATFORM"])
+
+    ml = None
+    if args.metrics_out:
+        from pipegcn_tpu.obs import MetricsLogger, device_info
+
+        try:
+            ml = MetricsLogger(args.metrics_out)
+            ml.run_header(config=vars(args), device=device_info(),
+                          mesh={"n_parts": n_parts,
+                                "replicas": args.replicas})
+        except OSError as exc:
+            print(f"# metrics sink unavailable: {exc}", file=sys.stderr)
+            ml = None
+
+    manager = FleetManager(fleet_dir, args.replicas,
+                           child_args=child_args, ml=ml, env=env,
+                           log=lambda m: print(f"# {m}",
+                                               file=sys.stderr))
+    t0 = time.perf_counter()
+    clients = manager.launch_all()
+    print(f"# fleet: {args.replicas} replicas ready in "
+          f"{time.perf_counter()-t0:.1f}s", file=sys.stderr)
+    router = Router(clients, policy="least-queue")
+
+    # publish generation 2 mid-load: every replica's watcher verifies
+    # the digests and load_params-swaps without retracing
+    def _publish_gen2():
+        save_checkpoint(ckpt_dir, trainer.host_state(), 2)
+        print("# fleet: checkpoint generation 2 published (hot-swap)",
+              file=sys.stderr)
+
+    timer = threading.Timer(max(args.serve_secs / 2, 1.0),
+                            _publish_gen2)
+    timer.daemon = True
+    timer.start()
+
+    num_nodes = int((np.asarray(sg.global_nid) >= 0).sum())
+    try:
+        summary = run_fleet_loop(
+            manager, router, num_nodes=num_nodes,
+            duration_s=args.serve_secs, qps=args.serve_qps,
+            max_batch=args.serve_max_batch,
+            max_delay_ms=args.serve_max_delay_ms,
+            max_queue=args.serve_max_queue or None,
+            seed=0, ml=ml)
+    finally:
+        timer.cancel()
+        manager.stop_all()
+
+    # the measured swap blip lives in the replicas' own metrics files
+    swap_ms = []
+    for path in glob.glob(os.path.join(fleet_dir,
+                                       "replica-m*-metrics.jsonl")):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "fleet" \
+                            and rec.get("kind") == "hot-swap":
+                        swap_ms.append(float(rec.get("swap_ms", 0.0)))
+        except OSError:
+            pass
+
+    rnd = lambda v, k=3: None if v is None else round(v, k)  # noqa: E731
+    result = {
+        "metric": "fleet_qps",
+        "value": round(summary["qps"], 2),
+        "unit": "q/s",
+        "serve": True,
+        "fleet": True,
+        "replicas": args.replicas,
+        "backend": backend,
+        "device": device_kind,
+        "n_parts": n_parts,
+        "dtype": scfg.dtype,
+        "target_qps": args.serve_qps,
+        "n_queries": summary["n_queries"],
+        "duration_s": round(summary["duration_s"], 2),
+        "p50_ms": rnd(summary["p50_ms"]),
+        "p95_ms": rnd(summary["p95_ms"]),
+        "p99_ms": rnd(summary["p99_ms"]),
+        "batch_fill": rnd(summary["batch_fill"]),
+        "n_shed": summary["n_shed"],
+        "n_failovers": summary["n_failovers"],
+        "replicas_up": summary["replicas_up"],
+        "per_replica_dispatched": summary["per_replica_dispatched"],
+        "per_replica_queue_depth_max":
+            summary["per_replica_queue_depth_max"],
+        "param_generation": summary["param_generation"],
+        "param_swap_ms": rnd(max(swap_ms), 1) if swap_ms else None,
+        "n_hot_swaps": len(swap_ms),
+        "conserved": summary["conserved"],
+        "drained": summary["drained"],
+    }
+    if degraded:
+        result["degraded"] = True
+    if ml is not None:
+        try:
+            ml.event("bench", **result)
+        finally:
+            ml.close()
+    print(json.dumps(result))
+    shutil.rmtree(work_dir, ignore_errors=True)
     return result
 
 

@@ -286,7 +286,40 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         depths = [d for d in depths if isinstance(d, int)]
         if depths:
             out["serving_queue_depth_max"] = max(depths)
+        sheds = [r.get("shed") for r in serving]
+        sheds = [x for x in sheds if isinstance(x, int)]
+        if sheds:
+            out["serving_shed_total"] = sum(sheds)
+        gens = [r.get("param_generation") for r in serving]
+        gens = [g for g in gens if isinstance(g, int) and g >= 0]
+        if gens:
+            out["serving_param_generation_last"] = gens[-1]
+        stale = [r.get("param_staleness") for r in serving]
+        stale = [x for x in stale if isinstance(x, int)]
+        if stale:
+            out["serving_param_staleness_max"] = max(stale)
         out["serving_drained"] = any(r.get("final") for r in serving)
+
+    # ---- serving fleet (serve/fleet.py, schema v7) ----
+    fleet = [r for r in records if r.get("event") == "fleet"]
+    if fleet:
+        out["n_fleet_records"] = len(fleet)
+        by_kind: Dict[str, int] = {}
+        for r in fleet:
+            k = r.get("kind")
+            if isinstance(k, str):
+                by_kind[k] = by_kind.get(k, 0) + 1
+        out["fleet_events"] = by_kind
+        swaps = [r.get("swap_ms") for r in fleet
+                 if r.get("kind") == "hot-swap"]
+        swaps = [x for x in swaps if isinstance(x, (int, float))]
+        if swaps:
+            out["fleet_param_swap_ms_max"] = round(max(swaps), 2)
+        gens = [r.get("param_generation") for r in fleet
+                if r.get("kind") == "hot-swap"]
+        gens = [g for g in gens if isinstance(g, int)]
+        if gens:
+            out["fleet_param_generation_last"] = max(gens)
 
     # ---- elastic membership timeline (resilience/elastic.py, v6) ----
     membership = [r for r in records if r.get("event") == "membership"]
@@ -451,9 +484,27 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
         row("serving cache hit rate", "serving_cache_hit_rate", "{:.1%}")
         row("serving staleness (max)", "serving_staleness_age_max")
         row("serving queue depth max", "serving_queue_depth_max")
+        row("serving shed (total)", "serving_shed_total")
+        row("serving param generation", "serving_param_generation_last")
+        row("serving param staleness", "serving_param_staleness_max")
         if not s.get("serving_drained"):
             lines.append(f"  {'!! serving shutdown':<26} no final "
                          f"record — the run died without draining")
+    # ---- serving fleet (docs/SERVING.md, "Fleet") ----
+    if s.get("n_fleet_records"):
+        ev = s.get("fleet_events") or {}
+        lines.append("  {:<26} {} events ({})".format(
+            "fleet", s["n_fleet_records"],
+            ", ".join(f"{k}={v}" for k, v in sorted(ev.items()))
+            or "none"))
+        row("fleet param swap (max)", "fleet_param_swap_ms_max",
+            "{:.2f} ms")
+        row("fleet param generation", "fleet_param_generation_last")
+        if ev.get("replica-dead", 0) > ev.get("replica-rejoin", 0):
+            lines.append(f"  {'!! fleet degraded':<26} "
+                         f"{ev.get('replica-dead', 0)} death(s) vs "
+                         f"{ev.get('replica-rejoin', 0)} rejoin(s) — "
+                         f"ended below full strength")
     # ---- elastic membership (docs/RESILIENCE.md) ----
     if s.get("n_membership_records"):
         lines.append("  {:<26} {} generations (last gen {})".format(

@@ -60,6 +60,15 @@ def build_parser():
     g.add_argument("--serve-build", "--serve_build", action="store_true",
                    help="build the partition artifact locally when "
                         "missing instead of awaiting it")
+    g.add_argument("--serve-max-queue", "--serve_max_queue", type=int,
+                   default=0,
+                   help="bound on queued query rows; overload sheds "
+                        "tickets (counted as `shed`) instead of "
+                        "growing the queue. 0 = unbounded")
+    g.add_argument("--serve-ticket-deadline-ms",
+                   "--serve_ticket_deadline_ms", type=float, default=0.0,
+                   help="shed tickets that waited past this deadline "
+                        "at flush time. 0 = no deadline")
     return p
 
 
@@ -110,8 +119,13 @@ def _load_partition(args):
         timeout_s=args.serve_artifact_timeout)
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+def build_serving_engine(args, log=print):
+    """Everything between parsed args and a warm ServingEngine —
+    shared by this entrypoint and each fleet replica process
+    (cli/fleet.py --replica-id K). Returns (trainer, engine, epoch)
+    where epoch is the restored checkpoint generation (-1 when serving
+    freshly-initialized params); the engine's parameter-generation
+    axis is already set to it."""
     if args.model not in ("graphsage", "gcn", "gat"):
         raise ValueError(f"unknown model: {args.model}")
     if args.model in ("gcn", "gat") and args.use_pp:
@@ -128,7 +142,7 @@ def main(argv=None) -> int:
 
     from ..models.sage import ModelConfig
     from ..parallel.trainer import TrainConfig, Trainer
-    from ..serve import ServingEngine, run_serving_loop
+    from ..serve import ServingEngine
     from ..utils.checkpoint import checkpoint_exists, load_checkpoint
 
     sg = _load_partition(args)
@@ -165,15 +179,33 @@ def main(argv=None) -> int:
                        eval=False, halo_dtype=args.halo_dtype)
     trainer = Trainer(sg, cfg, tcfg)
 
+    epoch = -1
     if args.checkpoint_dir and checkpoint_exists(args.checkpoint_dir):
         host_state, epoch = load_checkpoint(args.checkpoint_dir,
                                             trainer.host_state())
         trainer.restore_state(host_state)
-        print(f"serving params restored from {args.checkpoint_dir} "
-              f"(epoch {epoch})")
+        log(f"serving params restored from {args.checkpoint_dir} "
+            f"(epoch {epoch})")
     elif args.checkpoint_dir:
-        print(f"WARNING: no checkpoint in {args.checkpoint_dir!r}; "
-              f"serving freshly-initialized params")
+        log(f"WARNING: no checkpoint in {args.checkpoint_dir!r}; "
+            f"serving freshly-initialized params")
+
+    engine = ServingEngine.for_trainer(
+        trainer, max_batch=args.serve_max_batch,
+        ladder_min=args.serve_ladder_min)
+    engine.param_generation = int(epoch)
+    warm_s = engine.warmup()
+    log(f"serve: engine warm in {warm_s:.2f}s "
+        f"(ladder {engine.ladder}, {engine.num_global_nodes} nodes, "
+        f"{trainer.P} partitions)")
+    return trainer, engine, epoch
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from ..serve import run_serving_loop
+
+    trainer, engine, _epoch = build_serving_engine(args)
 
     ml = None
     if args.metrics_out:
@@ -183,14 +215,6 @@ def main(argv=None) -> int:
         ml.run_header(config=vars(args), device=device_info(),
                       mesh={"n_parts": args.n_partitions,
                             **mesh_info(trainer.mesh)})
-
-    engine = ServingEngine.for_trainer(
-        trainer, max_batch=args.serve_max_batch,
-        ladder_min=args.serve_ladder_min)
-    warm_s = engine.warmup()
-    print(f"serve: engine warm in {warm_s:.2f}s "
-          f"(ladder {engine.ladder}, {engine.num_global_nodes} nodes, "
-          f"{trainer.P} partitions)")
 
     stop_flag = {"stop": False}
 
@@ -211,6 +235,8 @@ def main(argv=None) -> int:
             update_rows=args.serve_update_rows,
             seed=args.seed,
             ml=ml,
+            max_queue=args.serve_max_queue or None,
+            ticket_deadline_ms=args.serve_ticket_deadline_ms or None,
             stop=lambda: stop_flag["stop"],
         )
     finally:

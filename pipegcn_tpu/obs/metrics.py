@@ -275,13 +275,17 @@ class MetricsLogger:
                 p50_ms: Optional[float], p95_ms: Optional[float],
                 p99_ms: Optional[float],
                 cache_hit_rate: Optional[float], staleness_age: int,
-                **extra) -> Dict[str, Any]:
+                shed: int = 0, param_generation: int = -1,
+                param_staleness: int = 0, **extra) -> Dict[str, Any]:
         """One serving report window (serve/loadgen.run_serving_loop):
         QPS, batch fill, queue depth, latency percentiles, cache hit
-        rate, and the max served staleness age. Hard-flushed — the
-        shutdown path's final record (extra ``final: true``) must
-        survive a SIGTERM'd load generator (scripts/chaos.sh serving
-        lane asserts exactly this)."""
+        rate, the max served staleness age, plus (v7) the load-shed
+        row count and the parameter-staleness axis (checkpoint
+        generation served / newer generations published but not yet
+        swapped in). Hard-flushed — the shutdown path's final record
+        (extra ``final: true``) must survive a SIGTERM'd load
+        generator (scripts/chaos.sh serving lane asserts exactly
+        this)."""
         extra.setdefault("time_unix", time.time())
         rec = self.write({
             "event": "serving",
@@ -296,6 +300,28 @@ class MetricsLogger:
             "cache_hit_rate": (None if cache_hit_rate is None
                                else float(cache_hit_rate)),
             "staleness_age": int(staleness_age),
+            "shed": int(shed),
+            "param_generation": int(param_generation),
+            "param_staleness": int(param_staleness),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
+    def fleet(self, kind: str, replica: int, window: int = -1,
+              **extra) -> Dict[str, Any]:
+        """One serving-fleet lifecycle event (serve/fleet.py): replica
+        death / failover / relaunch / rejoin, a zero-downtime checkpoint
+        hot-swap, or a supervisor stop. `window` is the serving report
+        window index the event fell in (-1 outside the load loop).
+        Hard-flushed — replica-dead records often immediately precede
+        more dying."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "fleet",
+            "kind": str(kind),
+            "replica": int(replica),
+            "window": int(window),
             **extra,
         })
         self.hard_flush()

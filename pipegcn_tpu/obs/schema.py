@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 6  # v6: membership record kind (elastic membership)
+SCHEMA_VERSION = 7  # v7: fleet record kind + serving shed /
+#                         parameter-staleness fields (serving fleet)
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -177,6 +178,13 @@ TUNING_FIELDS: Dict[str, str] = {
 # flushed batches; staleness_age is the max bounded-staleness age (in
 # applied update batches) any query in the window was served at — 0
 # means every answer reflected every accepted update (docs/SERVING.md).
+# v7 grows the parameter-staleness axis + load-shedding accounting:
+# param_generation is the checkpoint generation (epoch) of the params
+# that served this window (-1 = freshly-initialized, no checkpoint);
+# param_staleness counts CRC-verified published generations NEWER than
+# the serving one (0 = serving the newest model); shed counts query
+# rows explicitly rejected this window (bounded queue / per-ticket
+# deadline) instead of silently growing the queue.
 SERVING_FIELDS: Dict[str, str] = {
     "event": "string",             # "serving"
     "window_s": "number",          # report window wall-clock length
@@ -189,6 +197,36 @@ SERVING_FIELDS: Dict[str, str] = {
     "p99_ms": "number?",
     "cache_hit_rate": "number?",   # fully-fresh served fraction
     "staleness_age": "integer",    # max served staleness (update batches)
+    "shed": "integer",             # rows load-shed this window
+    "param_generation": "integer",  # checkpoint gen of served params
+    "param_staleness": "integer",  # newer published gens not yet served
+}
+
+# one record per serving-fleet lifecycle event (serve/fleet.py +
+# serve/router.py): replica death/failover/relaunch/rejoin and
+# zero-downtime checkpoint hot-swaps. kind:
+#   replica-dead   a replica stopped answering (process exit, stale
+#                  heartbeat, or RPC failure); extras: reason
+#   failover       in-flight tickets were retried against survivors;
+#                  extras: n_retried, to_replica
+#   relaunch       the fleet supervisor restarted the replica process;
+#                  extras: incarnation, delay_s
+#   replica-rejoin the relaunched replica answered health checks and
+#                  re-entered routing; extras: incarnation,
+#                  rejoin_latency_s
+#   hot-swap       the replica swapped to a newer CRC-verified
+#                  checkpoint generation without retracing; extras:
+#                  param_generation, swap_ms
+#   swap-rejected  a corrupt/truncated generation failed verification
+#                  and the replica kept (or walked back to) older
+#                  params; extras: reason
+#   fleet-stop     the supervisor stopped relaunching (max-restarts /
+#                  restart-storm brake); extras: reason
+FLEET_FIELDS: Dict[str, str] = {
+    "event": "string",             # "fleet"
+    "kind": "string",              # see above
+    "replica": "integer",          # replica id the event concerns
+    "window": "integer",           # serving report window index
 }
 
 # one record per membership generation of an elastic-supervised run
@@ -223,6 +261,7 @@ _BY_EVENT = {
     "tuning": TUNING_FIELDS,
     "serving": SERVING_FIELDS,
     "membership": MEMBERSHIP_FIELDS,
+    "fleet": FLEET_FIELDS,
 }
 
 _JSON_TYPES = {

@@ -43,6 +43,12 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 the elastic SUPERVISOR reads it (via :meth:`schedule`)
                 and folds the member back into generation G's
                 assignment, rebalancing shards
+  replica-kill  ``replica-kill@W[:mK]``: SIGKILL serving replica K at
+                serving report window W (default replica 0). Inert in
+                the trainer — the serving FLEET driver reads it (via
+                :meth:`due_member`) and hard-kills the replica process
+                so the router's failover path is drillable from the
+                standard chaos harness. Boundary-retired like kill@E.
 
 The optional ``:rN`` qualifier targets one rank (``jax.process_index``)
 so multi-process chaos drills can kill, desynchronize, or hang a single
@@ -72,20 +78,22 @@ import re
 from typing import List, Optional
 
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
-         "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin")
+         "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin",
+         "replica-kill")
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire
 _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
-                   "kill")
+                   "kill", "replica-kill")
 
-_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::r(\d+))?$")
+_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm])(\d+))?$")
 
 
 @dataclasses.dataclass
 class _Entry:
     kind: str
     epoch: int
-    rank: Optional[int] = None  # None = every rank
+    rank: Optional[int] = None    # None = every rank (``:rN``)
+    member: Optional[int] = None  # serving replica target (``:mK``)
     consumed: bool = False
 
 
@@ -111,15 +119,19 @@ class FaultPlan:
             if not m:
                 raise ValueError(
                     f"bad fault-plan entry {raw!r}: expected "
-                    f"kind@epoch[:rN] (e.g. nan-loss@5:r1,sigterm@8,"
-                    f"corrupt-ckpt@10)")
+                    f"kind@epoch[:rN] or kind@window[:mK] (e.g. "
+                    f"nan-loss@5:r1,sigterm@8,replica-kill@2:m1)")
             kind, epoch = m.group(1), int(m.group(2))
-            erank = int(m.group(3)) if m.group(3) is not None else None
+            erank = emember = None
+            if m.group(3) == "r":
+                erank = int(m.group(4))
+            elif m.group(3) == "m":
+                emember = int(m.group(4))
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r}; known: "
                     f"{', '.join(KINDS)}")
-            entries.append(_Entry(kind, epoch, erank))
+            entries.append(_Entry(kind, epoch, erank, emember))
         return cls(entries, rank=rank)
 
     def _mine(self, e: _Entry) -> bool:
@@ -134,6 +146,7 @@ class FaultPlan:
     def remaining(self) -> List[str]:
         return [f"{e.kind}@{e.epoch}"
                 + (f":r{e.rank}" if e.rank is not None else "")
+                + (f":m{e.member}" if e.member is not None else "")
                 for e in self._entries if not e.consumed]
 
     def skip_before(self, start_epoch: int) -> None:
@@ -165,6 +178,18 @@ class FaultPlan:
         members, not just the rank this plan was parsed for."""
         return [(e.epoch, e.rank) for e in self._entries
                 if e.kind == kind and not e.consumed]
+
+    def due_member(self, kind: str, window: int) -> Optional[int]:
+        """Member (serving replica) id of a `kind` fault scheduled
+        at-or-before `window`, consuming the entry; None when nothing
+        is due. An entry without an ``:mK`` qualifier targets member 0
+        — the fleet driver calls this at serving-window boundaries
+        (``replica-kill@W:mK``)."""
+        for e in self._entries:
+            if not e.consumed and e.kind == kind and e.epoch <= window:
+                e.consumed = True
+                return e.member if e.member is not None else 0
+        return None
 
     def due_in(self, kind: str, lo: int, hi: int) -> Optional[int]:
         """Epoch (clamped into [lo, hi)) of a `kind` fault targeting

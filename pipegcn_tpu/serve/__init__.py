@@ -1,8 +1,15 @@
 """Online serving runtime (docs/SERVING.md).
 
 Compiled-once sharded inference over the partitioned graph, with
-micro-batched queries, incremental halo freshness, and schema-v5
-`serving` observability. Entry point: `python -m pipegcn_tpu.cli.serve`.
+micro-batched queries, incremental halo freshness, bounded-queue load
+shedding, and schema-v7 `serving`/`fleet` observability. Entry points:
+`python -m pipegcn_tpu.cli.serve` (single mesh) and
+`python -m pipegcn_tpu.cli.fleet` (N-replica fleet with failover
+routing and zero-downtime checkpoint hot-swap).
+
+The fleet/router modules are imported lazily by their entrypoints (the
+router is jax-free; the fleet module pulls in resilience machinery) —
+import them as `pipegcn_tpu.serve.router` / `pipegcn_tpu.serve.fleet`.
 """
 
 from .batcher import (MicroBatcher, ServingStats, Ticket,  # noqa: F401
@@ -11,3 +18,4 @@ from .cache import Layer0Cache  # noqa: F401
 from .engine import ServingEngine, TRACE_COUNTS, trace_counts  # noqa: F401
 from .freshness import FreshnessTracker, dirty_exchange_blocks  # noqa: F401
 from .loadgen import OpenLoopGenerator, run_serving_loop  # noqa: F401
+from .router import FleetUnavailable, Router  # noqa: F401

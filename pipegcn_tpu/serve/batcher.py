@@ -54,9 +54,12 @@ def bucket_for(n: int, ladder: Sequence[int]) -> int:
 
 class Ticket:
     """One submitted query: node ids in, logits rows out after the
-    batch it rode in flushes."""
+    batch it rode in flushes — or ``shed=True`` when the ticket was
+    explicitly rejected (bounded queue / deadline / shutdown with no
+    serving capacity) instead of being silently dropped."""
 
-    __slots__ = ("ids", "t_submit", "result", "latency_s", "done")
+    __slots__ = ("ids", "t_submit", "result", "latency_s", "done",
+                 "shed", "shed_reason")
 
     def __init__(self, ids: np.ndarray, t_submit: float):
         self.ids = ids
@@ -64,6 +67,8 @@ class Ticket:
         self.result: Optional[np.ndarray] = None
         self.latency_s: Optional[float] = None
         self.done = False
+        self.shed = False
+        self.shed_reason: Optional[str] = None
 
 
 class MicroBatcher:
@@ -74,23 +79,56 @@ class MicroBatcher:
     `run(ids)` is called with the concatenated UNPADDED ids — padding
     to the ladder shape is the engine's job (it owns the compiled
     programs) — and `observer(bucket, n_valid, latencies_s)` fires per
-    flushed batch for stats collection."""
+    flushed batch for stats collection.
+
+    Overload protection (docs/SERVING.md "Load shedding"): with
+    ``max_queue`` set, a submit that would push the queued row count
+    past the bound is REJECTED — the ticket comes back ``shed=True``
+    immediately, bounding both memory and the tail latency of what IS
+    accepted. With ``ticket_deadline_ms`` set, tickets that have
+    already waited past the deadline at flush time are shed rather
+    than served uselessly late. Every shed fires ``on_shed(ticket,
+    reason)``; nothing is ever dropped without a record."""
 
     def __init__(self, run: Callable[[np.ndarray], np.ndarray],
                  max_batch: int = 64, max_delay_ms: float = 5.0,
                  ladder_min: int = 8,
                  clock: Callable[[], float] = time.monotonic,
-                 observer: Optional[Callable] = None):
+                 observer: Optional[Callable] = None,
+                 max_queue: Optional[int] = None,
+                 ticket_deadline_ms: Optional[float] = None,
+                 on_shed: Optional[Callable] = None):
         self._run = run
         self.ladder = bucket_ladder(ladder_min, max_batch)
         self.max_batch = self.ladder[-1]
         self.max_delay_s = max_delay_ms / 1000.0
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.deadline_s = (None if ticket_deadline_ms is None
+                           else ticket_deadline_ms / 1000.0)
         self._clock = clock
         self._observer = observer
+        self._on_shed = on_shed
         self._pending: List[Ticket] = []
         self.n_flushed_batches = 0
+        self.n_shed_tickets = 0
+        self.n_shed_rows = 0
+        self.n_served_rows = 0
+        # every row ever handed to submit(): the conservation invariant
+        # submitted == served + shed + queue_depth holds at all times,
+        # so "zero tickets silently lost" is checkable from outside
+        self.n_submitted_rows = 0
 
     # ---------------- intake ------------------------------------------
+
+    def _shed(self, t: Ticket, reason: str) -> Ticket:
+        t.shed = True
+        t.shed_reason = reason
+        t.done = True
+        self.n_shed_tickets += 1
+        self.n_shed_rows += t.ids.size
+        if self._on_shed is not None:
+            self._on_shed(t, reason)
+        return t
 
     def submit(self, node_ids) -> Ticket:
         ids = np.atleast_1d(np.asarray(node_ids, np.int64))
@@ -99,6 +137,10 @@ class MicroBatcher:
                 f"a single query of {ids.size} ids exceeds max_batch "
                 f"{self.max_batch}; split it")
         t = Ticket(ids, self._clock())
+        self.n_submitted_rows += ids.size
+        if self.max_queue is not None \
+                and self.queue_depth + ids.size > self.max_queue:
+            return self._shed(t, "queue-full")
         self._pending.append(t)
         return t
 
@@ -122,21 +164,34 @@ class MicroBatcher:
 
     # ---------------- flush -------------------------------------------
 
-    def pump(self, now: Optional[float] = None, force: bool = False) -> int:
-        """Flush every due batch (or everything with force=True);
-        returns the number of batches dispatched."""
-        n = 0
-        while self._pending and (force or self.due(now)):
-            self._flush_one()
-            n += 1
+    def _expire(self, now: float) -> int:
+        """Shed queued tickets that already waited past the deadline —
+        under overload the answer would arrive uselessly late, and
+        serving it would push every younger ticket later still."""
+        if self.deadline_s is None or not self._pending:
+            return 0
+        keep, n = [], 0
+        for t in self._pending:
+            if now - t.t_submit > self.deadline_s:
+                self._shed(t, "deadline")
+                n += 1
+            else:
+                keep.append(t)
+        self._pending = keep
         return n
 
-    def drain(self) -> int:
-        """Flush the whole queue regardless of policy (shutdown path:
-        the engine must answer every accepted query before exiting)."""
-        return self.pump(force=True)
-
-    def _flush_one(self) -> None:
+    def take_batch(self, now: Optional[float] = None,
+                   force: bool = False):
+        """Pop one due batch WITHOUT running it: returns (tickets,
+        concatenated ids) for the caller to dispatch (the fleet router
+        path, serve/fleet.py — dispatch happens on worker threads so
+        N replicas serve concurrently), or None when nothing is due.
+        Deadline-expired tickets are shed first. Finish the batch with
+        :meth:`complete_batch` (or shed every ticket explicitly)."""
+        now = self._clock() if now is None else now
+        self._expire(now)
+        if not self._pending or not (force or self.due(now)):
+            return None
         take, rows = [], 0
         while self._pending and rows + self._pending[0].ids.size \
                 <= self.max_batch:
@@ -144,21 +199,54 @@ class MicroBatcher:
             take.append(t)
             rows += t.ids.size
         if not take:  # single oversized ticket is rejected at submit
-            return
-        ids = np.concatenate([t.ids for t in take])
-        out = self._run(ids)
-        t_done = self._clock()
+            return None
+        return take, np.concatenate([t.ids for t in take])
+
+    def complete_batch(self, take: List[Ticket], out: np.ndarray,
+                       t_done: Optional[float] = None) -> None:
+        """Fill a taken batch's tickets from the concatenated result
+        rows and fire the observer. Thread-safety: per-batch state is
+        local, counters are int += under the GIL — safe for the fleet's
+        worker threads."""
+        t_done = self._clock() if t_done is None else t_done
         off = 0
         lats = []
+        rows = 0
         for t in take:
             t.result = out[off:off + t.ids.size]
             off += t.ids.size
+            rows += t.ids.size
             t.latency_s = t_done - t.t_submit
             t.done = True
             lats.extend([t.latency_s] * t.ids.size)
         self.n_flushed_batches += 1
+        self.n_served_rows += rows
         if self._observer is not None:
             self._observer(bucket_for(rows, self.ladder), rows, lats)
+
+    def shed_batch(self, take: List[Ticket],
+                   reason: str = "no-capacity") -> None:
+        """Explicitly shed a taken batch (shutdown with every replica
+        down): the tickets are answered 'no' rather than lost."""
+        for t in take:
+            self._shed(t, reason)
+
+    def pump(self, now: Optional[float] = None, force: bool = False) -> int:
+        """Flush every due batch (or everything with force=True);
+        returns the number of batches dispatched."""
+        n = 0
+        while True:
+            batch = self.take_batch(now, force=force)
+            if batch is None:
+                return n
+            take, ids = batch
+            self.complete_batch(take, self._run(ids))
+            n += 1
+
+    def drain(self) -> int:
+        """Flush the whole queue regardless of policy (shutdown path:
+        the engine must answer every accepted query before exiting)."""
+        return self.pump(force=True)
 
 
 class ServingStats:
@@ -167,6 +255,10 @@ class ServingStats:
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._clock = clock
+        # parameter-generation axis (v7): persists across windows — the
+        # served generation doesn't vanish just because a window rolled
+        self.param_generation = -1
+        self.param_staleness = 0
         self.reset()
 
     def reset(self) -> None:
@@ -178,6 +270,7 @@ class ServingStats:
         self.hits = 0
         self.misses = 0
         self.max_staleness = 0
+        self.n_shed = 0
 
     # fed by MicroBatcher's observer hook
     def note_batch(self, bucket: int, n_valid: int,
@@ -194,6 +287,15 @@ class ServingStats:
         else:
             self.misses += int(n)
         self.max_staleness = max(self.max_staleness, int(staleness_age))
+
+    # fed by MicroBatcher's on_shed hook (ticket, reason)
+    def note_shed(self, ticket, reason: str = "") -> None:
+        self.n_shed += int(ticket.ids.size)
+
+    # fed by the checkpoint watcher / engine after a (non-)swap
+    def note_params(self, generation: int, staleness: int = 0) -> None:
+        self.param_generation = int(generation)
+        self.param_staleness = int(staleness)
 
     def snapshot(self, queue_depth: int = 0, reset: bool = True) -> dict:
         """One `serving` record's worth of fields; resets the window."""
@@ -213,6 +315,9 @@ class ServingStats:
             "cache_hit_rate": (float(self.hits / served)
                                if served else None),
             "staleness_age": int(self.max_staleness),
+            "shed": int(self.n_shed),
+            "param_generation": int(self.param_generation),
+            "param_staleness": int(self.param_staleness),
         }
         if reset:
             self.reset()

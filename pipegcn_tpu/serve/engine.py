@@ -46,6 +46,8 @@ from ..obs.trace import named_phase
 from ..parallel.halo import exchange_blocks, halo_exchange
 from ..parallel.mesh import PARTS_AXIS
 from ..parallel.trainer import _pad_cols
+from ..utils.checkpoint import (CheckpointCorrupt, _generations,
+                                load_checkpoint)
 from .batcher import MicroBatcher, ServingStats, bucket_for, bucket_ladder
 from .cache import Layer0Cache
 from .freshness import FreshnessTracker, dirty_exchange_blocks
@@ -94,6 +96,12 @@ class ServingEngine:
         self.ladder = bucket_ladder(ladder_min, max_batch)
         self.update_ladder = bucket_ladder(ladder_min, max_update_rows)
         self.params_version = 0
+        # parameter-generation axis (schema v7): the checkpoint epoch
+        # the served params came from (-1 = fresh init), and how many
+        # newer PUBLISHED generations the fleet has not swapped in yet
+        self.param_generation = -1
+        self.param_staleness = 0
+        self._last_corrupt_gen = -1  # dedupe corrupt-gen fault records
 
         # ---------------- host-side routing ---------------------------
         # global nid -> (partition, local row); -1 rows are padding
@@ -254,14 +262,91 @@ class ServingEngine:
 
     # ---------------- params / warmup ---------------------------------
 
-    def load_params(self, params=None, norm=None) -> None:
+    def load_params(self, params=None, norm=None,
+                    generation: Optional[int] = None) -> None:
         """Swap serving weights (e.g. after a checkpoint restore on the
-        trainer); logits are stale until the next refresh()."""
+        trainer); logits are stale until the next refresh().
+        `generation` records the checkpoint epoch the params came from
+        (the v7 parameter-generation axis on serving records)."""
         self._params = self.trainer.state["params"] \
             if params is None else params
         self._norm = self.trainer.state["norm"] if norm is None else norm
         self.params_version += 1
         self._logits = None
+        if generation is not None:
+            self.param_generation = int(generation)
+
+    def load_from_checkpoint(self, directory: str, ml=None) -> Dict:
+        """CRC-hardened zero-downtime weight swap from a checkpoint
+        directory (the fleet hot-swap path, docs/SERVING.md "Fleet").
+
+        Loads only the serving subset {params, norm} of the newest
+        generation that passes digest verification (load_pytree reads
+        only the template's paths, so optimizer moments never leave
+        disk). A corrupt/truncated newest generation walks back to an
+        older good one — and if nothing newer than what we already
+        serve survives verification, the OLD params keep serving and a
+        ``serve-ckpt-corrupt`` fault record is emitted (once per bad
+        generation, not once per poll). Returns a swap report:
+        {swapped, param_generation, param_staleness, swap_ms?, reason?}.
+        """
+        newest = max((e for e, _ in _generations(directory) if e >= 0),
+                     default=-1)
+        t0 = time.monotonic()
+        template = {"params": self._params, "norm": self._norm}
+        try:
+            state, epoch = load_checkpoint(directory, template)
+        except FileNotFoundError:
+            return {"swapped": False, "reason": "no-checkpoint",
+                    "param_generation": self.param_generation,
+                    "param_staleness": self.param_staleness}
+        except CheckpointCorrupt as exc:
+            self.param_staleness = sum(
+                1 for e, _ in _generations(directory)
+                if e > self.param_generation)
+            if ml is not None and newest != self._last_corrupt_gen:
+                ml.fault("serve-ckpt-corrupt", epoch=newest,
+                         reason=str(exc)[:200])
+            self._last_corrupt_gen = newest
+            return {"swapped": False, "reason": "all-corrupt",
+                    "param_generation": self.param_generation,
+                    "param_staleness": self.param_staleness}
+        # count published generations the served params still trail
+        stale_after = sum(1 for e, _ in _generations(directory)
+                          if e > epoch)
+        if epoch <= self.param_generation:
+            # nothing newer was READABLE; if something newer was
+            # PUBLISHED, the newest generation(s) failed verification
+            self.param_staleness = sum(
+                1 for e, _ in _generations(directory)
+                if e > self.param_generation)
+            if newest > self.param_generation:
+                if ml is not None and newest != self._last_corrupt_gen:
+                    ml.fault("serve-ckpt-corrupt", epoch=newest,
+                             reason="newest generation failed "
+                                    "verification; kept serving "
+                                    f"generation {self.param_generation}")
+                self._last_corrupt_gen = newest
+                reason = "newer-generation-corrupt"
+            else:
+                reason = "no-newer-generation"
+            return {"swapped": False, "reason": reason,
+                    "param_generation": self.param_generation,
+                    "param_staleness": self.param_staleness}
+        if epoch < newest and ml is not None \
+                and newest != self._last_corrupt_gen:
+            # walked back: swapping to an older-than-newest good gen
+            ml.fault("serve-ckpt-corrupt", epoch=newest,
+                     reason=f"walked back to generation {epoch}")
+            self._last_corrupt_gen = newest
+        self.load_params(state["params"], state["norm"],
+                         generation=epoch)
+        self.refresh()  # retrace-free: same shapes, compiled programs
+        swap_ms = (time.monotonic() - t0) * 1000.0
+        self.param_staleness = stale_after
+        return {"swapped": True, "param_generation": epoch,
+                "param_staleness": stale_after,
+                "swap_ms": float(swap_ms)}
 
     def warmup(self, buckets=None) -> float:
         """Trace the refresh program and every query-ladder bucket so
@@ -380,13 +465,19 @@ class ServingEngine:
         self.cache.record_queries(ids.size, hit)
         if stats is not None:
             stats.note_serve(ids.size, hit, self.staleness_age)
+            stats.note_params(self.param_generation, self.param_staleness)
         return out
 
     def make_batcher(self, stats: Optional[ServingStats] = None,
                      max_delay_ms: float = 5.0,
-                     clock=time.monotonic) -> MicroBatcher:
+                     clock=time.monotonic,
+                     max_queue: Optional[int] = None,
+                     ticket_deadline_ms: Optional[float] = None
+                     ) -> MicroBatcher:
         return MicroBatcher(
             run=lambda ids: self.query(ids, stats=stats),
             max_batch=self.ladder[-1], max_delay_ms=max_delay_ms,
             ladder_min=self.ladder[0], clock=clock,
-            observer=stats.note_batch if stats is not None else None)
+            observer=stats.note_batch if stats is not None else None,
+            max_queue=max_queue, ticket_deadline_ms=ticket_deadline_ms,
+            on_shed=stats.note_shed if stats is not None else None)

@@ -1,0 +1,914 @@
+"""Replicated multi-mesh serving fleet (docs/SERVING.md "Fleet").
+
+N serving replicas, each a FULL sharded-graph mesh in its own OS
+process (the same virtual-device trick the trainer uses makes a
+CPU-mesh replica real enough to SIGKILL in tests), fronted by the
+jax-free :class:`~.router.Router`. The pieces, by where they run:
+
+  replica process (cli/fleet.py --replica-id K)
+    ReplicaServer — wraps a ServingEngine behind a tiny
+    length-prefixed-JSON TCP protocol (query/health/stop), binds port
+    0 and publishes the real port through an atomic readiness file,
+    beats a generation-keyed heartbeat file (the PR-11 machinery:
+    HeartbeatWatchdog with n_ranks=1, generation=incarnation — a
+    relaunched replica's beats can never be mistaken for its previous
+    life's), and runs the zero-downtime checkpoint watcher: poll for a
+    new CRC-verified generation, `load_from_checkpoint` under the
+    engine lock (queries drain / briefly block — the measured
+    `param_swap_ms` blip), never retracing (same shapes, same
+    compiled programs).
+
+  driver process (cli/fleet.py / bench.py --serve --replicas N)
+    FleetManager — launches and supervises the replica subprocesses
+    (RestartPolicy's backoff/cap/storm brakes, reused from the elastic
+    supervisor), detects death by subprocess exit AND heartbeat
+    staleness, relaunches with a bumped incarnation, and folds the
+    rejoined replica back into the router.
+    run_fleet_loop — the open-loop load loop: a driver-side
+    MicroBatcher accumulates tickets (bounded queue + deadline load
+    shedding), worker threads dispatch taken batches through the
+    router (so N replicas serve concurrently — aggregate QPS scales
+    near-linearly), failed batches retry against survivors, and a
+    batch the whole fleet cannot answer is shed EXPLICITLY — the
+    conservation invariant submitted == served + shed + queued holds
+    at every instant, so "zero accepted tickets lost" is checkable
+    from outside.
+
+Transport is stdlib-only: '>I' length prefix + JSON, logits as base64
+float32. One persistent connection per replica, one in-flight request
+per connection (guarded by the client's lock).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import socket
+import socketserver
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .batcher import MicroBatcher, ServingStats
+from .loadgen import OpenLoopGenerator
+from .router import FleetUnavailable, Router
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+_LEN = struct.Struct(">I")
+_MAX_MSG = 64 << 20  # 64 MiB: a torn/hostile length prefix must not OOM us
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_MSG:
+        raise ConnectionError(f"message length {n} exceeds cap")
+    return json.loads(_recv_exact(sock, n))
+
+
+def _encode_f32(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr, np.float32)
+    return {"shape": list(arr.shape),
+            "b64": base64.b64encode(arr.tobytes()).decode()}
+
+
+def _decode_f32(d: dict) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(d["b64"]),
+                         np.float32).reshape(d["shape"]).copy()
+
+
+# ---------------------------------------------------------------------------
+# readiness files
+# ---------------------------------------------------------------------------
+
+def _ready_path(fleet_dir: str, replica: int) -> str:
+    return os.path.join(fleet_dir, f"replica-m{replica}.json")
+
+
+def _write_ready(fleet_dir: str, replica: int, incarnation: int,
+                 port: int) -> None:
+    """Atomic publish: the manager must never read a torn port."""
+    path = _ready_path(fleet_dir, replica)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({"replica": int(replica),
+                   "incarnation": int(incarnation),
+                   "port": int(port), "pid": os.getpid(),
+                   "t_ready": time.time()}, f)
+    os.replace(tmp, path)
+
+
+def _read_ready(fleet_dir: str, replica: int) -> Optional[dict]:
+    try:
+        with open(_ready_path(fleet_dir, replica)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _heartbeat_path(fleet_dir: str, replica: int,
+                    incarnation: int) -> str:
+    # the HeartbeatWatchdog naming: heartbeat-g<generation>-r<rank>,
+    # keyed on the replica's incarnation so a relaunch never reads its
+    # previous life's beats as fresh
+    return os.path.join(fleet_dir,
+                        f"heartbeat-g{incarnation}-r{replica}")
+
+
+# ---------------------------------------------------------------------------
+# replica process
+# ---------------------------------------------------------------------------
+
+class ReplicaServer:
+    """One serving replica: engine + TCP endpoint + heartbeats +
+    checkpoint hot-swap watcher. Runs in its own process; everything
+    that touches the engine holds `self._lock` (queries, swaps), so a
+    hot-swap drains in-flight queries and in-flight queries never see
+    half-swapped params."""
+
+    def __init__(self, engine, fleet_dir: str, replica_id: int,
+                 incarnation: int = 0, ml=None,
+                 checkpoint_dir: Optional[str] = None,
+                 swap_poll_s: float = 0.5,
+                 heartbeat_interval_s: float = 0.2,
+                 report_every_s: float = 2.0,
+                 log: Callable[[str], None] = print):
+        from ..resilience.coord import HeartbeatWatchdog
+
+        self.engine = engine
+        self.fleet_dir = fleet_dir
+        self.replica_id = int(replica_id)
+        self.incarnation = int(incarnation)
+        self.ml = ml
+        self.checkpoint_dir = checkpoint_dir
+        self.swap_poll_s = float(swap_poll_s)
+        self.report_every_s = float(report_every_s)
+        self.log = log
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.stats = ServingStats()
+        self.n_queries = 0
+        os.makedirs(fleet_dir, exist_ok=True)
+        # n_ranks=1: this watchdog only BEATS (no peers to watch) —
+        # liveness judgment is the driver-side manager's job
+        self._hb = HeartbeatWatchdog(
+            fleet_dir, rank=self.replica_id, n_ranks=1,
+            timeout_s=60.0, interval_s=heartbeat_interval_s,
+            generation=self.incarnation, log=log)
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._threads: List[threading.Thread] = []
+
+    # ---------------- request handling --------------------------------
+
+    def _handle(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "query":
+            ids = np.asarray(msg["ids"], np.int64)
+            with self._lock:
+                out = self.engine.query(ids, stats=self.stats)
+                meta = {
+                    "hit": bool(self.engine.fully_fresh),
+                    "staleness_age": int(self.engine.staleness_age),
+                    "param_generation": int(self.engine.param_generation),
+                    "param_staleness": int(self.engine.param_staleness),
+                    "incarnation": self.incarnation,
+                }
+            self.n_queries += int(ids.size)
+            return {"ok": True, "logits": _encode_f32(out), "meta": meta}
+        if op == "health":
+            with self._lock:
+                return {"ok": True, "replica": self.replica_id,
+                        "incarnation": self.incarnation,
+                        "pid": os.getpid(),
+                        "param_generation":
+                            int(self.engine.param_generation),
+                        "param_staleness":
+                            int(self.engine.param_staleness),
+                        "n_queries": int(self.n_queries)}
+        if op == "stop":
+            self._stop.set()
+            return {"ok": True, "stopping": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # ---------------- background threads ------------------------------
+
+    def _swap_loop(self) -> None:
+        while not self._stop.wait(self.swap_poll_s):
+            self.poll_checkpoint()
+
+    def poll_checkpoint(self) -> Optional[dict]:
+        """One checkpoint-watcher step: hot-swap if a newer verified
+        generation exists. Public so tests can drive it without the
+        thread. Returns the swap report when a swap happened."""
+        if not self.checkpoint_dir:
+            return None
+        with self._lock:
+            rep = self.engine.load_from_checkpoint(
+                self.checkpoint_dir, ml=self.ml)
+        if rep.get("swapped"):
+            self.stats.note_params(rep["param_generation"],
+                                   rep.get("param_staleness", 0))
+            if self.ml is not None:
+                self.ml.fleet("hot-swap", self.replica_id,
+                              param_generation=rep["param_generation"],
+                              swap_ms=rep["swap_ms"],
+                              incarnation=self.incarnation)
+            self.log(f"replica {self.replica_id}: hot-swapped to "
+                     f"generation {rep['param_generation']} in "
+                     f"{rep['swap_ms']:.0f}ms")
+            return rep
+        if rep.get("reason") in ("all-corrupt",
+                                 "newer-generation-corrupt") \
+                and self.ml is not None:
+            self.ml.fleet("swap-rejected", self.replica_id,
+                          reason=rep["reason"],
+                          incarnation=self.incarnation)
+        return None
+
+    def _report_loop(self) -> None:
+        while not self._stop.wait(self.report_every_s):
+            self._emit_window()
+
+    def _emit_window(self, final: bool = False) -> None:
+        if self.ml is None:
+            return
+        rec = self.stats.snapshot(queue_depth=0)
+        extra = {"replica": self.replica_id,
+                 "incarnation": self.incarnation}
+        if final:
+            extra["final"] = True
+        self.ml.serving(**rec, **extra)
+
+    # ---------------- lifecycle ---------------------------------------
+
+    def serve_forever(self, host: str = "127.0.0.1") -> None:
+        """Bind port 0, publish readiness, serve until a stop op or
+        SIGTERM; drains in-flight requests, emits a final serving
+        record, and returns."""
+        handler_self = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # one persistent connection
+                while True:
+                    try:
+                        msg = _recv_msg(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        resp = handler_self._handle(msg)
+                    except Exception as exc:  # noqa: BLE001
+                        resp = {"ok": False,
+                                "error": f"{type(exc).__name__}: {exc}"}
+                    try:
+                        _send_msg(self.request, resp)
+                    except OSError:
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, 0), _Handler)
+        port = self._server.server_address[1]
+        self._hb.start()
+        for target, name in ((self._swap_loop, "swap"),
+                             (self._report_loop, "report")):
+            t = threading.Thread(
+                target=target, daemon=True,
+                name=f"replica-{self.replica_id}-{name}")
+            t.start()
+            self._threads.append(t)
+        srv = threading.Thread(target=self._server.serve_forever,
+                               kwargs={"poll_interval": 0.05},
+                               daemon=True,
+                               name=f"replica-{self.replica_id}-srv")
+        srv.start()
+        _write_ready(self.fleet_dir, self.replica_id, self.incarnation,
+                     port)
+        self.log(f"replica {self.replica_id} (incarnation "
+                 f"{self.incarnation}) serving on port {port}")
+        try:
+            while not self._stop.wait(0.1):
+                pass
+        finally:
+            self.shutdown()
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self._hb.suspend()
+        self._emit_window(final=True)
+        if self.ml is not None:
+            self.ml.hard_flush()
+
+
+# ---------------------------------------------------------------------------
+# driver-side client
+# ---------------------------------------------------------------------------
+
+class ReplicaError(ConnectionError):
+    """The replica did not answer (dead, closing, or protocol error)."""
+
+
+class TcpReplicaClient:
+    """One persistent connection to a replica; thread-safe (one
+    request in flight per connection). `query` returns
+    ``(logits, meta)`` — the router passes the result through
+    opaquely."""
+
+    def __init__(self, host: str, port: int, replica_id: int,
+                 timeout_s: float = 10.0):
+        self.host = host
+        self.port = int(port)
+        self.replica_id = int(replica_id)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _rpc(self, msg: dict) -> dict:
+        with self._lock:
+            try:
+                s = self._ensure()
+                _send_msg(s, msg)
+                resp = _recv_msg(s)
+            except (OSError, ValueError, ConnectionError) as exc:
+                self._drop()
+                raise ReplicaError(
+                    f"replica {self.replica_id} at "
+                    f"{self.host}:{self.port}: {exc}") from exc
+        if not resp.get("ok"):
+            raise ReplicaError(
+                f"replica {self.replica_id} error: "
+                f"{resp.get('error', 'unknown')}")
+        return resp
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def query(self, ids: np.ndarray):
+        resp = self._rpc({"op": "query",
+                          "ids": np.asarray(ids, np.int64).tolist()})
+        return _decode_f32(resp["logits"]), resp.get("meta", {})
+
+    def health(self) -> dict:
+        return self._rpc({"op": "health"})
+
+    def stop(self) -> None:
+        try:
+            self._rpc({"op": "stop"})
+        except ReplicaError:
+            pass
+
+    def reconnect(self, port: int) -> None:
+        """Point at a relaunched incarnation's new port."""
+        with self._lock:
+            self._drop()
+            self.port = int(port)
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+# ---------------------------------------------------------------------------
+# driver-side supervisor
+# ---------------------------------------------------------------------------
+
+def _popen_logged(cmd: List[str], env: Dict[str, str], log_path: str):
+    logf = open(log_path, "ab")
+    try:
+        return subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf,
+                                start_new_session=True)
+    finally:
+        logf.close()
+
+
+class _Replica:
+    """Manager-side view of one replica slot."""
+
+    def __init__(self, rid: int):
+        self.rid = rid
+        self.incarnation = 0
+        self.proc = None
+        self.client: Optional[TcpReplicaClient] = None
+        self.up = False
+        self.relaunch_at: Optional[float] = None  # backoff deadline
+        self.died_at: Optional[float] = None
+        self.launched_at: Optional[float] = None
+        self.gave_up = False
+
+
+class FleetManager:
+    """Launch, watch, and relaunch the replica subprocesses.
+
+    Death is detected two ways — subprocess exit (fast) and heartbeat
+    staleness (catches a wedged-but-alive process) — and each death
+    runs through a per-replica :class:`RestartPolicy` (exponential
+    backoff, lifetime cap, restart-storm brake: the elastic
+    supervisor's brakes, reused). `poll(router)` is the one
+    entrypoint the load loop calls; it marks the router down/up and
+    emits the contracted `fleet` + fault/recovery records."""
+
+    def __init__(self, fleet_dir: str, n_replicas: int,
+                 child_args: List[str], *,
+                 ml=None,
+                 env: Optional[Dict[str, str]] = None,
+                 heartbeat_timeout_s: float = 3.0,
+                 ready_timeout_s: float = 120.0,
+                 max_restarts: int = 4,
+                 backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 5.0,
+                 popen: Callable = _popen_logged,
+                 log: Callable[[str], None] = print):
+        from ..resilience.elastic import RestartPolicy
+
+        self.fleet_dir = os.path.abspath(fleet_dir)
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self.n_replicas = int(n_replicas)
+        self.child_args = list(child_args)
+        self.ml = ml
+        self.env = dict(env if env is not None else os.environ)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.popen = popen
+        self.log = log
+        self.replicas = {rid: _Replica(rid)
+                         for rid in range(self.n_replicas)}
+        self._policies = {rid: RestartPolicy(
+            max_restarts=max_restarts, backoff_base_s=backoff_base_s,
+            backoff_max_s=backoff_max_s)
+            for rid in range(self.n_replicas)}
+        self.window = -1  # updated by the load loop for record context
+
+    # ---------------- launch ------------------------------------------
+
+    def _cmd(self, rep: _Replica) -> List[str]:
+        # manager flags LAST so they win argparse's last-occurrence
+        # rule over anything in the forwarded driver argv
+        return [sys.executable, "-m", "pipegcn_tpu.cli.fleet"] \
+            + self.child_args \
+            + ["--replica-id", str(rep.rid),
+               "--incarnation", str(rep.incarnation),
+               "--fleet-dir", self.fleet_dir]
+
+    def launch(self, rid: int) -> None:
+        rep = self.replicas[rid]
+        # retire the previous incarnation's readiness file so
+        # wait_ready can never read a stale port
+        try:
+            os.remove(_ready_path(self.fleet_dir, rid))
+        except OSError:
+            pass
+        log_path = os.path.join(
+            self.fleet_dir, f"replica-m{rid}-i{rep.incarnation}.log")
+        rep.proc = self.popen(self._cmd(rep), self.env, log_path)
+        rep.launched_at = time.monotonic()
+        rep.relaunch_at = None
+        self.log(f"fleet: launched replica {rid} incarnation "
+                 f"{rep.incarnation} (pid {rep.proc.pid})")
+
+    def wait_ready(self, rid: int,
+                   timeout_s: Optional[float] = None) -> dict:
+        """Block until replica rid's CURRENT incarnation publishes its
+        readiness file; returns it. Raises TimeoutError (or
+        RuntimeError if the child exited) on failure."""
+        rep = self.replicas[rid]
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.ready_timeout_s)
+        while time.monotonic() < deadline:
+            info = _read_ready(self.fleet_dir, rid)
+            if info and info.get("incarnation") == rep.incarnation:
+                return info
+            if rep.proc is not None and rep.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {rid} exited rc={rep.proc.returncode} "
+                    f"before becoming ready (see its log in "
+                    f"{self.fleet_dir})")
+            time.sleep(0.05)
+        raise TimeoutError(f"replica {rid} not ready within "
+                           f"{timeout_s or self.ready_timeout_s}s")
+
+    def launch_all(self) -> Dict[int, TcpReplicaClient]:
+        """Launch every replica, wait for readiness, build clients.
+        Returns {rid: client} for the Router."""
+        for rid in self.replicas:
+            self.launch(rid)
+        clients = {}
+        for rid, rep in self.replicas.items():
+            info = self.wait_ready(rid)
+            rep.client = TcpReplicaClient("127.0.0.1", info["port"], rid)
+            rep.up = True
+            clients[rid] = rep.client
+        return clients
+
+    # ---------------- liveness ----------------------------------------
+
+    def _heartbeat_stale(self, rep: _Replica) -> bool:
+        path = _heartbeat_path(self.fleet_dir, rep.rid, rep.incarnation)
+        try:
+            age = time.time() - os.path.getmtime(path)
+        except OSError:
+            # no beat yet: judge from launch grace instead
+            if rep.launched_at is None:
+                return False
+            return (time.monotonic() - rep.launched_at
+                    > self.heartbeat_timeout_s + self.ready_timeout_s)
+        return age > self.heartbeat_timeout_s
+
+    def _on_death(self, rep: _Replica, reason: str,
+                  router: Optional[Router]) -> None:
+        rep.up = False
+        rep.died_at = time.monotonic()
+        if rep.proc is not None and rep.proc.poll() is None:
+            # wedged-but-alive (heartbeat silence): cull it so the
+            # relaunch never races a zombie still holding the port
+            try:
+                rep.proc.kill()
+            except OSError:
+                pass
+        if router is not None:
+            # the router's on_fault hook (wired in cli/fleet.py) emits
+            # the replica-dead + fault records exactly once per death
+            # edge, whether the router's dispatch or this supervisor
+            # noticed first
+            router.mark_down(rep.rid, reason)
+        elif self.ml is not None:
+            # no router (standalone manager): emit the dual records —
+            # the contracted fleet event AND a fault record with
+            # kind="fleet" so existing fault rollups count it
+            self.ml.fleet("replica-dead", rep.rid, window=self.window,
+                          reason=reason, incarnation=rep.incarnation)
+            self.ml.fault("fleet", epoch=self.window, rank=rep.rid,
+                          reason=reason)
+        pol = self._policies[rep.rid]
+        if rep.launched_at is not None:
+            pol.note_stable(time.monotonic() - rep.launched_at)
+        dec = pol.decide()
+        if dec.action != "restart":
+            rep.gave_up = True
+            self.log(f"fleet: replica {rep.rid} NOT relaunched "
+                     f"({dec.reason}); degraded to "
+                     f"{sum(r.up for r in self.replicas.values())} "
+                     f"replicas")
+            return
+        rep.incarnation += 1
+        rep.relaunch_at = time.monotonic() + dec.delay_s
+        if self.ml is not None:
+            self.ml.fleet("relaunch", rep.rid, window=self.window,
+                          incarnation=rep.incarnation,
+                          delay_s=dec.delay_s)
+        self.log(f"fleet: replica {rep.rid} dead ({reason}); relaunch "
+                 f"as incarnation {rep.incarnation} in "
+                 f"{dec.delay_s:.1f}s")
+
+    def poll(self, router: Optional[Router] = None) -> None:
+        """One supervision step: detect deaths, run due relaunches,
+        fold ready rejoins back into the router."""
+        for rep in self.replicas.values():
+            if rep.gave_up:
+                continue
+            if rep.up:
+                if rep.proc is not None and rep.proc.poll() is not None:
+                    self._on_death(
+                        rep, f"exit rc={rep.proc.returncode}", router)
+                elif self._heartbeat_stale(rep):
+                    self._on_death(rep, "heartbeat-stale", router)
+                continue
+            # down: launch when the backoff expires...
+            if rep.relaunch_at is not None \
+                    and time.monotonic() >= rep.relaunch_at:
+                self.launch(rep.rid)
+            # ...and rejoin once the new incarnation publishes
+            if rep.proc is not None and rep.relaunch_at is None:
+                info = _read_ready(self.fleet_dir, rep.rid)
+                if info and info.get("incarnation") == rep.incarnation:
+                    if rep.client is None:
+                        rep.client = TcpReplicaClient(
+                            "127.0.0.1", info["port"], rep.rid)
+                    else:
+                        rep.client.reconnect(info["port"])
+                    rep.up = True
+                    latency = (time.monotonic() - rep.died_at
+                               if rep.died_at is not None else 0.0)
+                    if router is not None:
+                        router.mark_up(rep.rid)
+                    if self.ml is not None:
+                        self.ml.fleet(
+                            "replica-rejoin", rep.rid,
+                            window=self.window,
+                            incarnation=rep.incarnation,
+                            rejoin_latency_s=latency)
+                        self.ml.recovery("fleet", epoch=self.window,
+                                         rank=rep.rid,
+                                         incarnation=rep.incarnation)
+                    self.log(f"fleet: replica {rep.rid} rejoined as "
+                             f"incarnation {rep.incarnation} after "
+                             f"{latency:.1f}s")
+                elif rep.proc.poll() is not None:
+                    # relaunch died before readiness: another strike
+                    self._on_death(
+                        rep, f"exit rc={rep.proc.returncode} before "
+                             f"ready", router)
+
+    # ---------------- chaos / shutdown --------------------------------
+
+    def kill_replica(self, rid: int) -> None:
+        """SIGKILL, no warning — the replica-kill@W[:mK] chaos fault."""
+        rep = self.replicas[rid]
+        if rep.proc is not None and rep.proc.poll() is None:
+            self.log(f"fleet: CHAOS SIGKILL replica {rid} "
+                     f"(pid {rep.proc.pid})")
+            try:
+                os.kill(rep.proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+    def stop_all(self, timeout_s: float = 10.0) -> None:
+        """Graceful stop: protocol stop op, then SIGTERM, then
+        SIGKILL."""
+        for rep in self.replicas.values():
+            if rep.client is not None:
+                rep.client.stop()
+        deadline = time.monotonic() + timeout_s
+        for rep in self.replicas.values():
+            if rep.proc is None:
+                continue
+            if rep.proc.poll() is None:
+                try:
+                    rep.proc.terminate()
+                except OSError:
+                    pass
+            while rep.proc.poll() is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if rep.proc.poll() is None:
+                try:
+                    rep.proc.kill()
+                except OSError:
+                    pass
+                rep.proc.wait()
+        for rep in self.replicas.values():
+            if rep.client is not None:
+                rep.client.close()
+        if self.ml is not None:
+            self.ml.fleet("fleet-stop", -1, window=self.window,
+                          reason="shutdown")
+
+
+# ---------------------------------------------------------------------------
+# the fleet load loop
+# ---------------------------------------------------------------------------
+
+def run_fleet_loop(manager: FleetManager, router: Router, *,
+                   num_nodes: int, duration_s: float, qps: float,
+                   max_batch: int = 64, max_delay_ms: float = 5.0,
+                   ladder_min: int = 8,
+                   ids_per_query: int = 1,
+                   report_every_s: float = 2.0,
+                   max_queue: Optional[int] = None,
+                   ticket_deadline_ms: Optional[float] = None,
+                   seed: int = 0, ml=None,
+                   fault_plan=None,
+                   poll_every_s: float = 0.1,
+                   stop: Optional[Callable[[], bool]] = None,
+                   clock: Callable[[], float] = time.monotonic,
+                   sleep: Callable[[float], None] = time.sleep) -> dict:
+    """Open-loop load over the fleet; returns the aggregate summary.
+
+    The driver-side MicroBatcher does the queueing (bounded queue +
+    deadline shedding); worker threads pull taken batches off an
+    internal dispatch queue and push them through the router, so
+    batches flow to every up replica concurrently. A serving window
+    closes every `report_every_s`: an aggregated `serving` record is
+    emitted, per-replica depth/shed counters are sampled, the
+    supervision poll runs, and any `replica-kill@W[:mK]` fault due at
+    that window boundary fires (windows are 1-indexed: window 1 is
+    the first report)."""
+    import queue as _queue
+
+    stats = ServingStats(clock)
+    all_lat: List[float] = []
+    fills: List[float] = []
+    lat_lock = threading.Lock()
+
+    def observer(bucket, n_valid, lats):
+        with lat_lock:
+            stats.note_batch(bucket, n_valid, lats)
+            all_lat.extend(lats)
+            fills.append(n_valid / bucket)
+
+    batcher = MicroBatcher(
+        run=lambda ids: (_ for _ in ()).throw(
+            RuntimeError("fleet loop dispatches via the router")),
+        max_batch=max_batch, max_delay_ms=max_delay_ms,
+        ladder_min=ladder_min, clock=clock, observer=observer,
+        max_queue=max_queue, ticket_deadline_ms=ticket_deadline_ms,
+        on_shed=stats.note_shed)
+
+    work: "_queue.Queue" = _queue.Queue()
+    n_fleet_shed = 0
+    window = [0]  # 1-indexed once the first report window closes
+
+    def worker():
+        nonlocal n_fleet_shed
+        while True:
+            item = work.get()
+            if item is None:
+                work.task_done()
+                return
+            take, ids = item
+            try:
+                res, rid = router.dispatch(ids)
+                out, meta = (res if isinstance(res, tuple)
+                             else (res, {}))
+                batcher.complete_batch(take, np.asarray(out))
+                with lat_lock:
+                    stats.note_serve(
+                        int(ids.size), bool(meta.get("hit", False)),
+                        int(meta.get("staleness_age", 0)))
+                    stats.note_params(
+                        int(meta.get("param_generation", -1)),
+                        int(meta.get("param_staleness", 0)))
+            except FleetUnavailable:
+                # the whole fleet is down / timed out: the batch is
+                # answered 'shed', never silently lost (the shed count
+                # lands in the serving records)
+                batcher.shed_batch(take, "fleet-down")
+                n_fleet_shed += int(ids.size)
+            except Exception as exc:  # noqa: BLE001 — never lose a batch
+                batcher.shed_batch(take, f"error:{type(exc).__name__}")
+                manager.log(f"fleet: dispatch error: {exc}")
+            finally:
+                work.task_done()
+
+    n_workers = max(2, 2 * manager.n_replicas)
+    workers = [threading.Thread(target=worker, daemon=True,
+                                name=f"fleet-worker-{i}")
+               for i in range(n_workers)]
+    for w in workers:
+        w.start()
+
+    gen = OpenLoopGenerator(num_nodes, qps, duration_s,
+                            ids_per_query=ids_per_query, seed=seed)
+    t0 = clock()
+    next_report = t0 + report_every_s
+    next_poll = t0 + poll_every_s
+    n_records = 0
+    total_q = 0
+    kills: List[dict] = []
+    per_replica_depth_max: Dict[int, int] = {
+        rid: 0 for rid in manager.replicas}
+
+    def emit(now, final=False):
+        nonlocal n_records, total_q
+        rec = stats.snapshot(
+            queue_depth=batcher.queue_depth + work.qsize())
+        total_q += rec["queries"]
+        depths = router.queue_depths()
+        for rid, d in depths.items():
+            per_replica_depth_max[rid] = max(
+                per_replica_depth_max.get(rid, 0), d)
+        if ml is not None:
+            extra = {"replicas_up": len(router.up_replicas()),
+                     "window": window[0]}
+            if final:
+                extra["final"] = True
+            ml.serving(**rec, **extra)
+        n_records += 1
+
+    def tick(now):
+        nonlocal next_report, next_poll
+        if now >= next_poll:
+            manager.poll(router)
+            next_poll = now + poll_every_s
+        if now >= next_report:
+            window[0] += 1
+            manager.window = window[0]
+            emit(now)
+            next_report = now + report_every_s
+            if fault_plan is not None:
+                rid = fault_plan.due_member("replica-kill", window[0])
+                if rid is not None and rid in manager.replicas:
+                    manager.kill_replica(rid)
+                    kills.append({"window": window[0], "replica": rid})
+
+    def maybe_dispatch(now, force=False):
+        while True:
+            batch = batcher.take_batch(now, force=force)
+            if batch is None:
+                return
+            work.put(batch)
+
+    stopped = False
+    for t_arr, q in zip(gen.arrivals, gen.queries):
+        if stop is not None and stop():
+            stopped = True
+            break
+        target = t0 + t_arr
+        while True:
+            now = clock()
+            if now >= target:
+                break
+            maybe_dispatch(now)
+            tick(now)
+            if stop is not None and stop():
+                stopped = True
+                break
+            sleep(min(target - now, 0.0005))
+        if stopped:
+            break
+        batcher.submit(q)
+        now = clock()
+        maybe_dispatch(now)
+        tick(now)
+
+    # shutdown: every accepted ticket is dispatched (and served by a
+    # survivor or EXPLICITLY shed), the workers drain, then the final
+    # aggregated record lands hard-flushed
+    maybe_dispatch(clock(), force=True)
+    work.join()
+    for _ in workers:
+        work.put(None)
+    work.join()
+    for w in workers:
+        w.join(timeout=5.0)
+    manager.poll(router)
+    emit(clock(), final=True)
+
+    with lat_lock:
+        lat = np.asarray(all_lat, np.float64) * 1000.0
+        fill = float(np.mean(fills)) if fills else None
+    dt = max(clock() - t0, 1e-9)
+    conserved = (batcher.n_submitted_rows
+                 == batcher.n_served_rows + batcher.n_shed_rows
+                 + batcher.queue_depth)
+    return {
+        "qps": float(total_q / dt),
+        "n_queries": int(total_q),
+        "duration_s": float(dt),
+        "p50_ms": float(np.percentile(lat, 50)) if lat.size else None,
+        "p95_ms": float(np.percentile(lat, 95)) if lat.size else None,
+        "p99_ms": float(np.percentile(lat, 99)) if lat.size else None,
+        "batch_fill": fill,
+        "n_records": int(n_records),
+        "n_submitted": int(batcher.n_submitted_rows),
+        "n_served": int(batcher.n_served_rows),
+        "n_shed": int(batcher.n_shed_rows),
+        "n_fleet_shed": int(n_fleet_shed),
+        "n_failovers": int(router.n_failovers),
+        "n_retried_rows": int(router.n_retried_rows),
+        "replicas_up": len(router.up_replicas()),
+        "per_replica_dispatched": {
+            str(k): int(v) for k, v in router.n_dispatched.items()},
+        "per_replica_queue_depth_max": {
+            str(k): int(v) for k, v in per_replica_depth_max.items()},
+        "param_generation": int(stats.param_generation),
+        "param_staleness": int(stats.param_staleness),
+        "kills": kills,
+        "drained": batcher.queue_depth == 0,
+        "conserved": bool(conserved),
+        "stopped_early": bool(stopped),
+    }

@@ -46,6 +46,8 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
                      update_rows: int = 32,
                      seed: int = 0,
                      ml=None,
+                     max_queue: Optional[int] = None,
+                     ticket_deadline_ms: Optional[float] = None,
                      stop: Optional[Callable[[], bool]] = None,
                      clock: Callable[[], float] = time.monotonic,
                      sleep: Callable[[float], None] = time.sleep) -> dict:
@@ -63,7 +65,13 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
     `stop()` (optional) is polled between arrivals — the SIGTERM path:
     on stop the loop drains the queue, emits a final record (extra
     field `final: true`), and returns. Every accepted query is
-    answered before the function returns."""
+    answered before the function returns.
+
+    Overload protection (docs/SERVING.md "Load shedding"): `max_queue`
+    bounds the queued row count (over-bound submits are shed with
+    reason queue-full), `ticket_deadline_ms` sheds tickets that waited
+    past the deadline at flush time. Shed counts land in each serving
+    record (`shed`) and the summary (`n_shed`)."""
     stats = ServingStats(clock)
     all_lat: list = []
     fills: list = []
@@ -74,7 +82,9 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
         fills.append(n_valid / bucket)
 
     batcher = engine.make_batcher(stats=stats,
-                                  max_delay_ms=max_delay_ms, clock=clock)
+                                  max_delay_ms=max_delay_ms, clock=clock,
+                                  max_queue=max_queue,
+                                  ticket_deadline_ms=ticket_deadline_ms)
     batcher._observer = observer
     gen = OpenLoopGenerator(engine.num_global_nodes, qps, duration_s,
                             ids_per_query=ids_per_query, seed=seed)
@@ -89,15 +99,17 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
     total_q = 0
     stale_max = 0
     hits = misses = 0
+    total_shed = 0
 
     def emit(now, final=False):
-        nonlocal n_records, total_q, stale_max, hits, misses
+        nonlocal n_records, total_q, stale_max, hits, misses, total_shed
         h, m = stats.hits, stats.misses
         rec = stats.snapshot(queue_depth=batcher.queue_depth)
         total_q += rec["queries"]
         stale_max = max(stale_max, rec["staleness_age"])
         hits += h
         misses += m
+        total_shed += rec["shed"]
         if ml is not None:
             extra = {"final": True} if final else {}
             ml.serving(**rec, **extra)
@@ -165,4 +177,15 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
         "n_records": int(n_records),
         "drained": batcher.queue_depth == 0,
         "stopped_early": bool(stopped),
+        "n_shed": int(total_shed),
+        "n_submitted": int(batcher.n_submitted_rows),
+        "n_served": int(batcher.n_served_rows),
+        # zero tickets silently lost: submitted == served + shed once
+        # the queue is drained (the kill drill pins this)
+        "conserved": bool(
+            batcher.n_submitted_rows
+            == batcher.n_served_rows + batcher.n_shed_rows
+            + batcher.queue_depth),
+        "param_generation": int(stats.param_generation),
+        "param_staleness": int(stats.param_staleness),
     }

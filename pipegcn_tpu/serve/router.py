@@ -1,0 +1,226 @@
+"""Fault-aware request router for the serving fleet.
+
+Host-side and jax-free: the router knows nothing about meshes or
+compiled programs — it dispatches already-batched node-id arrays to
+abstract replica clients (TcpReplicaClient in production, fakes in
+tests) and owns three policies:
+
+  placement   least-queue (default): the up replica with the fewest
+              in-flight rows, ties broken by replica id — keeps every
+              mesh busy under open-loop load, which is what makes
+              aggregate QPS scale near-linearly in N (bench.py
+              --serve --replicas N).
+              hash: consistent hashing on the batch's first node id
+              over a virtual-node ring, so a given node's queries keep
+              landing on the same replica (layer-0 cache locality) and
+              a replica death only remaps ITS arc, not the whole
+              keyspace.
+
+  failover    a dispatch that errors marks the replica down, fires
+              `on_fault(replica, reason)`, and retries the batch
+              against survivors under an overall timeout with
+              exponential backoff between attempts. Only when NO
+              replica answers inside the timeout does the router give
+              up (FleetUnavailable) — the caller then sheds the batch
+              explicitly rather than losing it.
+
+  rejoin      `mark_up` (driven by the fleet manager's health checks /
+              heartbeat watcher) puts a recovered replica back into
+              rotation; the hash ring and least-queue choice pick it
+              up on the next dispatch.
+
+Thread-safety: dispatch runs on the fleet's worker threads; membership
+and in-flight bookkeeping are guarded by one lock, while the blocking
+client call happens outside it.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+POLICIES = ("least-queue", "hash")
+
+
+class FleetUnavailable(RuntimeError):
+    """No replica answered the batch inside the retry timeout."""
+
+
+def _ring_point(token: str) -> int:
+    return zlib.crc32(token.encode()) & 0xFFFFFFFF
+
+
+class Router:
+    """Dispatch batches over a set of replica clients with failover.
+
+    `clients` maps replica id -> client; a client needs only
+    ``query(ids) -> np.ndarray`` (raising on failure). Everything else
+    — health, liveness, relaunch — is the fleet manager's job; it
+    drives `mark_down` / `mark_up` from heartbeats, and dispatch
+    errors mark down eagerly on their own."""
+
+    def __init__(self, clients: Dict[int, object], *,
+                 policy: str = "least-queue",
+                 retry_timeout_s: float = 5.0,
+                 backoff_s: float = 0.05,
+                 backoff_mult: float = 2.0,
+                 max_backoff_s: float = 1.0,
+                 ring_points: int = 64,
+                 on_fault: Optional[Callable[[int, str], None]] = None,
+                 on_failover: Optional[Callable[[int, int, int],
+                                                None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}; one of "
+                             f"{POLICIES}")
+        if not clients:
+            raise ValueError("router needs at least one replica client")
+        self.policy = policy
+        self.retry_timeout_s = float(retry_timeout_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_mult = float(backoff_mult)
+        self.max_backoff_s = float(max_backoff_s)
+        self._clients = dict(clients)
+        self._clock = clock
+        self._sleep = sleep
+        self._on_fault = on_fault
+        # fires when a batch SUCCEEDS on a survivor after >= 1 failed
+        # attempt: on_failover(to_replica, n_rows, n_attempts)
+        self._on_failover = on_failover
+        self._lock = threading.Lock()
+        self._up = {rid: True for rid in self._clients}
+        self._inflight = {rid: 0 for rid in self._clients}
+        self.n_dispatched = {rid: 0 for rid in self._clients}
+        self.n_failovers = 0
+        self.n_retried_rows = 0
+        # virtual-node hash ring, sorted by point: each replica owns
+        # `ring_points` arcs so load stays even and a death remaps
+        # only the dead replica's arcs
+        ring: List[Tuple[int, int]] = []
+        for rid in self._clients:
+            for v in range(ring_points):
+                ring.append((_ring_point(f"replica-{rid}-vnode-{v}"),
+                             rid))
+        ring.sort()
+        self._ring = ring
+
+    # ---------------- membership --------------------------------------
+
+    def mark_down(self, rid: int, reason: str = "") -> bool:
+        """Take a replica out of rotation; returns True on the DOWN
+        edge (so callers emit exactly one fault record per death)."""
+        with self._lock:
+            was_up = self._up.get(rid, False)
+            self._up[rid] = False
+        if was_up and self._on_fault is not None:
+            self._on_fault(rid, reason)
+        return was_up
+
+    def mark_up(self, rid: int) -> bool:
+        """Put a replica back into rotation (rejoin); returns True on
+        the UP edge."""
+        with self._lock:
+            was_down = not self._up.get(rid, False)
+            self._up[rid] = True
+        return was_down
+
+    def is_up(self, rid: int) -> bool:
+        with self._lock:
+            return self._up.get(rid, False)
+
+    def up_replicas(self) -> List[int]:
+        with self._lock:
+            return sorted(r for r, u in self._up.items() if u)
+
+    def queue_depths(self) -> Dict[int, int]:
+        """In-flight rows per replica (the least-queue signal)."""
+        with self._lock:
+            return dict(self._inflight)
+
+    # ---------------- placement ---------------------------------------
+
+    def _hash_pick(self, key: int, excluded: set) -> Optional[int]:
+        point = _ring_point(f"key-{int(key)}")
+        n = len(self._ring)
+        i = bisect.bisect_left(self._ring, (point, -1))
+        for step in range(n):
+            _, rid = self._ring[(i + step) % n]
+            if self._up.get(rid, False) and rid not in excluded:
+                return rid
+        return None
+
+    def _pick(self, ids: np.ndarray, excluded: set) -> Optional[int]:
+        with self._lock:
+            if self.policy == "hash" and ids.size:
+                return self._hash_pick(int(ids[0]), excluded)
+            best, best_depth = None, None
+            for rid in sorted(self._clients):
+                if not self._up.get(rid, False) or rid in excluded:
+                    continue
+                d = self._inflight[rid]
+                if best_depth is None or d < best_depth:
+                    best, best_depth = rid, d
+            return best
+
+    # ---------------- dispatch ----------------------------------------
+
+    def dispatch(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Send one batch; returns (logits, replica id that served it).
+
+        On a replica error: mark it down, back off exponentially, and
+        retry against survivors until `retry_timeout_s` elapses (the
+        first attempt always runs). Raises FleetUnavailable when the
+        whole fleet is down or the timeout expires."""
+        deadline = self._clock() + self.retry_timeout_s
+        delay = self.backoff_s
+        excluded: set = set()
+        attempt = 0
+        last_err = "no replica available"
+        while attempt == 0 or self._clock() < deadline:
+            attempt += 1
+            rid = self._pick(ids, excluded)
+            if rid is None:
+                # every non-excluded replica is down; if some replica
+                # is still up but excluded (it already failed THIS
+                # batch), give it another chance after the backoff —
+                # it may have been a transient error
+                if not self.up_replicas():
+                    raise FleetUnavailable(
+                        f"no up replicas (last error: {last_err})")
+                excluded.clear()
+                self._sleep(delay)
+                delay = min(delay * self.backoff_mult,
+                            self.max_backoff_s)
+                continue
+            with self._lock:
+                self._inflight[rid] += int(ids.size)
+            try:
+                out = self._clients[rid].query(ids)
+            except Exception as exc:  # noqa: BLE001 — any client error
+                last_err = f"{type(exc).__name__}: {exc}"
+                excluded.add(rid)
+                self.mark_down(rid, last_err)
+                self.n_failovers += 1
+                self.n_retried_rows += int(ids.size)
+                self._sleep(delay)
+                delay = min(delay * self.backoff_mult,
+                            self.max_backoff_s)
+                continue
+            finally:
+                with self._lock:
+                    self._inflight[rid] -= int(ids.size)
+            self.n_dispatched[rid] += int(ids.size)
+            if attempt > 1 and self._on_failover is not None:
+                self._on_failover(rid, int(ids.size), attempt)
+            # the client's result is opaque to the router: a plain
+            # ndarray (fakes) or (ndarray, meta) (TcpReplicaClient)
+            return out, rid
+        raise FleetUnavailable(
+            f"retry timeout after {attempt} attempts "
+            f"(last error: {last_err})")

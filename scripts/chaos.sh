@@ -50,3 +50,17 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
 timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serving \
     -p no:cacheprovider "$@"
+
+# Fleet lane (docs/SERVING.md "Fleet"): the replica-kill drill — a
+# two-replica `python -m pipegcn_tpu.cli.fleet` run SIGKILLs one
+# replica mid-load (fault plan replica-kill@W:mK); the router must
+# route every in-flight and subsequent batch to the survivor, lose
+# zero accepted tickets (submitted == served + shed, all sheds
+# explicit), land `fleet` fault + recovery records, and rejoin the
+# relaunched replica — plus the tier-1-safe fleet unit tests (router
+# failover/backoff, consistent-hash remap, load shedding, hot-swap
+# walk-back). Re-run under the faults marker filtered to fleet so a
+# fleet regression is named even when the broad lane is trimmed.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults or fleet" \
+    -k "fleet" -p no:cacheprovider "$@"

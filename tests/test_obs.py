@@ -28,12 +28,13 @@ from pipegcn_tpu.utils.timer import CommTimer
 
 # ---------------- schema -------------------------------------------------
 
-# FROZEN copy of the v6 contract (v5 + the membership kind the elastic
-# membership PR added, bumping the version to 6). If any assert
-# below fires, a field was removed or retyped without bumping
-# SCHEMA_VERSION — consumers (bench trajectory, report CLI, timeline
-# CLI, scripts) would break silently.
-_V6_FIELDS = {
+# FROZEN copy of the v7 contract (v6 + the fleet kind and the
+# serving shed/param-generation fields the serving-fleet PR added,
+# bumping the version to 7). If any assert below fires, a field was
+# removed or retyped without bumping SCHEMA_VERSION — consumers
+# (bench trajectory, report CLI, timeline CLI, scripts) would break
+# silently.
+_V7_FIELDS = {
     "run": {
         "event": "string", "schema_version": "integer",
         "time_unix": "number", "config": "object", "device": "object",
@@ -87,16 +88,22 @@ _V6_FIELDS = {
         "queue_depth": "integer", "p50_ms": "number?",
         "p95_ms": "number?", "p99_ms": "number?",
         "cache_hit_rate": "number?", "staleness_age": "integer",
+        "shed": "integer", "param_generation": "integer",
+        "param_staleness": "integer",
     },
     "membership": {
         "event": "string", "generation": "integer",
         "assignment": "object", "trigger": "string",
         "restart_latency_s": "number?",
     },
+    "fleet": {
+        "event": "string", "kind": "string", "replica": "integer",
+        "window": "integer",
+    },
 }
 
 
-def test_schema_v6_drift_guard():
+def test_schema_v7_drift_guard():
     current = {"run": obs_schema.RUN_FIELDS,
                "epoch": obs_schema.EPOCH_FIELDS,
                "eval": obs_schema.EVAL_FIELDS,
@@ -110,9 +117,10 @@ def test_schema_v6_drift_guard():
                "fallback": obs_schema.FALLBACK_FIELDS,
                "tuning": obs_schema.TUNING_FIELDS,
                "serving": obs_schema.SERVING_FIELDS,
-               "membership": obs_schema.MEMBERSHIP_FIELDS}
-    if obs_schema.SCHEMA_VERSION == 6:
-        for kind, fields in _V6_FIELDS.items():
+               "membership": obs_schema.MEMBERSHIP_FIELDS,
+               "fleet": obs_schema.FLEET_FIELDS}
+    if obs_schema.SCHEMA_VERSION == 7:
+        for kind, fields in _V7_FIELDS.items():
             for name, tag in fields.items():
                 assert current[kind].get(name) == tag, (
                     f"schema field {kind}.{name} removed or retyped "
@@ -120,7 +128,7 @@ def test_schema_v6_drift_guard():
     else:
         # a bump legitimizes any field change; the contract is that the
         # version moved WITH the change
-        assert obs_schema.SCHEMA_VERSION > 6
+        assert obs_schema.SCHEMA_VERSION > 7
 
 
 def test_validate_record():
@@ -162,14 +170,32 @@ def test_validate_serving_record():
     validate_record({"event": "serving", "window_s": 2.0, "queries": 40,
                      "qps": 20.0, "batch_fill": 0.5, "queue_depth": 0,
                      "p50_ms": 1.2, "p95_ms": 3.4, "p99_ms": 5.6,
-                     "cache_hit_rate": 1.0, "staleness_age": 0})
+                     "cache_hit_rate": 1.0, "staleness_age": 0,
+                     "shed": 0, "param_generation": -1,
+                     "param_staleness": 0})
     # empty windows carry nullable latency/fill fields
     validate_record({"event": "serving", "window_s": 2.0, "queries": 0,
                      "qps": 0.0, "batch_fill": None, "queue_depth": 0,
                      "p50_ms": None, "p95_ms": None, "p99_ms": None,
-                     "cache_hit_rate": None, "staleness_age": 0})
+                     "cache_hit_rate": None, "staleness_age": 0,
+                     "shed": 4, "param_generation": 7,
+                     "param_staleness": 1})
     with pytest.raises(ValueError, match="missing field"):
         validate_record({"event": "serving", "window_s": 2.0})
+
+
+def test_validate_fleet_record():
+    validate_record({"event": "fleet", "kind": "replica-dead",
+                     "replica": 1, "window": 3})
+    # hot-swap records ride with free extras (swap_ms, incarnation, …)
+    validate_record({"event": "fleet", "kind": "hot-swap", "replica": 0,
+                     "window": -1, "param_generation": 2,
+                     "swap_ms": 12.5, "incarnation": 0})
+    with pytest.raises(ValueError, match="missing field"):
+        validate_record({"event": "fleet", "kind": "failover"})
+    with pytest.raises(ValueError, match="expected integer"):
+        validate_record({"event": "fleet", "kind": "relaunch",
+                         "replica": "one", "window": 0})
 
 
 # ---------------- sink ---------------------------------------------------
@@ -435,10 +461,12 @@ def test_report_json_pins_serving_summary(tmp_path, capsys):
         ml.run_header(config={}, device={}, mesh={})
         ml.serving(window_s=2.0, queries=40, qps=20.0, batch_fill=0.5,
                    queue_depth=1, p50_ms=1.0, p95_ms=2.0, p99_ms=3.0,
-                   cache_hit_rate=1.0, staleness_age=0)
+                   cache_hit_rate=1.0, staleness_age=0, shed=3,
+                   param_generation=1, param_staleness=1)
         ml.serving(window_s=2.0, queries=120, qps=60.0, batch_fill=0.75,
                    queue_depth=3, p50_ms=2.0, p95_ms=4.0, p99_ms=6.0,
-                   cache_hit_rate=0.5, staleness_age=2, final=True)
+                   cache_hit_rate=0.5, staleness_age=2, shed=5,
+                   param_generation=2, param_staleness=0, final=True)
     rc = report_main([str(p), "--json"])
     assert rc == 0
     s = json.loads(capsys.readouterr().out)
@@ -452,6 +480,10 @@ def test_report_json_pins_serving_summary(tmp_path, capsys):
     assert s["serving_cache_hit_rate"] == pytest.approx(0.625)
     assert s["serving_staleness_age_max"] == 2
     assert s["serving_queue_depth_max"] == 3
+    # v7 rollups: total shed rows, last served generation, worst lag
+    assert s["serving_shed_total"] == 8
+    assert s["serving_param_generation_last"] == 2
+    assert s["serving_param_staleness_max"] == 1
     assert s["serving_drained"] is True
     # human-readable lines render the same facts
     rc = report_main([str(p)])
@@ -470,6 +502,39 @@ def test_report_json_pins_serving_summary(tmp_path, capsys):
     assert summ["serving_drained"] is False
     assert report_main([str(q)]) == 0
     assert "!! serving shutdown" in capsys.readouterr().out
+
+
+def test_report_json_pins_fleet_summary(tmp_path, capsys):
+    """--json shape pin for the round-12 fleet fields: `fleet` records
+    roll up to a per-kind event count, the max measured hot-swap
+    latency, and the last swapped generation; a death without a rejoin
+    prints the degraded warning."""
+    p = tmp_path / "fleet.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        ml.fleet("hot-swap", 0, window=-1, param_generation=2,
+                 swap_ms=12.5, incarnation=0)
+        ml.fleet("hot-swap", 1, window=-1, param_generation=2,
+                 swap_ms=30.25, incarnation=0)
+        ml.fleet("replica-dead", 1, window=3, reason="heartbeat-stale")
+        ml.fleet("failover", 0, window=3, n_retried=16, attempts=2)
+        ml.fleet("relaunch", 1, window=3, incarnation=1, delay_s=0.5)
+    rc = report_main([str(p), "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["n_fleet_records"] == 5
+    assert s["fleet_events"] == {"hot-swap": 2, "replica-dead": 1,
+                                 "failover": 1, "relaunch": 1}
+    assert s["fleet_param_swap_ms_max"] == pytest.approx(30.25)
+    assert s["fleet_param_generation_last"] == 2
+    # human-readable lines render the same facts + the degraded flag
+    # (1 death, 0 rejoins)
+    rc = report_main([str(p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fleet" in out
+    assert "replica-dead=1" in out
+    assert "!! fleet degraded" in out
 
 
 def test_membership_record_roundtrip(tmp_path):

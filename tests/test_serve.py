@@ -148,6 +148,54 @@ def test_microbatcher_policy_fake_clock():
         mb.submit(np.arange(9))
 
 
+def test_microbatcher_load_shedding_fake_clock():
+    """Bounded-queue + deadline load shedding (docs/SERVING.md "Load
+    shedding"): overload is answered 'no' immediately, expired tickets
+    are shed at flush time, and the conservation invariant
+    submitted == served + shed + queue_depth holds throughout."""
+    now = [0.0]
+    stats = ServingStats(clock=lambda: now[0])
+    served = []
+
+    def run(ids):
+        served.append(np.asarray(ids).copy())
+        return np.stack([ids, ids], axis=1).astype(np.float32)
+
+    mb = MicroBatcher(run, max_batch=8, max_delay_ms=5.0, ladder_min=2,
+                      clock=lambda: now[0], max_queue=4,
+                      ticket_deadline_ms=20.0,
+                      observer=stats.note_batch, on_shed=stats.note_shed)
+    t1 = mb.submit(np.array([1, 2, 3]))
+    assert not t1.shed
+    # a submit that lands exactly AT the bound is accepted...
+    t2 = mb.submit(np.array([4]))
+    assert not t2.shed and mb.queue_depth == 4
+    # ...one row past it is shed immediately with an explicit reason
+    t3 = mb.submit(np.array([5]))
+    assert t3.done and t3.shed and t3.shed_reason == "queue-full"
+    assert t3.result is None and mb.queue_depth == 4
+    # tickets that outwait the deadline are shed at flush, not served
+    now[0] += 0.021
+    assert mb.pump(now[0], force=True) == 0
+    assert t1.shed and t1.shed_reason == "deadline"
+    assert t2.shed and t2.shed_reason == "deadline"
+    assert served == []  # nothing uselessly late ever ran
+    # a fresh ticket inside the deadline still serves normally
+    t4 = mb.submit(np.array([6, 7]))
+    now[0] += 0.006
+    assert mb.pump(now[0]) == 1
+    assert t4.done and not t4.shed
+    np.testing.assert_array_equal(t4.result[:, 0], [6, 7])
+    # conservation: every submitted row is served, shed, or queued
+    assert mb.n_submitted_rows == 7
+    assert mb.n_served_rows == 2 and mb.n_shed_rows == 5
+    assert mb.n_shed_tickets == 3
+    assert mb.n_submitted_rows == (mb.n_served_rows + mb.n_shed_rows
+                                   + mb.queue_depth)
+    # the shed count lands in the serving record via on_shed
+    assert stats.snapshot()["shed"] == 5
+
+
 def test_serving_stats_snapshot():
     now = [100.0]
     st = ServingStats(clock=lambda: now[0])
@@ -479,3 +527,7 @@ def test_serve_cli_kill_drill(tmp_path):
     assert tail, out[-2000:]
     summ = json.loads(tail[-1])
     assert summ["drained"] is True and summ["stopped_early"] is True
+    # no silently dropped tickets: every accepted row was served or
+    # explicitly shed before the final record landed
+    assert summ["conserved"] is True
+    assert summ["n_submitted"] == summ["n_served"] + summ["n_shed"]
