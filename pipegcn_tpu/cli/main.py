@@ -190,6 +190,44 @@ def prepare(args):
     return sg, eval_graphs
 
 
+def _prepare_streaming(args):
+    """Streaming-mode prepare (--stream-plan / graph-delta faults):
+    always builds the sharded graph in memory — the patcher mutates the
+    HOST graph and partition arrays in lockstep with the device state,
+    which a reloaded artifact would not share — and reserves
+    --stream-slack headroom in every padded dimension so scheduled
+    deltas land without recompiling. Returns
+    (sg, eval_graphs, host_graph, parts)."""
+    if args.local_reorder != "none":
+        raise ValueError(
+            "--stream-plan / graph-delta faults require --local-reorder "
+            "none: the patcher appends new nodes in plain local-id "
+            "order, and cluster renumbering would break the "
+            "patched-vs-rebuilt bit-identity contract")
+    if args.use_pp:
+        raise ValueError(
+            "streaming deltas are incompatible with --use-pp (the "
+            "layer-0 precompute bakes in the pre-delta topology)")
+    if args.inductive:
+        raise ValueError(
+            "streaming deltas support transductive runs only (the "
+            "inductive split would diverge from the patched graph)")
+    if math.ceil(args.n_partitions / args.parts_per_node) > 1:
+        raise ValueError(
+            "streaming deltas are single-process only (the patcher "
+            "owns the full host-side partition state)")
+    g = load_data(args.dataset, args.data_root)
+    eval_graphs = ({"val": (g, "val_mask"), "test": (g, "test_mask")}
+                   if args.eval else None)
+    seed = args.seed if args.fix_seed else 0
+    parts = partition_graph(
+        g, args.n_partitions, method=args.partition_method,
+        obj=args.partition_obj, seed=seed)
+    sg = ShardedGraph.build(g, parts, n_parts=args.n_partitions,
+                            slack=args.stream_slack)
+    return sg, eval_graphs, g, parts
+
+
 def _await_partition_artifact(part_path: str, n_partitions: int,
                               timeout_s: float = 3600.0,
                               poll_s: float = 2.0,
@@ -272,6 +310,16 @@ def run(args) -> dict:
             raise ValueError(
                 "--profile-epochs needs --profile-dir (there is "
                 "nowhere to write the trace)")
+    # parse the delta schedule BEFORE the partition/trainer build: a
+    # missing or corrupt delta file must not burn a multi-minute setup
+    # (parse() CRC-checks every batch up front)
+    stream_plan = None
+    streaming = bool(getattr(args, "stream_plan", "")) or \
+        "graph-delta" in getattr(args, "fault_plan", "")
+    if getattr(args, "stream_plan", ""):
+        from ..stream import StreamPlan
+
+        stream_plan = StreamPlan.parse(args.stream_plan)
 
     # deferred jax import so the parser works without initializing backends
     import jax
@@ -320,7 +368,12 @@ def run(args) -> dict:
         log=print)
     coord.start()
 
-    sg, eval_graphs = prepare(args)
+    if streaming:
+        # streaming needs the live host graph + parts the artifact path
+        # discards, so it always builds in memory (with slack headroom)
+        sg, eval_graphs, host_g, host_parts = _prepare_streaming(args)
+    else:
+        sg, eval_graphs = prepare(args)
     # partition-size report (reference prints each rank's node count at
     # setup, train.py:267-268)
     sizes = ", ".join(str(int(c)) for c in sg.inner_count)
@@ -373,6 +426,17 @@ def run(args) -> dict:
         loss_scale=args.loss_scale,
     )
     trainer = Trainer(sg, cfg, tcfg)
+
+    if streaming:
+        from ..stream import GraphPatcher
+
+        patcher = GraphPatcher(host_g, sg, host_parts,
+                               slack=args.stream_slack)
+        trainer.enable_stream(patcher)
+        n_due = stream_plan.remaining() if stream_plan is not None else 0
+        print(f"streaming enabled: {n_due} delta batch(es) scheduled, "
+              f"slack={args.stream_slack:.0%}, "
+              f"headroom={patcher.slack_remaining()}")
 
     graph_name = args.graph_name or derive_graph_name(args)
     os.makedirs(args.results_dir, exist_ok=True)
@@ -476,6 +540,7 @@ def run(args) -> dict:
                 sentinel=sentinel,
                 preemption=preemption,
                 fault_plan=fault_plan,
+                stream_plan=stream_plan,
                 coord=coord,
             )
     finally:

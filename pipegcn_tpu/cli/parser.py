@@ -291,10 +291,28 @@ def create_parser() -> argparse.ArgumentParser:
                              "separated kind@epoch[:rN] entries "
                              "(nan-loss, nan-grad, sigterm, crash, "
                              "corrupt-ckpt, desync, hang, overflow, "
-                             "kernel-crash), e.g. "
+                             "kernel-crash, graph-delta), e.g. "
                              "'nan-loss@5:r1,sigterm@8'; each fires "
                              "once, host-side only; :rN targets one "
                              "rank (process index) in multi-host runs")
+    # ---- streaming graphs (docs/STREAMING.md) ----
+    parser.add_argument("--stream-plan", "--stream_plan", type=str,
+                        default="",
+                        help="graph delta schedule: comma-separated "
+                             "FILE@epoch[:everyN] entries — batch j of "
+                             "FILE (CRC-guarded JSONL or npz, "
+                             "stream/deltas.py) applies at the boundary "
+                             "of epoch+j*N. Edges/nodes land in the "
+                             "existing partition through reserved "
+                             "headroom (--stream-slack), so compiled "
+                             "shapes stay static across deltas")
+    parser.add_argument("--stream-slack", "--stream_slack", type=float,
+                        default=0.10,
+                        help="fractional headroom reserved in every "
+                             "padded dimension (rows, edges, send "
+                             "slots) of the sharded build for streamed "
+                             "growth; exhausting it re-pads loudly "
+                             "(one recompile) instead of failing")
     # ---- numerics guardrails (docs/RESILIENCE.md "Numerics") ----
     parser.add_argument("--loss-scale", "--loss_scale", type=str,
                         default="off",

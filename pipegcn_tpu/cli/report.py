@@ -353,6 +353,35 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                  if r.get("trigger") in ("max-restarts", "restart-storm")]
         if stops:
             out["membership_stopped"] = stops[-1]
+
+    # ---- streaming graph deltas (stream/, schema v8) ----
+    stream = [r for r in records if r.get("event") == "stream"]
+    if stream:
+        out["n_stream_records"] = len(stream)
+        for key in ("edges_added", "edges_deleted", "nodes_added"):
+            vals = [r.get(key) for r in stream]
+            vals = [v for v in vals if isinstance(v, int)]
+            if vals:
+                out[f"stream_{key}"] = sum(vals)
+        pms = [r.get("patch_ms") for r in stream]
+        pms = [v for v in pms if isinstance(v, (int, float))]
+        if pms:
+            out["stream_patch_ms_median"] = round(_median(pms), 3)
+            out["stream_patch_ms_max"] = round(max(pms), 3)
+        drifts = [r.get("drift") for r in stream]
+        drifts = [v for v in drifts if isinstance(v, (int, float))]
+        if drifts:
+            out["stream_drift_max"] = round(max(drifts), 6)
+            out["stream_drift_last"] = round(drifts[-1], 6)
+        reb = [r.get("tables_rebuilt") for r in stream]
+        reb = [v for v in reb if isinstance(v, int)]
+        if reb:
+            out["stream_tables_rebuilt"] = sum(reb)
+        out["stream_repads"] = sum(1 for r in stream if r.get("repadded"))
+        slack = [r.get("slack_remaining") for r in stream
+                 if isinstance(r.get("slack_remaining"), dict)]
+        if slack:
+            out["stream_slack_remaining_last"] = slack[-1]
     return out
 
 
@@ -525,6 +554,32 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
             lines.append(f"  {'!! supervisor stopped':<26} "
                          f"{s['membership_stopped']} — resume from the "
                          f"last checkpoint manually")
+    # ---- streaming graph deltas (docs/STREAMING.md) ----
+    if s.get("n_stream_records"):
+        lines.append("  {:<26} {} delta(s): +{}/-{} edges, +{} nodes"
+                     .format("stream deltas", s["n_stream_records"],
+                             s.get("stream_edges_added", 0),
+                             s.get("stream_edges_deleted", 0),
+                             s.get("stream_nodes_added", 0)))
+        if s.get("stream_patch_ms_median") is not None:
+            lines.append("  {:<26} median {:.1f} / max {:.1f} ms"
+                         .format("stream patch cost",
+                                 s["stream_patch_ms_median"],
+                                 s.get("stream_patch_ms_max", 0.0)))
+        if s.get("stream_drift_max") is not None:
+            lines.append("  {:<26} max {:.4f}, last {:.4f}".format(
+                "stream probe drift", s["stream_drift_max"],
+                s.get("stream_drift_last", 0.0)))
+        row("stream tables rebuilt", "stream_tables_rebuilt")
+        sl = s.get("stream_slack_remaining_last")
+        if isinstance(sl, dict):
+            lines.append("  {:<26} {}".format(
+                "stream slack left", ", ".join(
+                    f"{k}={v}" for k, v in sorted(sl.items()))))
+        if s.get("stream_repads"):
+            lines.append(f"  {'!! stream re-pads':<26} "
+                         f"{s['stream_repads']} slack exhaustion(s) — "
+                         f"recompiled; raise --stream-slack")
     row("best val", "best_val", "{:.4f}")
     row("best epoch", "best_epoch")
     row("test acc", "test_acc", "{:.4f}")

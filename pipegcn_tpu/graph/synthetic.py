@@ -137,6 +137,105 @@ def synthetic_graph(
     return finalize(g)
 
 
+def synthetic_delta_schedule(
+    g: Graph,
+    n_batches: int = 4,
+    edges_per_batch: int = 8,
+    dels_per_batch: int = 4,
+    nodes_per_batch: int = 1,
+    nbrs_per_node: int = 3,
+    seed: int = 0,
+    start_seq: int = 0,
+):
+    """Deterministic synthetic delta batches against `g` (tests/bench).
+
+    Each batch deletes ``dels_per_batch`` existing undirected non-self-
+    loop edges (both directions), adds ``edges_per_batch`` new
+    undirected edges between existing nodes, and grows the graph by
+    ``nodes_per_batch`` nodes wired to ``nbrs_per_node`` random
+    neighbors each with class-prototype-free random features — the
+    mutation mix an evolving production graph sees. Batches track the
+    evolving edge set so a schedule is always applicable in order:
+    no double-deletes, no duplicate adds, and later batches may touch
+    earlier batches' nodes. Fully determined by (g, sizes, seed).
+
+    Returns a list of :class:`pipegcn_tpu.stream.DeltaBatch`.
+    """
+    from ..stream.deltas import DeltaBatch
+
+    rng = np.random.default_rng(seed)
+    num_nodes = g.num_nodes
+    label = np.asarray(g.ndata["label"])
+    multilabel = label.ndim == 2
+    n_class = label.shape[1] if multilabel else int(label.max()) + 1
+    n_feat = int(g.ndata["feat"].shape[1])
+    cap = num_nodes + n_batches * nodes_per_batch  # fused-key base
+
+    nondir = g.src < g.dst  # one representative per undirected edge
+    keys = set((g.src[nondir].astype(np.int64) * cap
+                + g.dst[nondir]).tolist())
+
+    batches = []
+    for bi in range(n_batches):
+        # ---- deletions: sample existing undirected pairs ------------
+        pool = np.fromiter(keys, np.int64, len(keys))
+        pool.sort()  # set order is not deterministic across runs
+        n_del = min(dels_per_batch, pool.size)
+        dele = []
+        if n_del:
+            picked = pool[rng.choice(pool.size, size=n_del,
+                                     replace=False)]
+            for k in picked:
+                u, v = int(k // cap), int(k % cap)
+                dele += [[u, v], [v, u]]
+                keys.discard(int(k))
+
+        # ---- new nodes ----------------------------------------------
+        node_feat = rng.normal(
+            0.0, 1.0, size=(nodes_per_batch, n_feat)).astype(np.float32)
+        if multilabel:
+            node_label = np.zeros((nodes_per_batch, n_class), np.float32)
+            node_label[np.arange(nodes_per_batch),
+                       rng.integers(0, n_class, nodes_per_batch)] = 1.0
+        else:
+            node_label = rng.integers(
+                0, n_class, nodes_per_batch).astype(np.int64)
+        nbrs = []
+        for i in range(nodes_per_batch):
+            k = min(nbrs_per_node, num_nodes)
+            nb = rng.choice(num_nodes, size=k, replace=False)
+            nbrs.append(np.sort(nb).astype(np.int64))
+            u = num_nodes + i
+            for v in nb:
+                keys.add(int(min(u, v)) * cap + int(max(u, v)))
+        num_nodes += nodes_per_batch
+
+        # ---- additions: fresh undirected pairs ----------------------
+        add = []
+        tries = 0
+        while len(add) < 2 * edges_per_batch and tries < 50:
+            tries += 1
+            a = int(rng.integers(0, num_nodes))
+            b = int(rng.integers(0, num_nodes))
+            if a == b:
+                continue
+            k = min(a, b) * cap + max(a, b)
+            if k in keys:
+                continue
+            keys.add(k)
+            add += [[a, b], [b, a]]
+
+        batches.append(DeltaBatch.make(
+            seq=start_seq + bi,
+            add_edges=np.asarray(add, np.int64).reshape(-1, 2),
+            del_edges=np.asarray(dele, np.int64).reshape(-1, 2),
+            node_feat=node_feat,
+            node_label=node_label,
+            node_nbrs=nbrs,
+        ))
+    return batches
+
+
 _KARATE_EDGES = [
     (0, 1), (0, 2), (0, 3), (0, 4), (0, 5), (0, 6), (0, 7), (0, 8), (0, 10),
     (0, 11), (0, 12), (0, 13), (0, 17), (0, 19), (0, 21), (0, 31), (1, 2),

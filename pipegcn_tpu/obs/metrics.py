@@ -327,6 +327,35 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def stream(self, epoch: int, seq: int, edges_added: int,
+               edges_deleted: int, nodes_added: int, patch_ms: float,
+               tables_rebuilt: int, repadded: bool,
+               slack_remaining: Dict[str, Any],
+               drift: Optional[float] = None, **extra) -> Dict[str, Any]:
+        """One applied graph delta batch (stream/, docs/STREAMING.md):
+        what changed, what the incremental patch cost, how much of the
+        reserved slack survives, and the forced probe's drift across
+        the first post-patch step. Hard-flushed — a delta that blows
+        the slack may be the last thing the run does, and the record
+        explaining the re-pad must be on disk."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "stream",
+            "epoch": int(epoch),
+            "seq": int(seq),
+            "edges_added": int(edges_added),
+            "edges_deleted": int(edges_deleted),
+            "nodes_added": int(nodes_added),
+            "patch_ms": float(patch_ms),
+            "tables_rebuilt": int(tables_rebuilt),
+            "repadded": bool(repadded),
+            "slack_remaining": dict(slack_remaining),
+            "drift": None if drift is None else float(drift),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def membership(self, generation: int, assignment: Dict[str, Any],
                    trigger: str,
                    restart_latency_s: Optional[float] = None,

@@ -17,8 +17,8 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 7  # v7: fleet record kind + serving shed /
-#                         parameter-staleness fields (serving fleet)
+SCHEMA_VERSION = 8  # v8: stream record kind (graph-delta ingestion,
+#                         docs/STREAMING.md)
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -246,6 +246,31 @@ MEMBERSHIP_FIELDS: Dict[str, str] = {
     "restart_latency_s": "number?",
 }
 
+# one record per applied graph delta batch (stream/, docs/STREAMING.md)
+# — written from the training loop (scheduled --stream-plan entries and
+# injected graph-delta faults alike) at the epoch boundary the patch
+# landed on. patch_ms is the host-side incremental patch time;
+# tables_rebuilt counts per-shard kernel-table rebuilds the delta
+# forced (0 on the raw-edge path); slack_remaining maps each padded
+# dimension ({"n": rows, "e": edges, "b": send slots}) to the worst-
+# shard free-slot count after this patch; repadded=true flags the loud
+# slack-exhaustion path (shapes grew, the step recompiled); drift is
+# the forced staleness probe's max relative drift across the first
+# post-patch step (null when the pipeline is off).
+STREAM_FIELDS: Dict[str, str] = {
+    "event": "string",             # "stream"
+    "epoch": "integer",            # boundary the delta applied at
+    "seq": "integer",              # monotonic delta-batch sequence id
+    "edges_added": "integer",
+    "edges_deleted": "integer",
+    "nodes_added": "integer",
+    "patch_ms": "number",          # host incremental-patch time
+    "tables_rebuilt": "integer",   # per-shard table rebuilds forced
+    "repadded": "boolean",         # slack exhausted -> shapes grew
+    "slack_remaining": "object",   # {n|e|b: worst-shard free slots}
+    "drift": "number?",            # forced probe max_rel_drift
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -262,6 +287,7 @@ _BY_EVENT = {
     "serving": SERVING_FIELDS,
     "membership": MEMBERSHIP_FIELDS,
     "fleet": FLEET_FIELDS,
+    "stream": STREAM_FIELDS,
 }
 
 _JSON_TYPES = {

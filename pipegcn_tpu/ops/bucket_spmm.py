@@ -553,7 +553,9 @@ def make_bucket_spmm_fn(
 
 
 def build_sharded_bucket_tables(sg, chunk_elems: int = DEFAULT_CHUNK_ELEMS,
-                                min_width: int = 0, slab: bool = False
+                                min_width: int = 0, slab: bool = False,
+                                plan_cache: Optional[dict] = None,
+                                dirty: Optional[Sequence[int]] = None
                                 ) -> Dict[str, np.ndarray]:
     """Stacked per-device tables for shard_map (leading device axis),
     padded to shared bucket widths and per-bucket row caps so the traced
@@ -568,29 +570,61 @@ def build_sharded_bucket_tables(sg, chunk_elems: int = DEFAULT_CHUNK_ELEMS,
     'bkt_{fwd,bwd}{res,src,pos,cnt}_<b>' (no underscore after the
     side, so the plain-table key predicates never match them).
 
+    `plan_cache` (a mutable dict, updated in place) with `dirty` (shard
+    ids whose edges changed) is the streaming-delta fast path: per-
+    shard degree maxima and BucketPlans are recomputed only for dirty
+    shards, clean shards reuse the cached ones — the O(E_r) per-shard
+    plan builds are the dominant cost, and a delta batch touches few
+    shards. Cached plans are only valid at the SAME width ladder: if
+    the global max degree moves the ladder, every plan rebuilds (the
+    resulting tables are identical to a cache-free build either way).
+
     Returns {'bkt_fwd_<b>': [P, cap_b, w_b], 'bkt_fwd_inv': [P, n_max],
              'bkt_bwd_<b>': ..., 'bkt_bwd_inv': [P, R]}.
     """
     P = sg.num_parts
     n_src_rows = sg.n_max + sg.halo_size
+    cache = plan_cache if plan_cache is not None else {}
+    stale = set(range(P)) if dirty is None or not cache else set(dirty)
+    if cache.get("shape") != (sg.n_max, n_src_rows) or \
+            cache.get("min_width") != min_width:
+        cache.clear()
+        stale = set(range(P))
 
-    # shared width ladders from global max degrees
-    max_in, max_out = 1, 1
+    # shared width ladders from global max degrees (per-shard maxima
+    # cached; only dirty shards rescan their edges)
+    degs = cache.get("degs", [None] * P)
+    degs += [None] * (P - len(degs))
     for r in range(P):
+        if degs[r] is not None and r not in stale:
+            continue
         real = sg.edge_dst[r] < sg.n_max
+        mi, mo = 1, 1
         if real.any():
             di = np.bincount(sg.edge_dst[r][real], minlength=sg.n_max)
             do = np.bincount(sg.edge_src[r][real], minlength=n_src_rows)
-            max_in = max(max_in, int(di.max(initial=1)))
-            max_out = max(max_out, int(do.max(initial=1)))
+            mi = max(1, int(di.max(initial=1)))
+            mo = max(1, int(do.max(initial=1)))
+        degs[r] = (mi, mo)
+    max_in = max(d[0] for d in degs)
+    max_out = max(d[1] for d in degs)
     fw = _bucket_widths(max_in, min_width)
     bw = _bucket_widths(max_out, min_width)
+    if cache.get("widths") != (tuple(fw), tuple(bw)):
+        stale = set(range(P))  # ladder moved: every plan is invalid
 
+    old_plans = cache.get("plans", [None] * P)
+    old_plans += [None] * (P - len(old_plans))
     plans = [
-        BucketPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max, n_src_rows,
-                   fwd_widths=fw, bwd_widths=bw)
+        old_plans[r] if old_plans[r] is not None and r not in stale
+        else BucketPlan(sg.edge_src[r], sg.edge_dst[r], sg.n_max,
+                        n_src_rows, fwd_widths=fw, bwd_widths=bw)
         for r in range(P)
     ]
+    if plan_cache is not None:
+        plan_cache.update(
+            shape=(sg.n_max, n_src_rows), min_width=min_width,
+            widths=(tuple(fw), tuple(bw)), degs=degs, plans=plans)
     fwd_caps = [max(p.fwd_counts[b] for p in plans) for b in range(len(fw))]
     bwd_caps = [max(p.bwd_counts[b] for p in plans) for b in range(len(bw))]
 

@@ -408,7 +408,7 @@ class Trainer:
         win = (self.tuning or {}).get("winner") or {}
         return bool(win.get("slab"))
 
-    def _use_bucket(self) -> None:
+    def _use_bucket(self, dirty=None) -> None:
         from ..ops.bucket_spmm import (build_sharded_bucket_tables,
                                        validate_bucket_tables)
 
@@ -416,9 +416,13 @@ class Trainer:
         slab_on = self._slab_flag()
         kind = ("bucket" + (f"_m{merge}" if merge else "")
                 + ("_slab" if slab_on else ""))
+        # streaming (enable_stream) keeps a per-shard BucketPlan cache
+        # so a delta batch rebuilds plans only for its dirty shards
+        cache = getattr(self, "_bucket_plan_cache", None)
         self._bucket_tables = self._cached_tables(
             kind, lambda: build_sharded_bucket_tables(
-                self.sg, min_width=merge, slab=slab_on))
+                self.sg, min_width=merge, slab=slab_on,
+                plan_cache=cache, dirty=dirty))
         # the kernel's clip-mode gathers are sound only for
         # in-bounds tables; a rotted cache must fail HERE, loudly,
         # not clamp to wrong rows mid-epoch
@@ -646,6 +650,120 @@ class Trainer:
             if self.tcfg.grad_corr:
                 comm["bavg"][str(i)] = np.zeros((self.P, H, f), np.float32)
         return comm
+
+    # ---------------- streaming deltas (stream/patch.py) --------------
+
+    def enable_stream(self, patcher) -> None:
+        """Attach a GraphPatcher so apply_graph_deltas() can mutate the
+        live training graph between epochs (docs/STREAMING.md). The
+        patcher must wrap THIS trainer's sg. use_pp is refused: its
+        one-shot feature precompute bakes the pre-delta topology into
+        the layer-0 concat, which a patch cannot fix incrementally."""
+        if self.cfg.use_pp:
+            raise ValueError(
+                "streaming deltas are incompatible with use_pp: the "
+                "precomputed layer-0 aggregation would go stale on "
+                "every topology change")
+        if patcher.sg is not self.sg:
+            raise ValueError(
+                "patcher wraps a different ShardedGraph than this "
+                "trainer's")
+        self._stream = patcher
+        # per-shard BucketPlan cache for dirty-shard-only rebuilds
+        # (_use_bucket passes it through to build_sharded_bucket_tables)
+        self._bucket_plan_cache: dict = {}
+
+    def apply_graph_deltas(self, batch, allow_repad: bool = True):
+        """Apply one DeltaBatch to the live trainer: patch the sharded
+        graph in place (stream/patch.py), rebuild only the affected
+        kernel tables, re-upload the data dict, and flush the pipelined
+        carry rows whose halo slots changed. Compiled shapes are static
+        across deltas (the step is NOT rebuilt) unless the patch
+        exhausted the reserved slack and re-padded — then every shape
+        grew and a recompile is the documented, loud exception.
+
+        Returns the PatchReport (tables_rebuilt filled in)."""
+        patcher = getattr(self, "_stream", None)
+        if patcher is None:
+            raise RuntimeError(
+                "call enable_stream(patcher) before apply_graph_deltas")
+        report = patcher.apply(batch, allow_repad=allow_repad)
+        self.sg = patcher.sg
+        rebuilt = 0
+        if report.repadded:
+            # padded dims grew: every table and every compiled program
+            # keyed on them is invalid. Full rebuild path — identical
+            # to __init__'s setup, minus use_pp (refused above).
+            self._bucket_plan_cache = {}
+            self._setup_spmm()
+            self._edges_trimmed = (self._bucket_tables is not None
+                                   or self._block_tables is not None
+                                   or self._gat_tables is not None)
+            rebuilt = self.P * max(
+                (self._bucket_tables is not None)
+                + (self._block_tables is not None)
+                + (self._gat_tables is not None), 1)
+            self.data = self._put_data(skip_edges=self._edges_trimmed)
+            self._step = self._build_step()
+            # the carry's [P, H, f] shapes changed; restart the pipeline
+            # from a zero carry (one staleness-reset epoch, same as the
+            # sentinel's rollback flush)
+            self.state = dict(self.state)
+            self.state["comm"] = jax.device_put(
+                self._init_comm(), self._shard)
+        else:
+            dirty = report.touched_parts or None
+            if self._bucket_tables is not None:
+                self._use_bucket(dirty=dirty)
+                rebuilt += len(dirty) if dirty else self.P
+            if self._block_tables is not None:
+                self._use_block()  # block plans are whole-shard; full
+                rebuilt += self.P
+            if self._gat_tables is not None:
+                from ..ops.gat_bucket import build_sharded_gat_tables
+
+                self._gat_tables = self._cached_tables(
+                    "gat", lambda: build_sharded_gat_tables(self.sg))
+                rebuilt += self.P
+            self.data = self._put_data(skip_edges=self._edges_trimmed)
+            self._flush_comm_rows(report)
+        if self.cfg.compute_dtype != jnp.float32:
+            self.data["feat"] = self.data["feat"].astype(
+                self.cfg.compute_dtype)
+        # the host Graph mutated in place: id-keyed eval caches would
+        # serve the pre-delta topology (program cache is shape-keyed
+        # and stays — that is the zero-recompile pin)
+        self._eval_cache.clear()
+        self._sharded_eval_cache.clear()
+        report.tables_rebuilt = rebuilt
+        return report
+
+    def _flush_comm_rows(self, report) -> None:
+        """Zero the pipelined carry rows invalidated by a patch: halo
+        slots whose send-list entry moved/appeared/vanished carry
+        features (receiver view) and boundary grads (sender view) for
+        the WRONG node — one flushed row costs one epoch of staleness-1
+        correction on that row, a stale-wrong-node row corrupts it."""
+        comm = self.state.get("comm")
+        if not comm or report.changed_send is None:
+            return
+        from ..stream.patch import flush_masks
+
+        recv, send = flush_masks(report.changed_send, self.P,
+                                 self.sg.b_max)
+        if not (recv.any() or send.any()):
+            return
+        masks = {"halo": recv, "favg": recv, "bgrad": send, "bavg": send}
+        new_comm = {}
+        for grp, bufs in comm.items():
+            m = jax.device_put(jnp.asarray(masks[grp][:, :, None]),
+                               self._shard)
+            new_comm[grp] = {
+                k: jnp.where(m, jnp.zeros((), v.dtype), v)
+                for k, v in bufs.items()
+            }
+        self.state = dict(self.state)
+        self.state["comm"] = new_comm
 
     # ---------------- pp precompute -----------------------------------
 
@@ -1419,6 +1537,7 @@ class Trainer:
         preemption=None,
         fault_plan=None,
         coord=None,
+        stream_plan=None,
     ) -> Dict[str, Any]:
         """The single epoch loop (reference train.py:327-400): periodic
         evaluation, best-val/BN-stats tracking, timing with <5-epoch
@@ -1499,6 +1618,15 @@ class Trainer:
         the legacy auto-window (epochs start+6..start+8) applies, and
         the same analysis runs on it. The record rides the metrics
         sink and the returned result dict ("profile").
+
+        `stream_plan` (stream.StreamPlan or None) applies graph delta
+        batches at their scheduled epoch boundaries via
+        apply_graph_deltas (enable_stream must have been called).
+        Fused blocks are clamped so no block straddles a scheduled
+        delta, delta epochs run unfused with a forced staleness probe
+        (the probe's drift IS the per-delta drift measurement), and
+        each application emits a contracted ``stream`` record
+        (docs/STREAMING.md).
 
         `staleness_probe_every=N` (pipelined mode only) measures, every
         N epochs, the per-layer relative drift between the STALE
@@ -1700,6 +1828,10 @@ class Trainer:
             # a resumed run gets the same --fault-plan; entries it
             # already lived through must not re-fire
             fault_plan.skip_before(start_epoch)
+        if stream_plan is not None:
+            # a resumed run's checkpointed graph already contains the
+            # deltas applied before start_epoch
+            stream_plan.skip_before(start_epoch)
         if coord is not None:
             coord.start()
             coord.set_checkpoint(checkpoint_dir, checkpoint_keep)
@@ -1720,6 +1852,60 @@ class Trainer:
                     # a dead peer can never complete a collective:
                     # raise PeerLost BEFORE dispatching anything
                     coord.check_peers()
+                # ---- streaming deltas: the graph changes HERE, at the
+                # boundary where the donated state is consistent ----
+                stream_reports = []
+                stream_due = [] if stream_plan is None else \
+                    stream_plan.due(epoch)
+                if (stream_due or (fault_plan is not None and
+                                   fault_plan.peek("graph-delta", epoch))) \
+                        and pending is not None:
+                    # an in-flight async eval was dispatched against the
+                    # pre-patch topology; finish it before the graph (and
+                    # the host-side eval context) grows under it
+                    _harvest_eval(pending)
+                    pending = None
+                if stream_plan is not None:
+                    for sb in stream_due:
+                        rep = self.apply_graph_deltas(sb)
+                        log_fn(
+                            f"stream delta seq={rep.seq} at epoch "
+                            f"{epoch}: +{rep.edges_added}/-"
+                            f"{rep.edges_deleted} edges, "
+                            f"+{rep.nodes_added} nodes, "
+                            f"{rep.patch_ms:.1f} ms patch"
+                            + (" [re-padded: recompile]"
+                               if rep.repadded else ""))
+                        stream_reports.append(rep)
+                        if rep.repadded:
+                            # the rebuilt step recompiles; keep its
+                            # first blocks out of the timing stats
+                            seen_chunks.clear()
+                if fault_plan is not None and \
+                        fault_plan.due("graph-delta", epoch):
+                    # chaos lane: an unscheduled synthetic delta batch
+                    # hits the live graph mid-run (scripts/chaos.sh)
+                    if getattr(self, "_stream", None) is None:
+                        log_fn(f"fault graph-delta at epoch {epoch} "
+                               f"skipped: streaming not enabled")
+                    else:
+                        from ..graph.synthetic import \
+                            synthetic_delta_schedule
+
+                        fb = synthetic_delta_schedule(
+                            self._stream.g, n_batches=1,
+                            edges_per_batch=4, dels_per_batch=2,
+                            nodes_per_batch=1, seed=epoch,
+                            start_seq=self._stream.last_seq + 1)[0]
+                        rep = self.apply_graph_deltas(fb)
+                        log_fn(f"fault-injected graph delta at epoch "
+                               f"{epoch} (seq={rep.seq})")
+                        if metrics is not None:
+                            metrics.fault(kind="injected", epoch=epoch,
+                                          reason="graph-delta")
+                        stream_reports.append(rep)
+                        if rep.repadded:
+                            seen_chunks.clear()
                 if fault_plan is not None and fault_plan.due("crash", epoch):
                     raise RuntimeError(
                         f"fault-injected crash at epoch {epoch}")
@@ -1835,6 +2021,11 @@ class Trainer:
                 for m in periods:
                     to_boundary = m - epoch % m
                     chunk = min(chunk, to_boundary)
+                if stream_plan is not None:
+                    # a fused block must not straddle a scheduled delta
+                    nxt = stream_plan.next_epoch(epoch + 1)
+                    if nxt is not None:
+                        chunk = min(chunk, nxt - epoch)
                 if prof_window is not None and not profiling and \
                         epoch < prof_window[0]:
                     # a fused block must not straddle the window start
@@ -1845,8 +2036,11 @@ class Trainer:
                 # staleness probe: snapshot the stale halo carry BEFORE
                 # the dispatch donates it (obs docs: drift is old vs
                 # new carry — exchange(h[e-1]) vs exchange(h[e]))
-                probe_due = (probe_every > 0
-                             and epoch % probe_every == 0
+                # delta epochs always probe: the drift across the first
+                # post-patch step IS the per-delta drift measurement
+                probe_due = ((probe_every > 0
+                              and epoch % probe_every == 0
+                              or bool(stream_reports))
                              and bool(self.state.get("comm")))
                 old_halo = None
                 if probe_due:
@@ -1978,9 +2172,11 @@ class Trainer:
                 # ---- staleness probe: relative drift between the
                 # stale halo features this epoch consumed (snapshotted
                 # above) and the fresh ones it shipped ----
+                stream_drift = None
                 if probe_due and old_halo is not None:
                     layers, max_rel = self._staleness_drift(
                         old_halo, self.state["comm"]["halo"])
+                    stream_drift = float(max_rel)
                     if metrics is not None:
                         metrics.staleness(epoch=epoch, layers=layers,
                                           max_rel_drift=max_rel)
@@ -1988,6 +2184,21 @@ class Trainer:
                         log_fn(f"staleness probe epoch {epoch}: max "
                                f"relative drift {max_rel:.4f}")
                     old_halo = None
+                # ---- contracted `stream` records for this boundary's
+                # delta applications (drift measured by the forced
+                # probe above; None when the pipeline is off) ----
+                if stream_reports and metrics is not None:
+                    for rep in stream_reports:
+                        metrics.stream(
+                            epoch=epoch, seq=rep.seq,
+                            edges_added=rep.edges_added,
+                            edges_deleted=rep.edges_deleted,
+                            nodes_added=rep.nodes_added,
+                            patch_ms=rep.patch_ms,
+                            tables_rebuilt=rep.tables_rebuilt,
+                            repadded=rep.repadded,
+                            slack_remaining=rep.slack_remaining,
+                            drift=stream_drift)
                 # ---- divergence sentinel: check the block, roll back
                 # on trip (restore last good snapshot, back the LR off,
                 # flush the stale halo carry), bounded retries. With an

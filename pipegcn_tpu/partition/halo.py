@@ -200,9 +200,21 @@ class ShardedGraph:
 
     # ------------------------------------------------------------------
     @staticmethod
+    def _padded_dim(raw: int, pad_to: int, slack: float = 0.0,
+                    floor: int = 0) -> int:
+        """Padded size of a per-device dimension: the raw maximum grown
+        by the streaming `slack` fraction (reserved headroom so
+        stream/patch.py can add entries without changing compiled
+        shapes), floored at `floor` (a bit-identity re-pad target), then
+        rounded up to `pad_to`."""
+        grown = int(np.ceil(raw * (1.0 + max(slack, 0.0))))
+        return _round_up(max(grown, int(floor)), pad_to)
+
+    @staticmethod
     def _send_structures(pair_fused: np.ndarray, parts: np.ndarray,
                          local_id: np.ndarray, num_parts: int, n: int,
-                         pad_to: int) -> Dict[str, np.ndarray]:
+                         pad_to: int, slack: float = 0.0,
+                         min_b_max: int = 0) -> Dict[str, np.ndarray]:
         """Send lists + halo-slot lookup from the sorted unique
         (node, dest part) fused-pair array — the shared core of build()
         and build_chunked().
@@ -227,8 +239,9 @@ class ShardedGraph:
             combo, minlength=num_parts * num_parts
         ).reshape(num_parts, num_parts)
         assert np.all(np.diag(send_counts) == 0)
-        b_max = _round_up(int(send_counts.max()), pad_to) \
-            if num_parts > 1 else 0
+        b_max = ShardedGraph._padded_dim(
+            int(send_counts.max()), pad_to, slack, min_b_max
+        ) if num_parts > 1 else 0
 
         combo_starts = np.zeros(num_parts * num_parts + 1, dtype=np.int64)
         np.cumsum(send_counts.reshape(-1), out=combo_starts[1:])
@@ -339,6 +352,10 @@ class ShardedGraph:
         cluster: Optional[np.ndarray] = None,
         reorder: str = "none",
         reorder_seed: int = 0,
+        slack: float = 0.0,
+        min_n_max: int = 0,
+        min_b_max: int = 0,
+        min_e_max: int = 0,
     ) -> "ShardedGraph":
         """Build the sharded layout from a graph and a partition assignment.
 
@@ -364,6 +381,15 @@ class ShardedGraph:
         (ops/bucket_spmm slab plans). The base-layout permutation and
         its inverse are stored on the result (reorder_perm/reorder_inv)
         and ride the artifact.
+
+        `slack` (streaming headroom, stream/patch.py) grows every padded
+        per-device dimension (n_max, b_max, e_max) by that fraction over
+        its raw maximum before rounding, reserving in-place growth room
+        for delta patching without changing compiled shapes. The
+        `min_*` floors force specific padded dimensions — the re-pad
+        path and the patched-vs-rebuilt bit-identity oracle use them to
+        rebuild a graph into the exact layout a patched ShardedGraph
+        occupies.
         """
         n = g.num_nodes
         parts = parts.astype(np.int32)
@@ -387,7 +413,8 @@ class ShardedGraph:
             parts[train_mask], minlength=num_parts
         ).astype(np.int32)
 
-        n_max = _round_up(int(part_sizes.max()), pad_to)
+        n_max = ShardedGraph._padded_dim(
+            int(part_sizes.max()), pad_to, slack, min_n_max)
 
         # ---- send lists ----------------------------------------------
         # cross edges define which (owner node, dest part) pairs exist;
@@ -399,14 +426,17 @@ class ShardedGraph:
             cs.astype(np.int64) * num_parts + parts[cd]
         )  # sorted by (node, dest part), same order as the row unique
         ss = ShardedGraph._send_structures(pair_fused, parts, local_id,
-                                           num_parts, n, pad_to)
+                                           num_parts, n, pad_to,
+                                           slack=slack,
+                                           min_b_max=min_b_max)
         send_counts, b_max = ss["send_counts"], ss["b_max"]
         send_idx, send_mask = ss["send_idx"], ss["send_mask"]
 
         # ---- per-device edges ----------------------------------------
         edge_owner = parts[g.dst]  # device that owns each edge
         e_sizes = np.bincount(edge_owner, minlength=num_parts)
-        e_max = _round_up(int(e_sizes.max()), 128)
+        e_max = ShardedGraph._padded_dim(
+            int(e_sizes.max()), 128, slack, min_e_max)
 
         src_local_all, dst_local_all = ShardedGraph._localize_edges(
             g.src, g.dst, parts, local_id, ss, num_parts, n_max, b_max)

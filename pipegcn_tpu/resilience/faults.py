@@ -43,6 +43,13 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 the elastic SUPERVISOR reads it (via :meth:`schedule`)
                 and folds the member back into generation G's
                 assignment, rebalancing shards
+  graph-delta   ``graph-delta@E[:rN]``: apply an unscheduled synthetic
+                graph delta batch (stream/patch.py) to the live
+                training graph at that epoch boundary — edges appear
+                and vanish, a node arrives — exercising the incremental
+                patch, the carry flush, and the forced drift probe
+                mid-run without a prepared delta file. Requires
+                streaming to be enabled (warn + skip otherwise)
   replica-kill  ``replica-kill@W[:mK]``: SIGKILL serving replica K at
                 serving report window W (default replica 0). Inert in
                 the trainer — the serving FLEET driver reads it (via
@@ -79,11 +86,11 @@ from typing import List, Optional
 
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
          "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin",
-         "replica-kill")
+         "replica-kill", "graph-delta")
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire
 _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
-                   "kill", "replica-kill")
+                   "kill", "replica-kill", "graph-delta")
 
 _ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm])(\d+))?$")
 
@@ -170,6 +177,15 @@ class FaultPlan:
                 e.consumed = True
                 return True
         return False
+
+    def peek(self, kind: str, epoch: int) -> bool:
+        """Non-consuming `due`: would a `kind` fault targeting this
+        rank fire at-or-before `epoch`? Lets the trainer settle
+        in-flight work (e.g. harvest a pending async eval) before the
+        consuming `due` call actually mutates anything."""
+        return any(not e.consumed and e.kind == kind
+                   and e.epoch <= epoch and self._mine(e)
+                   for e in self._entries)
 
     def schedule(self, kind: str) -> List[tuple]:
         """Non-consuming (epoch-or-generation, rank) view of every

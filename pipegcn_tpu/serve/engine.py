@@ -2,9 +2,10 @@
 
 The serving counterpart of `parallel/evaluator.py`: one donated-buffer,
 shard_map'd forward program over the partitioned graph — no dropout, no
-grads, no metric reduce — plus four tiny companion programs (full halo
-exchange, incremental dirty-row exchange, in-place feature patch, and
-the replicated query gather). All five are built ONCE per engine and
+grads, no metric reduce — plus five tiny companion programs (full halo
+exchange, incremental dirty-row exchange, in-place feature patch,
+changed-slot halo flush, and the replicated query gather). All are
+built ONCE per engine and
 traced once per input shape; the batcher's power-of-two ladder keeps
 the shape population finite, so after `warmup()` steady-state traffic
 never recompiles (pinned by the TRACE_COUNTS test in test_serve.py).
@@ -57,6 +58,7 @@ from .freshness import FreshnessTracker, dirty_exchange_blocks
 # recompiles exactly. The no-recompile acceptance test pins these.
 TRACE_COUNTS: Dict[str, int] = {
     "exchange": 0, "inc": 0, "refresh": 0, "patch": 0, "query": 0,
+    "flush": 0,
 }
 
 
@@ -118,6 +120,9 @@ class ServingEngine:
         self._feat_lag = 0   # update batches not yet in _logits
         self._halo_lag = 0   # update batches whose boundary rows are
         #                      not yet in _halo0
+        # topology-generation axis (schema v8): count of graph delta
+        # batches this engine's topology reflects (docs/STREAMING.md)
+        self.topo_generation = 0
 
         # ---------------- device state --------------------------------
         # private copy of the feature shard: serving patches it under
@@ -228,6 +233,19 @@ class ServingEngine:
 
         self._patch_prog = jax.jit(jax.shard_map(
             patch_fn, mesh=mesh, in_specs=(spec, repl, repl, repl),
+            out_specs=spec), donate_argnums=(0,))
+
+        def flush_fn(halo0, m):
+            # zero receiver-side halo slots whose send-list entry a
+            # topology delta moved or removed: a removed entry's slot
+            # must read zero (what a full exchange produces for a
+            # masked-off slot), a moved entry's slot is re-shipped by
+            # the next incremental refresh
+            TRACE_COUNTS["flush"] += 1
+            return jnp.where(m, jnp.zeros((), halo0.dtype), halo0)
+
+        self._flush_prog = jax.jit(jax.shard_map(
+            flush_fn, mesh=mesh, in_specs=(spec, spec),
             out_specs=spec), donate_argnums=(0,))
 
         def query_fn(logits, qp, ql):
@@ -358,6 +376,12 @@ class ServingEngine:
             qp = np.full(b, -1, np.int32)
             ql = np.zeros(b, np.int32)
             np.asarray(self._query_prog(self._logits, qp, ql))
+        # trace the topology-delta flush with an all-clear mask so the
+        # first live delta replays compiled code (no-op on the values)
+        m = jax.device_put(
+            jnp.zeros((self.P, (self.P - 1) * self.sg.b_max, 1), bool),
+            self.trainer._shard)
+        self._halo0 = self._flush_prog(self._halo0, m)
         return time.monotonic() - t0
 
     # ---------------- freshness path ----------------------------------
@@ -408,6 +432,115 @@ class ServingEngine:
         self._feat_lag += 1
         if touched:
             self._halo_lag += 1
+        return touched
+
+    def apply_graph_deltas(self, report) -> int:
+        """Sync the engine with a topology delta the TRAINER just
+        applied (Trainer.apply_graph_deltas -> PatchReport): re-bind
+        the patched static inputs (send-lists, degrees, kernel tables),
+        extend the query routing for new nodes, feed new-node features
+        through the compiled patch ladder, zero the layer-0 cache slots
+        whose send-list entry moved or vanished, and mark the moved /
+        degree-changed rows dirty so the next refresh_boundary() merge
+        is bit-identical to a full exchange. No retracing: every shape
+        is unchanged (the patcher's slack guarantee). Returns the
+        number of halo cache slots invalidated.
+
+        A re-padded report means every compiled program's shapes grew;
+        the engine cannot be patched and must be rebuilt."""
+        if self.cfg.use_pp:
+            raise ValueError(
+                "topology deltas are unsupported under use_pp (the "
+                "precomputed layer-0 aggregate bakes in the old "
+                "topology); serve with use_pp off")
+        if report.repadded:
+            cache = getattr(self.trainer, "_serving_engines", None)
+            if cache:
+                cache.clear()
+            raise RuntimeError(
+                "graph delta re-padded the sharded graph: compiled "
+                "serving shapes grew; rebuild the engine via "
+                "ServingEngine.for_trainer")
+        from ..stream.patch import flush_masks
+
+        sg = self.trainer.sg
+        self.sg = sg
+        # the trainer re-uploaded every patched array + rebuilt kernel
+        # tables; same shapes, so the compiled programs replay
+        self._static = {k: v for k, v in self.trainer.data.items()
+                        if k not in _NON_STATIC}
+        # ---- host routing: new nodes become queryable -----------------
+        nid = np.asarray(sg.global_nid)
+        self.num_global_nodes = int((nid >= 0).sum())
+        self._q_part = np.full(self.num_global_nodes, -1, np.int32)
+        self._q_local = np.zeros(self.num_global_nodes, np.int32)
+        for p in range(self.P):
+            own = np.nonzero(nid[p] >= 0)[0]
+            self._q_part[nid[p, own]] = p
+            self._q_local[nid[p, own]] = own.astype(np.int32)
+        # ---- new-node features -> private feature shard ---------------
+        if report.new_rows is not None and report.new_rows.any():
+            pp, rr = np.nonzero(report.new_rows)
+            vals = np.asarray(sg.feat)[pp, rr].astype(np.float32)
+            wide = _pad_cols(vals, self.trainer._feat_pad)
+            top = self.update_ladder[-1]
+            for i0 in range(0, pp.size, top):
+                sl = slice(i0, min(i0 + top, pp.size))
+                n = sl.stop - sl.start
+                b = bucket_for(n, self.update_ladder)
+                up = np.full(b, -1, np.int32)
+                ul = np.zeros(b, np.int32)
+                uv = np.zeros((b, wide.shape[1]), np.float32)
+                up[:n], ul[:n] = pp[sl].astype(np.int32), \
+                    rr[sl].astype(np.int32)
+                uv[:n] = wide[sl]
+                self._feat = self._patch_prog(self._feat, up, ul, uv)
+        # ---- layer-0 cache: rebuild the ledger on the patched
+        # send-lists, carrying over hit accounting and still-valid
+        # stale bits (slot positions are unchanged where the entry is) -
+        old = self.cache
+        self.cache = Layer0Cache(sg.send_idx, sg.send_mask)
+        self.cache.hits, self.cache.misses = old.hits, old.misses
+        if old.stale.shape == self.cache.stale.shape:
+            self.cache.stale[:] = old.stale
+        touched = 0
+        recv = None
+        ch = report.changed_send
+        if ch is not None and ch.any():
+            recv, _ = flush_masks(ch, self.P, sg.b_max)
+            # zero every changed receiver slot (device): removed
+            # entries must read zero, moved entries are re-shipped by
+            # the incremental refresh below
+            m = jax.device_put(jnp.asarray(recv[:, :, None]),
+                               self.trainer._shard)
+            self._halo0 = self._flush_prog(self._halo0, m)
+            self.cache.stale |= recv
+            touched += int(recv.sum())
+            # owner rows behind surviving changed entries: dirty, so
+            # the next incremental exchange re-ships their values
+            si = np.asarray(sg.send_idx)
+            sel = ch & np.asarray(sg.send_mask).astype(bool)
+            for p in range(self.P):
+                rows = si[p][sel[p]]
+                if rows.size:
+                    self.freshness.mark(np.full(rows.size, p), rows)
+        # ---- degree-changed + new rows: their send view changed (GCN
+        # pre-scales by in_deg) or they are brand new — re-ship every
+        # slot they feed ------------------------------------------------
+        dirty_rows = np.zeros((self.P, self.n_max), bool)
+        if report.deg_changed is not None:
+            dirty_rows |= report.deg_changed
+        if report.new_rows is not None:
+            dirty_rows |= report.new_rows
+        pp, rr = np.nonzero(dirty_rows)
+        if pp.size:
+            self.freshness.mark(pp, rr)
+            touched += self.cache.invalidate_rows(pp, rr)
+        # ---- staleness ledger -----------------------------------------
+        self._feat_lag += 1
+        if touched:
+            self._halo_lag += 1
+        self.topo_generation += 1
         return touched
 
     def refresh_boundary(self) -> int:

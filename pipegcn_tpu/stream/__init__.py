@@ -1,0 +1,35 @@
+"""Streaming-graph subsystem: delta ingestion + incremental patching.
+
+The paper trains on a static full graph; production graphs change while
+you train and serve. This package closes that gap (ROADMAP item 4,
+second half) without ever re-running METIS:
+
+  deltas.py   versioned add-edge/del-edge/add-node batch format
+              (CRC-guarded JSONL or npz, monotonic sequence ids) and
+              the ``FILE@epoch[:everyN]`` application schedule
+  patch.py    incremental CSR + sharded-table patching: new edges land
+              in the existing partition of their endpoints, send/recv
+              lists and halo slots grow in place through the reserved
+              ``--stream-slack`` headroom, so the compiled step's
+              shapes are STATIC across deltas. Bit-identity of the
+              patched ShardedGraph vs a from-scratch build of the same
+              final edge list is the correctness oracle.
+
+See docs/STREAMING.md for the delta format, the slack model, and the
+drift-measurement methodology.
+"""
+
+from .deltas import (DELTA_FORMAT_VERSION, DeltaBatch, StreamPlan,
+                     load_deltas, save_deltas)
+from .patch import GraphPatcher, PatchReport, SlackExhausted
+
+__all__ = [
+    "DELTA_FORMAT_VERSION",
+    "DeltaBatch",
+    "StreamPlan",
+    "load_deltas",
+    "save_deltas",
+    "GraphPatcher",
+    "PatchReport",
+    "SlackExhausted",
+]

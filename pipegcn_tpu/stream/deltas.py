@@ -1,0 +1,353 @@
+"""Versioned graph-delta batch format + epoch application schedule.
+
+A delta batch is the unit of graph change: a set of directed COO edge
+additions/deletions plus fully-described new nodes, stamped with a
+monotonically increasing sequence id. Batches are applied atomically by
+stream/patch.py (capacity is pre-checked against the reserved slack
+before anything mutates).
+
+On-disk formats (chosen by extension):
+
+  *.jsonl   one JSON object per line; human-diffable. Every record
+            carries a ``crc`` field — CRC32 of the canonical
+            serialization (sorted keys, compact separators) of the
+            record WITHOUT the crc field. A header line pins the format
+            name and version.
+  *.npz     array-native for large batches: per-batch arrays plus a
+            per-batch CRC32 over the raw array bytes (dtype/shape
+            prefixed, so a reinterpreting tamper is caught too).
+
+Both loaders reject CRC mismatches, version skew, and non-monotonic
+sequence ids loudly — a torn or tampered delta file must never be
+half-applied to a serving topology.
+
+Edge semantics: entries are DIRECTED COO edges, matching graph/csr.py
+(message flows src -> dst). The synthetic generator emits both
+directions of each undirected change, mirroring how the real datasets
+store symmetric adjacency. Self-loops are managed by the patcher (every
+node keeps exactly one; add-node implies its self-loop) and may not
+appear in add/del lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DELTA_FORMAT_VERSION = 1
+_FORMAT_NAME = "pipegcn-deltas"
+
+
+@dataclasses.dataclass
+class DeltaBatch:
+    """One atomic graph change set.
+
+    add_edges / del_edges: [K, 2] int64 directed (src, dst) COO entries
+    between nodes that exist BEFORE this batch's node additions are
+    applied — except add_edges may also reference the batch's own new
+    nodes (their ids are assigned first; see patch.py apply order).
+    node_feat [M, F] float32, node_label [M] (int64, or [M, C] float32
+    multi-hot), node_nbrs: M int64 arrays — each new node's undirected
+    neighbor set (both directions are materialized, plus the node's
+    self-loop). New nodes are never training nodes: local train-first
+    renumbering would otherwise shift every existing local id.
+    """
+
+    seq: int
+    add_edges: np.ndarray
+    del_edges: np.ndarray
+    node_feat: np.ndarray
+    node_label: np.ndarray
+    node_nbrs: Tuple[np.ndarray, ...] = ()
+
+    @property
+    def n_add(self) -> int:
+        return int(self.add_edges.shape[0])
+
+    @property
+    def n_del(self) -> int:
+        return int(self.del_edges.shape[0])
+
+    @property
+    def n_new(self) -> int:
+        return int(self.node_feat.shape[0])
+
+    @staticmethod
+    def make(seq: int, add_edges=(), del_edges=(), node_feat=None,
+             node_label=None, node_nbrs=()) -> "DeltaBatch":
+        """Normalizing constructor: coerces lists/tuples into the
+        canonical array dtypes (empty inputs become [0, 2] / [0, F=0]
+        arrays so downstream shape logic never branches)."""
+        ae = np.asarray(add_edges, np.int64).reshape(-1, 2)
+        de = np.asarray(del_edges, np.int64).reshape(-1, 2)
+        if node_feat is None:
+            nf = np.zeros((0, 0), np.float32)
+        else:
+            nf = np.asarray(node_feat, np.float32)
+            if nf.size == 0:
+                nf = np.zeros((0, nf.shape[-1] if nf.ndim > 1 else 0),
+                              np.float32)
+            else:
+                nf = nf.reshape(-1, nf.shape[-1] if nf.ndim > 1
+                                else nf.size)
+        if node_label is None:
+            nl = np.zeros((nf.shape[0],), np.int64)
+        else:
+            nl = np.asarray(node_label)
+            nl = nl.astype(np.float32) if nl.ndim == 2 else \
+                nl.astype(np.int64).reshape(-1)
+        nbrs = tuple(np.asarray(x, np.int64).reshape(-1)
+                     for x in node_nbrs)
+        if len(nbrs) != nf.shape[0]:
+            raise ValueError(
+                f"batch seq={seq}: {nf.shape[0]} new nodes but "
+                f"{len(nbrs)} neighbor lists")
+        return DeltaBatch(int(seq), ae, de, nf, nl, nbrs)
+
+
+# ---------------------------------------------------------------------
+# CRC guards
+# ---------------------------------------------------------------------
+
+def _canon_payload(b: DeltaBatch) -> dict:
+    multilabel = b.node_label.ndim == 2
+    return {
+        "seq": int(b.seq),
+        "add_edges": b.add_edges.tolist(),
+        "del_edges": b.del_edges.tolist(),
+        "node_feat": [[float(x) for x in row] for row in b.node_feat],
+        "node_label": b.node_label.tolist(),
+        "node_label_multilabel": bool(multilabel),
+        "node_nbrs": [x.tolist() for x in b.node_nbrs],
+    }
+
+
+def _json_crc(payload: dict) -> int:
+    blob = json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return zlib.crc32(blob) & 0xFFFFFFFF
+
+
+def _array_crc(arrs: Sequence[np.ndarray]) -> int:
+    # dtype/shape prefix per array: a tamper that reinterprets bytes
+    # (e.g. swaps two same-size arrays) changes the stream too
+    c = 0
+    for a in arrs:
+        a = np.ascontiguousarray(a)
+        c = zlib.crc32(f"{a.dtype.str}|{a.shape}|".encode(), c)
+        c = zlib.crc32(a.tobytes(), c)
+    return c & 0xFFFFFFFF
+
+
+def batch_crc(b: DeltaBatch) -> int:
+    """Content CRC of a batch (the JSONL-record guard)."""
+    return _json_crc(_canon_payload(b))
+
+
+# ---------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------
+
+def save_deltas(path: str, batches: Sequence[DeltaBatch]) -> None:
+    """Write a delta file (format by extension: .npz or JSONL)."""
+    _check_monotonic(batches, path)
+    if path.endswith(".npz"):
+        _save_npz(path, batches)
+        return
+    with open(path, "w") as f:
+        hdr = {"format": _FORMAT_NAME, "version": DELTA_FORMAT_VERSION,
+               "n_batches": len(batches)}
+        hdr["crc"] = _json_crc(hdr)
+        f.write(json.dumps(hdr, sort_keys=True) + "\n")
+        for b in batches:
+            payload = _canon_payload(b)
+            payload["crc"] = _json_crc(payload)
+            f.write(json.dumps(payload, sort_keys=True) + "\n")
+
+
+def load_deltas(path: str) -> List[DeltaBatch]:
+    """Load + verify a delta file. Raises ValueError on CRC mismatch,
+    version skew, or non-monotonic sequence ids."""
+    if path.endswith(".npz"):
+        return _load_npz(path)
+    batches: List[DeltaBatch] = []
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty delta file")
+    hdr = json.loads(lines[0])
+    _check_header(hdr, path)
+    for i, ln in enumerate(lines[1:]):
+        rec = json.loads(ln)
+        crc = rec.pop("crc", None)
+        if crc is None or _json_crc(rec) != crc:
+            raise ValueError(
+                f"{path}: CRC mismatch on batch record {i} "
+                f"(seq={rec.get('seq')}) — torn write or tamper")
+        multilabel = rec.get("node_label_multilabel", False)
+        nl = np.asarray(rec["node_label"],
+                        np.float32 if multilabel else np.int64)
+        nf = np.asarray(rec["node_feat"], np.float32)
+        if nf.size == 0:
+            nf = nf.reshape(0, 0)
+        batches.append(DeltaBatch.make(
+            rec["seq"], rec["add_edges"], rec["del_edges"],
+            nf, nl, [np.asarray(x, np.int64) for x in rec["node_nbrs"]],
+        ))
+    _check_monotonic(batches, path)
+    return batches
+
+
+def _check_header(hdr: dict, path: str) -> None:
+    crc = dict(hdr)
+    got = crc.pop("crc", None)
+    if got is None or _json_crc(crc) != got:
+        raise ValueError(f"{path}: header CRC mismatch")
+    if hdr.get("format") != _FORMAT_NAME:
+        raise ValueError(
+            f"{path}: not a {_FORMAT_NAME} file "
+            f"(format={hdr.get('format')!r})")
+    if hdr.get("version") != DELTA_FORMAT_VERSION:
+        raise ValueError(
+            f"{path}: delta format version {hdr.get('version')} != "
+            f"supported {DELTA_FORMAT_VERSION}")
+
+
+def _check_monotonic(batches: Sequence[DeltaBatch], path: str) -> None:
+    seqs = [b.seq for b in batches]
+    if any(b >= a for a, b in zip(seqs[1:], seqs[:-1])):
+        raise ValueError(
+            f"{path}: sequence ids must be strictly increasing, "
+            f"got {seqs}")
+
+
+def _save_npz(path: str, batches: Sequence[DeltaBatch]) -> None:
+    arrs = {"version": np.int64(DELTA_FORMAT_VERSION),
+            "n_batches": np.int64(len(batches))}
+    for i, b in enumerate(batches):
+        k = f"b{i:05d}_"
+        nbr_ptr = np.zeros(len(b.node_nbrs) + 1, np.int64)
+        np.cumsum([x.size for x in b.node_nbrs], out=nbr_ptr[1:])
+        nbr_flat = (np.concatenate(b.node_nbrs)
+                    if b.node_nbrs else np.zeros(0, np.int64))
+        parts = [np.int64(b.seq), b.add_edges, b.del_edges,
+                 b.node_feat, b.node_label, nbr_flat, nbr_ptr]
+        arrs[k + "seq"] = parts[0]
+        arrs[k + "add_edges"] = parts[1]
+        arrs[k + "del_edges"] = parts[2]
+        arrs[k + "node_feat"] = parts[3]
+        arrs[k + "node_label"] = parts[4]
+        arrs[k + "nbr_flat"] = parts[5]
+        arrs[k + "nbr_ptr"] = parts[6]
+        arrs[k + "crc"] = np.int64(_array_crc(parts))
+    np.savez(path, **arrs)
+
+
+def _load_npz(path: str) -> List[DeltaBatch]:
+    with np.load(path) as z:
+        if int(z["version"]) != DELTA_FORMAT_VERSION:
+            raise ValueError(
+                f"{path}: delta format version {int(z['version'])} != "
+                f"supported {DELTA_FORMAT_VERSION}")
+        batches = []
+        for i in range(int(z["n_batches"])):
+            k = f"b{i:05d}_"
+            parts = [z[k + "seq"], z[k + "add_edges"],
+                     z[k + "del_edges"], z[k + "node_feat"],
+                     z[k + "node_label"], z[k + "nbr_flat"],
+                     z[k + "nbr_ptr"]]
+            if _array_crc(parts) != int(z[k + "crc"]):
+                raise ValueError(
+                    f"{path}: CRC mismatch on batch {i} — torn write "
+                    f"or tamper")
+            seq, ae, de, nf, nl, flat, ptr = parts
+            nbrs = [flat[ptr[j]:ptr[j + 1]] for j in range(ptr.size - 1)]
+            batches.append(DeltaBatch.make(int(seq), ae, de, nf, nl,
+                                           nbrs))
+    _check_monotonic(batches, path)
+    return batches
+
+
+# ---------------------------------------------------------------------
+# epoch application schedule (--stream-plan)
+# ---------------------------------------------------------------------
+
+_PLAN_RE = re.compile(r"^(.+)@(\d+)(?::(\d+))?$")
+
+
+class StreamPlan:
+    """Epoch-keyed delta schedule, parsed from comma-separated
+    ``FILE@E0[:everyN]`` entries: batch j of FILE is applied at the
+    boundary of epoch E0 + j*N (N defaults to 1). Like FaultPlan, every
+    scheduled batch fires at most once and ``due()`` uses an at-or-
+    before comparison so fused-epoch blocks cannot silently skip one;
+    ``skip_before`` retires batches a resumed run already lived
+    through."""
+
+    def __init__(self, scheduled: List[Tuple[int, DeltaBatch]]):
+        self._entries = sorted(scheduled, key=lambda e: (e[0], e[1].seq))
+        self._done = [False] * len(self._entries)
+
+    @classmethod
+    def parse(cls, spec: str) -> "StreamPlan":
+        scheduled: List[Tuple[int, DeltaBatch]] = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _PLAN_RE.match(raw)
+            if not m:
+                raise ValueError(
+                    f"bad stream-plan entry {raw!r}: expected "
+                    f"FILE@epoch[:everyN] (e.g. deltas.jsonl@10:5)")
+            path, e0 = m.group(1), int(m.group(2))
+            every = int(m.group(3)) if m.group(3) else 1
+            if every < 1:
+                raise ValueError(
+                    f"stream-plan entry {raw!r}: everyN must be >= 1")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"stream-plan file not found: {path}")
+            for j, b in enumerate(load_deltas(path)):
+                scheduled.append((e0 + j * every, b))
+        return cls(scheduled)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __bool__(self) -> bool:
+        return bool(self._entries)
+
+    def remaining(self) -> int:
+        return sum(1 for d in self._done if not d)
+
+    def skip_before(self, start_epoch: int) -> None:
+        """Retire batches scheduled strictly before `start_epoch` — a
+        resume's checkpointed graph already contains them (deltas are
+        applied at the START of their epoch, like boundary faults)."""
+        for i, (e, _) in enumerate(self._entries):
+            if e < start_epoch:
+                self._done[i] = True
+
+    def due(self, epoch: int) -> List[DeltaBatch]:
+        """Consume and return every batch scheduled at-or-before
+        `epoch`, in schedule order."""
+        out = []
+        for i, (e, b) in enumerate(self._entries):
+            if not self._done[i] and e <= epoch:
+                self._done[i] = True
+                out.append(b)
+        return out
+
+    def next_epoch(self, after: int) -> Optional[int]:
+        """Smallest unconsumed scheduled epoch >= `after` (for fused-
+        block clamping: the trainer must visit that boundary)."""
+        nxt = [e for i, (e, _) in enumerate(self._entries)
+               if not self._done[i] and e >= after]
+        return min(nxt) if nxt else None

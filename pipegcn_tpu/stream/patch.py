@@ -1,0 +1,637 @@
+"""Incremental CSR + sharded-table patching for streaming graph deltas.
+
+GraphPatcher applies DeltaBatches to a host Graph AND its ShardedGraph
+in place, with **no re-partition**: new edges land on the existing
+partition of their destination endpoint, new nodes on the owner of
+their highest-in-degree neighbor, and send/recv lists + halo slots grow
+through the slack headroom reserved at build time (``--stream-slack``)
+so every compiled shape stays static across deltas.
+
+Correctness oracle: after any batch sequence, every array of the
+patched ShardedGraph is bit-identical to a from-scratch
+``ShardedGraph.build`` of the patcher's host graph with the same padded
+dimensions (``min_n_max``/``min_b_max``/``min_e_max`` floors). The
+patcher guarantees this by construction:
+
+  * local ids never shift — new nodes get global ids above every
+    existing id and are never training nodes, so the build()'s
+    (part, ~train, global id) lexsort appends them exactly where the
+    patcher does (the end of their partition's block). Layouts with
+    extra sort keys (reorder/cluster) are refused at init.
+  * host COO order is maintained deterministically (deletions keep
+    relative order; additions append: per new node its self-loop then
+    both directions of each neighbor edge, then the batch's add_edges)
+    and affected devices' edge arrays are recomputed from the host COO
+    with build()'s exact localization + stable CSR sort, so the
+    tie-break order matches a rebuild of the same Graph object.
+  * send lists stay sorted by local id under in-place insertion and
+    removal, matching _send_structures' (owner, dest, local id) sort.
+
+Capacity is pre-checked against the padded dims BEFORE any mutation;
+exhaustion raises :class:`SlackExhausted` naming the required floors,
+or (``allow_repad=True``) triggers the loud re-pad: a from-scratch
+rebuild at grown padding, after which the batch is re-applied. A re-pad
+changes compiled shapes — consumers must rebuild device state (the
+trainer and serving engine both do, loudly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graph.csr import Graph
+from ..partition.halo import ShardedGraph
+from .deltas import DeltaBatch
+
+# ndata keys the patcher knows how to extend for new nodes; anything
+# else on the host graph would silently desynchronize from a rebuild
+_NDATA_KEYS = ("feat", "label", "train_mask", "val_mask", "test_mask",
+               "in_deg")
+
+
+class SlackExhausted(RuntimeError):
+    """A delta batch does not fit the reserved padding. ``required``
+    holds the raw (unpadded) per-dimension floors a re-pad needs."""
+
+    def __init__(self, msg: str, required: Dict[str, int]):
+        super().__init__(msg)
+        self.required = dict(required)
+
+
+@dataclasses.dataclass
+class PatchReport:
+    """What one batch application did — the payload of the contracted
+    ``stream`` observability record plus the invalidation masks the
+    trainer carry-flush and serving freshness paths consume."""
+
+    seq: int
+    edges_added: int
+    edges_deleted: int
+    nodes_added: int
+    patch_ms: float
+    slack_remaining: Dict[str, int]
+    repadded: bool = False
+    tables_rebuilt: int = 0   # filled in by the trainer/serving layer
+    # [P, P-1, b_max] bool: send-list entries whose content or position
+    # changed (None after a re-pad: everything changed)
+    changed_send: Optional[np.ndarray] = None
+    # [P, n_max] bool: inner rows whose in_degree changed (incl. new)
+    deg_changed: Optional[np.ndarray] = None
+    # [P, n_max] bool: rows added by this batch
+    new_rows: Optional[np.ndarray] = None
+    touched_parts: Tuple[int, ...] = ()
+
+
+def flush_masks(changed_send: np.ndarray, num_parts: int, b_max: int):
+    """(receiver [P, H], sender [P, H]) bool masks over halo-flat rows
+    from a changed-send-entry cube. The pipelined carry is two-view:
+    ``halo``/``favg`` are consumed where RECEIVED (device q = (p+d)%P
+    holds owner p's distance-d block), ``bgrad``/``bavg`` where SENT
+    (make_stale_concat's bwd scatters through the device's own send
+    list) — a flush must zero each in its own frame."""
+    P = num_parts
+    H = (P - 1) * b_max
+    recv = np.zeros((P, H), bool)
+    send = np.zeros((P, H), bool)
+    for p in range(P):
+        for d in range(1, P):
+            ch = changed_send[p, d - 1]
+            if not ch.any():
+                continue
+            flat = slice((d - 1) * b_max, d * b_max)
+            send[p, flat] |= ch
+            recv[(p + d) % P, flat] |= ch
+    return recv, send
+
+
+class GraphPatcher:
+    """In-place delta application against a (host Graph, ShardedGraph,
+    partition assignment) triple. Mutates all three; the host graph and
+    ``parts`` stay rebuild-consistent so the bit-identity oracle (and
+    the loud re-pad) can always fall back to ``ShardedGraph.build``."""
+
+    def __init__(self, g: Graph, sg: ShardedGraph, parts: np.ndarray,
+                 pad_to: int = 8, slack: float = 0.10,
+                 verify_checksum: bool = True):
+        if sg.reorder != "none":
+            raise ValueError(
+                "streaming requires the base layout: reorder="
+                f"{sg.reorder!r} renumbers local ids by a locality key "
+                "the patcher cannot extend incrementally")
+        if sg.local_parts is not None:
+            raise ValueError(
+                "streaming patches the full [P, ...] array stack; "
+                "elastic local_parts views are not patchable")
+        for arr in (sg.edge_src, sg.edge_dst):
+            if not isinstance(arr, np.ndarray):
+                raise ValueError(
+                    "streaming needs writable padded edge arrays; "
+                    "trim_edges artifacts store per-rank views only")
+        self.g = g
+        self.sg = sg
+        self.parts = np.asarray(parts, np.int32).copy()
+        self.pad_to = int(pad_to)
+        self.slack = float(slack)
+        self.P = sg.num_parts
+        if self.parts.shape[0] != g.num_nodes:
+            raise ValueError("parts length != num_nodes")
+        if verify_checksum and sg.source_edge_checksum not in (
+                -1, ShardedGraph.edge_checksum(g)):
+            raise ValueError(
+                "host graph does not match the sharded graph "
+                "(edge checksum mismatch) — patching would diverge")
+        self.local_id = self._derive_local_ids()
+        self._verify_layout()
+        self.pair_count = self._build_pair_counts()
+        self.last_seq = -1
+
+    # ---------------- init-time derivations ---------------------------
+
+    def _derive_local_ids(self) -> np.ndarray:
+        sg = self.sg
+        local = np.full(self.g.num_nodes, -1, np.int64)
+        for p in range(self.P):
+            n = int(sg.inner_count[p])
+            gn = sg.global_nid[p, :n]
+            if np.any(self.parts[gn] != p):
+                raise ValueError(
+                    f"partition assignment disagrees with shard {p}'s "
+                    "global_nid rows")
+            local[gn] = np.arange(n)
+        if np.any(local < 0):
+            raise ValueError("sharded graph does not cover every node")
+        return local
+
+    def _verify_layout(self) -> None:
+        # the append-at-end invariant needs (part, ~train, global id)
+        # ordering exactly: ascending global ids within each part's
+        # train and non-train blocks (a cluster-keyed layout fails here)
+        sg = self.sg
+        for p in range(self.P):
+            n, t = int(sg.inner_count[p]), int(sg.train_count[p])
+            gn = sg.global_nid[p, :n]
+            for blk, name in ((gn[:t], "train"), (gn[t:], "non-train")):
+                if blk.size > 1 and np.any(np.diff(blk) <= 0):
+                    raise ValueError(
+                        f"shard {p}'s {name} block is not in global-id "
+                        "order — streaming requires the base (no "
+                        "cluster/reorder key) layout")
+
+    def _build_pair_counts(self) -> Dict[int, int]:
+        g, P = self.g, self.P
+        cross = self.parts[g.src] != self.parts[g.dst]
+        fused = (g.src[cross].astype(np.int64) * P
+                 + self.parts[g.dst[cross]])
+        keys, counts = np.unique(fused, return_counts=True)
+        return dict(zip(keys.tolist(), counts.tolist()))
+
+    # ---------------- public queries ----------------------------------
+
+    def slack_remaining(self) -> Dict[str, int]:
+        sg = self.sg
+        b_used = int(sg.send_counts.max()) if sg.send_counts.size else 0
+        return {
+            "n": int(sg.n_max - sg.inner_count.max()),
+            "b": int(sg.b_max - b_used),
+            "e": int(sg.e_max - sg.edge_count.max()),
+        }
+
+    # ---------------- batch application -------------------------------
+
+    def apply(self, batch: DeltaBatch,
+              allow_repad: bool = False) -> PatchReport:
+        t0 = time.perf_counter()
+        self._validate_batch(batch)
+
+        plan = self._plan(batch)
+        try:
+            self._capacity_check(plan)
+        except SlackExhausted as exc:
+            if not allow_repad:
+                raise
+            self._repad(exc.required)
+            rep = self.apply(batch, allow_repad=False)
+            rep.repadded = True
+            rep.patch_ms = (time.perf_counter() - t0) * 1e3
+            return rep
+
+        report = self._commit(batch, plan)
+        self.last_seq = batch.seq
+        report.patch_ms = (time.perf_counter() - t0) * 1e3
+        return report
+
+    # ---------------- validation --------------------------------------
+
+    def _validate_batch(self, batch: DeltaBatch) -> None:
+        g, sg = self.g, self.sg
+        if batch.seq <= self.last_seq:
+            raise ValueError(
+                f"batch seq {batch.seq} <= last applied {self.last_seq}"
+                " — delta sequence ids must be strictly increasing")
+        N, M = g.num_nodes, batch.n_new
+        for name, arr in (("del", batch.del_edges),
+                          ("add", batch.add_edges)):
+            if arr.size and np.any(arr[:, 0] == arr[:, 1]):
+                raise ValueError(
+                    f"{name}-edge list contains self-loops; self-loops "
+                    "are managed by the patcher (one per node, always)")
+        if batch.del_edges.size and (
+                batch.del_edges.min() < 0 or batch.del_edges.max() >= N):
+            raise ValueError("del-edge endpoint out of range")
+        if batch.add_edges.size and (
+                batch.add_edges.min() < 0
+                or batch.add_edges.max() >= N + M):
+            raise ValueError("add-edge endpoint out of range")
+        if M:
+            if batch.node_feat.shape[1] != sg.n_feat:
+                raise ValueError(
+                    f"new-node feature width {batch.node_feat.shape[1]}"
+                    f" != graph n_feat {sg.n_feat}")
+            if sg.multilabel != (batch.node_label.ndim == 2):
+                raise ValueError(
+                    "new-node label arity does not match the graph "
+                    f"(multilabel={sg.multilabel})")
+            if not sg.multilabel and batch.node_label.size and (
+                    batch.node_label.min() < 0
+                    or batch.node_label.max() >= sg.n_class):
+                raise ValueError(
+                    f"new-node label outside [0, {sg.n_class}) would "
+                    "change the rebuilt class count")
+            for i, nb in enumerate(batch.node_nbrs):
+                if nb.size == 0:
+                    raise ValueError(
+                        f"new node {i} has no neighbors — owner "
+                        "assignment needs at least one")
+                if nb.min() < 0 or nb.max() >= N:
+                    raise ValueError(
+                        f"new node {i} references a neighbor outside "
+                        "the pre-batch graph")
+
+    # ---------------- planning (no mutation) --------------------------
+
+    def _plan(self, batch: DeltaBatch) -> Dict[str, np.ndarray]:
+        """Resolve everything the batch will do — new-node owners, full
+        directed add/del lists, pair-count transitions — against the
+        CURRENT graph, without mutating it."""
+        g, P = self.g, self.P
+        N, M = g.num_nodes, batch.n_new
+        in_deg = np.asarray(g.ndata["in_deg"])
+
+        # owner of each new node: partition of its highest-in-degree
+        # neighbor (first on ties), measured on the pre-batch graph
+        new_parts = np.empty(M, np.int32)
+        for i, nb in enumerate(batch.node_nbrs):
+            new_parts[i] = self.parts[nb[int(np.argmax(in_deg[nb]))]]
+
+        # canonical host-COO append order: per new node its self-loop
+        # then (u, v), (v, u) per neighbor; then the batch's add_edges
+        adds = []
+        for i, nb in enumerate(batch.node_nbrs):
+            u = N + i
+            adds.append([[u, u]])
+            pair = np.empty((nb.size * 2, 2), np.int64)
+            pair[0::2, 0], pair[0::2, 1] = u, nb
+            pair[1::2, 0], pair[1::2, 1] = nb, u
+            adds.append(pair)
+        if batch.add_edges.size:
+            adds.append(batch.add_edges)
+        add = (np.concatenate([np.asarray(a, np.int64) for a in adds])
+               if adds else np.zeros((0, 2), np.int64))
+        dele = batch.del_edges
+
+        # simple-graph discipline: dels must exist (exactly once, by
+        # construction), adds must not duplicate a surviving edge or
+        # each other
+        NN = N + M
+        cur = g.src.astype(np.int64) * NN + g.dst
+        cur_sorted = np.sort(cur)
+        if dele.size:
+            dk = dele[:, 0] * NN + dele[:, 1]
+            if np.unique(dk).size != dk.size:
+                raise ValueError("duplicate del-edge entries in batch")
+            pos = np.searchsorted(cur_sorted, dk)
+            pos = np.clip(pos, 0, max(cur_sorted.size - 1, 0))
+            if cur_sorted.size == 0 or np.any(cur_sorted[pos] != dk):
+                raise ValueError(
+                    "del-edge not present in the current graph")
+        else:
+            dk = np.zeros(0, np.int64)
+        if add.size:
+            ak = add[:, 0] * NN + add[:, 1]
+            if np.unique(ak).size != ak.size:
+                raise ValueError("duplicate add-edge entries in batch")
+            pos = np.searchsorted(cur_sorted, ak)
+            pos = np.clip(pos, 0, max(cur_sorted.size - 1, 0))
+            present = (cur_sorted.size > 0) & (cur_sorted[
+                np.minimum(pos, cur_sorted.size - 1)] == ak)
+            # present is fine only if the same key is also deleted
+            clash = present & ~np.isin(ak, dk)
+            if np.any(clash):
+                raise ValueError(
+                    "add-edge duplicates an existing edge (graph must "
+                    "stay simple)")
+
+        # pair-count transitions for cross edges
+        parts_ext = np.concatenate([self.parts, new_parts])
+        delta: Dict[int, int] = {}
+        for arr, sign in ((dele, -1), (add, +1)):
+            if not arr.size:
+                continue
+            pu, pv = parts_ext[arr[:, 0]], parts_ext[arr[:, 1]]
+            cross = pu != pv
+            fused = arr[cross, 0] * P + pv[cross]
+            for k, c in zip(*np.unique(fused, return_counts=True)):
+                delta[int(k)] = delta.get(int(k), 0) + sign * int(c)
+        return {"add": add, "del": dele, "new_parts": new_parts,
+                "pair_delta": delta, "parts_ext": parts_ext}
+
+    def _capacity_check(self, plan: Dict[str, np.ndarray]) -> None:
+        sg, P = self.sg, self.P
+        new_sizes = sg.inner_count + np.bincount(
+            plan["new_parts"], minlength=P).astype(np.int32)
+        ecnt = sg.edge_count.astype(np.int64)
+        parts_ext = plan["parts_ext"]
+        if plan["del"].size:
+            ecnt -= np.bincount(parts_ext[plan["del"][:, 1]],
+                                minlength=P)
+        if plan["add"].size:
+            ecnt += np.bincount(parts_ext[plan["add"][:, 1]],
+                                minlength=P)
+        # per-(owner, dist) send-count deltas from pair transitions
+        sc = sg.send_counts.copy() if sg.send_counts.size else \
+            np.zeros((P, max(P - 1, 1)), np.int32)
+        for k, dv in plan["pair_delta"].items():
+            u, q = k // P, k % P
+            cur = self.pair_count.get(k, 0)
+            new = cur + dv
+            if new < 0:
+                raise ValueError(
+                    "pair-count underflow: delta deletes more "
+                    f"(u={u} -> part {q}) edges than exist")
+            p = int(self.parts[u]) if u < self.parts.shape[0] else \
+                int(plan["new_parts"][u - self.parts.shape[0]])
+            d = (q - p) % P
+            if cur == 0 and new > 0:
+                sc[p, d - 1] += 1
+            elif cur > 0 and new == 0:
+                sc[p, d - 1] -= 1
+        req = {
+            "min_n_max": int(new_sizes.max()),
+            "min_b_max": int(sc.max()) if P > 1 else 0,
+            "min_e_max": int(ecnt.max()),
+        }
+        over = []
+        if req["min_n_max"] > sg.n_max:
+            over.append(f"nodes {req['min_n_max']} > n_max {sg.n_max}")
+        if req["min_b_max"] > sg.b_max and P > 1:
+            over.append(f"send {req['min_b_max']} > b_max {sg.b_max}")
+        if req["min_e_max"] > sg.e_max:
+            over.append(f"edges {req['min_e_max']} > e_max {sg.e_max}")
+        if over:
+            raise SlackExhausted(
+                "stream slack exhausted (" + "; ".join(over) + ") — "
+                "re-pad required (--stream-slack reserves headroom; "
+                "apply(allow_repad=True) rebuilds loudly)", req)
+
+    # ---------------- loud re-pad -------------------------------------
+
+    def _repad(self, required: Dict[str, int]) -> None:
+        """From-scratch rebuild of the sharded arrays at grown padding
+        (same graph, same partition assignment, same local ids — only
+        the padded dims change). Compiled shapes change: every consumer
+        must rebuild device state."""
+        sg = self.sg
+        grow = 1.0 + max(self.slack, 0.0)
+        mins = {k: int(np.ceil(v * grow)) for k, v in required.items()}
+        print(
+            f"[stream] slack exhausted: re-padding sharded graph "
+            f"(n_max {sg.n_max}, b_max {sg.b_max}, e_max {sg.e_max}) "
+            f"-> floors {mins} — compiled shapes change, device state "
+            f"must be rebuilt", file=sys.stderr, flush=True)
+        new_sg = ShardedGraph.build(
+            self.g, self.parts, n_parts=self.P, pad_to=self.pad_to,
+            slack=self.slack, min_n_max=mins["min_n_max"],
+            min_b_max=mins["min_b_max"], min_e_max=mins["min_e_max"])
+        new_sg.cache_dir = sg.cache_dir
+        self._replace_sg(new_sg)
+
+    def _replace_sg(self, new_sg: ShardedGraph) -> None:
+        # rebind in place so holders of the patcher see the new arrays;
+        # holders of the OLD sg object must re-read it via the patcher
+        self.sg = new_sg
+        self.local_id = self._derive_local_ids()
+        self.pair_count = self._build_pair_counts()
+
+    # ---------------- commit ------------------------------------------
+
+    def _commit(self, batch: DeltaBatch,
+                plan: Dict[str, np.ndarray]) -> PatchReport:
+        g, sg, P = self.g, self.sg, self.P
+        N, M = g.num_nodes, batch.n_new
+        n_max, b_max = sg.n_max, sg.b_max
+        add, dele = plan["add"], plan["del"]
+        new_parts = plan["new_parts"]
+        NN = N + M
+
+        # ---- host graph: nodes ---------------------------------------
+        unknown = [k for k in g.ndata if k not in _NDATA_KEYS]
+        if unknown:
+            raise ValueError(
+                f"host graph carries ndata keys {unknown} the patcher "
+                "cannot extend for new nodes")
+        if M:
+            g.ndata["feat"] = np.concatenate(
+                [g.ndata["feat"], batch.node_feat.astype(np.float32)])
+            lab = g.ndata["label"]
+            if sg.multilabel:
+                g.ndata["label"] = np.concatenate(
+                    [lab, batch.node_label.astype(lab.dtype)])
+            else:
+                g.ndata["label"] = np.concatenate(
+                    [lab, batch.node_label.astype(lab.dtype)])
+            for k in ("train_mask", "val_mask", "test_mask"):
+                g.ndata[k] = np.concatenate(
+                    [g.ndata[k], np.zeros(M, bool)])
+            g.ndata["in_deg"] = np.concatenate(
+                [g.ndata["in_deg"], np.zeros(M, np.float32)])
+            g.num_nodes = NN
+
+        # ---- host graph: edges (canonical order) ---------------------
+        if dele.size:
+            cur = g.src.astype(np.int64) * NN + g.dst
+            keep = ~np.isin(cur, dele[:, 0] * NN + dele[:, 1])
+            g.src, g.dst = g.src[keep], g.dst[keep]
+        if add.size:
+            g.src = np.concatenate(
+                [g.src, add[:, 0].astype(g.src.dtype)])
+            g.dst = np.concatenate(
+                [g.dst, add[:, 1].astype(g.dst.dtype)])
+        ind = g.ndata["in_deg"]
+        if dele.size:
+            np.subtract.at(ind, dele[:, 1], 1.0)
+        if add.size:
+            np.add.at(ind, add[:, 1], 1.0)
+
+        # ---- local ids / parts for new nodes -------------------------
+        deg_changed = np.zeros((P, n_max), bool)
+        new_rows = np.zeros((P, n_max), bool)
+        if M:
+            self.parts = np.concatenate([self.parts, new_parts])
+            new_local = np.empty(M, np.int64)
+            cnt = sg.inner_count.astype(np.int64).copy()
+            for i in range(M):
+                p = int(new_parts[i])
+                new_local[i] = cnt[p]
+                cnt[p] += 1
+            self.local_id = np.concatenate([self.local_id, new_local])
+            gids = np.arange(N, NN, dtype=np.int64)
+            sg.feat[new_parts, new_local] = batch.node_feat
+            sg.label[new_parts, new_local] = (
+                batch.node_label.astype(sg.label.dtype))
+            sg.global_nid[new_parts, new_local] = gids
+            sg.inner_count = cnt.astype(np.int32)
+            new_rows[new_parts, new_local] = True
+
+        # in_deg rows that changed (destinations of any add/del + new)
+        touched_dst = np.concatenate([
+            a for a in (dele[:, 1] if dele.size else None,
+                        add[:, 1] if add.size else None)
+            if a is not None]) if (dele.size or add.size) else \
+            np.zeros(0, np.int64)
+        if touched_dst.size:
+            tv = np.unique(touched_dst)
+            sg.in_deg[self.parts[tv], self.local_id[tv]] = ind[tv]
+            deg_changed[self.parts[tv], self.local_id[tv]] = True
+        deg_changed |= new_rows
+
+        # ---- send lists: pair transitions ----------------------------
+        changed = np.zeros((P, max(P - 1, 1), max(b_max, 1)), bool)
+        touched_pd = set()
+        for k in sorted(plan["pair_delta"]):
+            dv = plan["pair_delta"][k]
+            u, q = k // P, k % P
+            cur = self.pair_count.get(k, 0)
+            new = cur + dv
+            p = int(self.parts[u])
+            d = (q - p) % P
+            if cur == 0 and new > 0:
+                self._send_insert(p, d, int(self.local_id[u]), changed)
+                touched_pd.add((p, d))
+            elif cur > 0 and new == 0:
+                self._send_remove(p, d, int(self.local_id[u]), changed)
+                touched_pd.add((p, d))
+            if new:
+                self.pair_count[k] = new
+            else:
+                self.pair_count.pop(k, None)
+
+        # ---- per-device edge arrays ----------------------------------
+        affected = set(new_parts.tolist())
+        if dele.size:
+            affected |= set(self.parts[dele[:, 1]].tolist())
+        if add.size:
+            affected |= set(self.parts[add[:, 1]].tolist())
+        affected |= {(p + d) % P for p, d in touched_pd}
+        for q in sorted(affected):
+            self._rebuild_device_edges(int(q))
+
+        # the checksum keys the derived-table disk cache; num_nodes
+        # enters the hash, so recompute from the host graph
+        sg.source_edge_checksum = ShardedGraph.edge_checksum(g)
+
+        changed_view = changed[:, :P - 1, :b_max] if P > 1 else \
+            np.zeros((P, 0, 0), bool)
+        return PatchReport(
+            seq=batch.seq,
+            edges_added=int(add.shape[0]),
+            edges_deleted=int(dele.shape[0]),
+            nodes_added=M,
+            patch_ms=0.0,
+            slack_remaining=self.slack_remaining(),
+            changed_send=changed_view,
+            deg_changed=deg_changed,
+            new_rows=new_rows,
+            touched_parts=tuple(sorted(affected)),
+        )
+
+    # ---------------- send-list surgery -------------------------------
+
+    def _send_insert(self, p: int, d: int, lid: int,
+                     changed: np.ndarray) -> None:
+        sg = self.sg
+        c = int(sg.send_counts[p, d - 1])
+        row_i = sg.send_idx[p, d - 1]
+        row_m = sg.send_mask[p, d - 1]
+        k = int(np.searchsorted(row_i[:c], lid))
+        row_i[k + 1:c + 1] = row_i[k:c]
+        row_m[k + 1:c + 1] = row_m[k:c]
+        row_i[k] = lid
+        row_m[k] = True
+        sg.send_counts[p, d - 1] = c + 1
+        changed[p, d - 1, k:c + 1] = True
+
+    def _send_remove(self, p: int, d: int, lid: int,
+                     changed: np.ndarray) -> None:
+        sg = self.sg
+        c = int(sg.send_counts[p, d - 1])
+        row_i = sg.send_idx[p, d - 1]
+        row_m = sg.send_mask[p, d - 1]
+        k = int(np.searchsorted(row_i[:c], lid))
+        if k >= c or row_i[k] != lid:
+            raise AssertionError(
+                f"send-list entry for local {lid} missing on "
+                f"(part {p}, dist {d})")
+        row_i[k:c - 1] = row_i[k + 1:c]
+        row_m[k:c - 1] = row_m[k + 1:c]
+        # zeroed tail matches _send_structures' np.zeros initialization
+        row_i[c - 1] = 0
+        row_m[c - 1] = False
+        sg.send_counts[p, d - 1] = c - 1
+        changed[p, d - 1, k:c] = True
+
+    # ---------------- device edge recompute ---------------------------
+
+    def _rebuild_device_edges(self, q: int) -> None:
+        """Recompute shard q's padded edge arrays from the host COO —
+        build()'s exact localization and stable CSR-by-dst sort
+        restricted to one owner, so the result is bit-identical to a
+        full rebuild's shard q."""
+        g, sg, P = self.g, self.sg, self.P
+        n_max, b_max, e_max = sg.n_max, sg.b_max, sg.e_max
+        own = np.flatnonzero(self.parts[g.dst] == q)
+        if own.size > e_max:
+            raise AssertionError(
+                f"shard {q} edge count {own.size} > e_max {e_max} "
+                "after capacity check")
+        srcg = g.src[own]
+        dstl = self.local_id[g.dst[own]]
+        p_src = self.parts[srcg]
+        lid = self.local_id[srcg]
+        src_local = np.where(p_src == q, lid, -1)
+        for p in range(P):
+            if p == q:
+                continue
+            m = p_src == p
+            if not m.any():
+                continue
+            d = (q - p) % P
+            cnt = int(sg.send_counts[p, d - 1])
+            rank = np.searchsorted(sg.send_idx[p, d - 1, :cnt], lid[m])
+            if np.any(rank >= cnt) or np.any(
+                    sg.send_idx[p, d - 1, rank] != lid[m]):
+                raise AssertionError(
+                    f"cross edge source missing from ({p}, d={d}) "
+                    "send list")
+            src_local[m] = n_max + (d - 1) * b_max + rank
+        order = np.argsort(dstl, kind="stable")
+        cnt_e = own.size
+        sg.edge_src[q, :cnt_e] = src_local[order].astype(np.int32)
+        sg.edge_dst[q, :cnt_e] = dstl[order].astype(np.int32)
+        sg.edge_src[q, cnt_e:] = 0
+        sg.edge_dst[q, cnt_e:] = n_max
+        sg.edge_count[q] = cnt_e

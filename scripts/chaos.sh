@@ -51,6 +51,18 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serving \
     -p no:cacheprovider "$@"
 
+# Stream lane (docs/STREAMING.md): the patched-vs-from-scratch-rebuild
+# bit-identity oracle (CSR slabs, send-lists, halo slots, eval logits,
+# on the xla AND incremental-bucket table paths), slack exhaustion ->
+# loud re-pad, the zero-recompile pin, pipelined carry-row flush, the
+# serving topology-delta freshness oracle (incremental == full
+# boundary exchange bitwise), and CRC tamper rejection — tier-1-safe
+# but run standalone so a streaming regression fails the chaos lane
+# even when someone trims the tier-1 selection.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m stream \
+    -p no:cacheprovider "$@"
+
 # Fleet lane (docs/SERVING.md "Fleet"): the replica-kill drill — a
 # two-replica `python -m pipegcn_tpu.cli.fleet` run SIGKILLs one
 # replica mid-load (fault plan replica-kill@W:mK); the router must

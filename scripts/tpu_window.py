@@ -119,6 +119,18 @@ QUEUE = [
       "--serve-secs", "30", "--serve-qps", "200",
       "--metrics-out", "results/serve_bench_metrics.jsonl"],
      1800, [_BENCH_PART]),
+    # round-13: streaming-graph delta ingestion measured on chip —
+    # per-delta patch cost + forced-probe drift through the live fit()
+    # loop, incremental-vs-full table rebuild, and the serving topology
+    # refresh (docs/STREAMING.md). No artifact in `requires`: --stream
+    # builds its graph in memory BY DESIGN (the patcher mutates the
+    # live host graph the cached artifact discards), so its ~minutes of
+    # host-side build are part of the scenario, bounded by the timeout.
+    ("stream_bench",
+     [sys.executable, "bench.py", "--stream", "--no-compare",
+      "--stream-deltas", "6",
+      "--metrics-out", "results/stream_bench_metrics.jsonl"],
+     3600, []),
     # VERDICT r5 item 8: second shape point for the auto-kernel policy
     ("offshape_products",
      [sys.executable, "scripts/offshape_bench.py", "--shape",

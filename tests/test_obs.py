@@ -131,6 +131,29 @@ def test_schema_v7_drift_guard():
         assert obs_schema.SCHEMA_VERSION > 7
 
 
+# FROZEN copy of the v8 additions (v7 + the `stream` kind the
+# streaming-graphs PR added, bumping the version to 8). Same contract
+# as the v7 guard: removing/retyping a field without bumping
+# SCHEMA_VERSION fires the assert.
+_V8_STREAM_FIELDS = {
+    "event": "string", "epoch": "integer", "seq": "integer",
+    "edges_added": "integer", "edges_deleted": "integer",
+    "nodes_added": "integer", "patch_ms": "number",
+    "tables_rebuilt": "integer", "repadded": "boolean",
+    "slack_remaining": "object", "drift": "number?",
+}
+
+
+def test_schema_v8_drift_guard():
+    if obs_schema.SCHEMA_VERSION == 8:
+        for name, tag in _V8_STREAM_FIELDS.items():
+            assert obs_schema.STREAM_FIELDS.get(name) == tag, (
+                f"schema field stream.{name} removed or retyped "
+                f"without bumping SCHEMA_VERSION")
+    else:
+        assert obs_schema.SCHEMA_VERSION > 8
+
+
 def test_validate_record():
     validate_record({"event": "epoch", "epoch": 0, "step_time_s": 0.1,
                      "loss": 1.0, "grad_norm": 0.5, "halo_bytes": 128,
@@ -502,6 +525,48 @@ def test_report_json_pins_serving_summary(tmp_path, capsys):
     assert summ["serving_drained"] is False
     assert report_main([str(q)]) == 0
     assert "!! serving shutdown" in capsys.readouterr().out
+
+
+def test_report_json_pins_stream_summary(tmp_path, capsys):
+    """--json shape pin for the v8 stream fields: `stream` records roll
+    up to delta totals, median/max patch cost, max/last probe drift, a
+    re-pad count, and the last slack headroom snapshot."""
+    p = tmp_path / "stream.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        ml.stream(epoch=4, seq=0, edges_added=10, edges_deleted=2,
+                  nodes_added=1, patch_ms=1.5, tables_rebuilt=4,
+                  repadded=False,
+                  slack_remaining={"n": 9, "b": 5, "e": 80},
+                  drift=0.31)
+        ml.stream(epoch=8, seq=1, edges_added=6, edges_deleted=4,
+                  nodes_added=0, patch_ms=2.5, tables_rebuilt=12,
+                  repadded=True,
+                  slack_remaining={"n": 20, "b": 11, "e": 150},
+                  drift=0.12)
+    rc = report_main([str(p), "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["n_stream_records"] == 2
+    assert s["stream_edges_added"] == 16
+    assert s["stream_edges_deleted"] == 6
+    assert s["stream_nodes_added"] == 1
+    assert s["stream_patch_ms_median"] == pytest.approx(2.0)
+    assert s["stream_patch_ms_max"] == pytest.approx(2.5)
+    assert s["stream_drift_max"] == pytest.approx(0.31)
+    assert s["stream_drift_last"] == pytest.approx(0.12)
+    assert s["stream_tables_rebuilt"] == 16
+    assert s["stream_repads"] == 1
+    assert s["stream_slack_remaining_last"] == {"n": 20, "b": 11,
+                                                "e": 150}
+    # human-readable lines render the same facts, incl. the loud
+    # slack-exhaustion flag
+    rc = report_main([str(p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "stream deltas" in out
+    assert "stream patch cost" in out
+    assert "!! stream re-pads" in out
 
 
 def test_report_json_pins_fleet_summary(tmp_path, capsys):
