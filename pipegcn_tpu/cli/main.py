@@ -530,6 +530,8 @@ def run(args) -> dict:
                 checkpoint_dir=args.checkpoint_dir or None,
                 checkpoint_every=args.checkpoint_every,
                 checkpoint_keep=args.checkpoint_keep,
+                checkpoint_fallback_dir=getattr(
+                    args, "checkpoint_fallback_dir", "") or None,
                 profile_dir=args.profile_dir or None,
                 profile_epochs=profile_epochs,
                 staleness_probe_every=args.staleness_probe_every,

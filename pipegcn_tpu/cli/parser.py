@@ -248,6 +248,14 @@ def create_parser() -> argparse.ArgumentParser:
                              "(keep-last-N rotation with a 'latest' "
                              "pointer and digest-verified fallback, "
                              "docs/RESILIENCE.md; 0 keeps all)")
+    parser.add_argument("--checkpoint-fallback-dir",
+                        "--checkpoint_fallback_dir", type=str, default="",
+                        help="second directory (ideally another volume) "
+                             "to save into when a periodic checkpoint "
+                             "write fails with OSError; with or without "
+                             "it the failed save degrades loudly and "
+                             "retries at later boundaries "
+                             "(docs/RESILIENCE.md 'Storage faults')")
     parser.add_argument("--resume", action="store_true",
                         help="resume from --checkpoint-dir (errors "
                              "without one; warns loudly when the dir "
@@ -291,10 +299,15 @@ def create_parser() -> argparse.ArgumentParser:
                              "separated kind@epoch[:rN] entries "
                              "(nan-loss, nan-grad, sigterm, crash, "
                              "corrupt-ckpt, desync, hang, overflow, "
-                             "kernel-crash, graph-delta), e.g. "
-                             "'nan-loss@5:r1,sigterm@8'; each fires "
-                             "once, host-side only; :rN targets one "
-                             "rank (process index) in multi-host runs")
+                             "kernel-crash, graph-delta, plus the "
+                             "storage kinds enospc, torn-write, ro-dir, "
+                             "slow-fs@E:<ms> — armed at the boundary of "
+                             "E, disarmed at the next checkpoint "
+                             "boundary), e.g. "
+                             "'nan-loss@5:r1,sigterm@8,enospc@4'; each "
+                             "fires once, host-side only; :rN targets "
+                             "one rank (process index) in multi-host "
+                             "runs")
     # ---- streaming graphs (docs/STREAMING.md) ----
     parser.add_argument("--stream-plan", "--stream_plan", type=str,
                         default="",

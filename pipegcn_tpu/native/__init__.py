@@ -58,6 +58,9 @@ def _build(lib_path: str) -> bool:
             try:
                 os.remove(tmp_path)
             except OSError:
+                # genuinely-optional (storage-fault audit): orphaned
+                # build temp; the caller already returned the build
+                # verdict
                 pass
     return True
 

@@ -13,12 +13,27 @@ from jax-free host processes (partition builders, report tooling).
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional, Union
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 from .schema import SCHEMA_VERSION, validate_record
+
+# ring-buffer capacity while the sink is io-degraded; beyond this the
+# OLDEST buffered records are dropped (and counted in the recovery
+# record) — fault/recovery records are small, so 4096 lines outlasts
+# any realistic disk-full window
+_RING_CAPACITY = 4096
+
+
+def _storage_io():
+    # lazy: resilience/__init__ -> elastic -> this module would cycle
+    # on a top-level import of the storage shim
+    from ..resilience.storage import FAULTY_IO
+    return FAULTY_IO
 
 
 def _jsonable(v: Any) -> Any:
@@ -48,7 +63,18 @@ class MetricsLogger:
     `path` may be a filesystem path (parent dirs created, file opened
     in append mode) or any object with ``write``. Use as a context
     manager or call :meth:`close`; a logger left open still has every
-    record on disk (each write is flushed)."""
+    record on disk (each write is flushed).
+
+    Storage-fault degradation (docs/RESILIENCE.md "Storage faults"):
+    when the sink's disk fails (ENOSPC, EROFS, a yanked mount — or the
+    injected equivalents, resilience/storage.py) the logger goes
+    *io-degraded* instead of raising or silently dropping: records
+    accumulate in an in-memory ring buffer (one loud warning per
+    episode), every subsequent write retries the disk, and on recovery
+    the ring re-drains in order followed by a ``recovery/io-degraded``
+    record counting what was re-drained and what (if anything) the
+    ring had to drop. Fault/recovery records are therefore never
+    silently lost — the worst case is a bounded, counted gap."""
 
     def __init__(self, path: Union[str, "os.PathLike", Any],
                  validate: bool = True):
@@ -65,6 +91,63 @@ class MetricsLogger:
             self._f = path
             self.path = None
         self.header_written = False
+        self._ring: collections.deque = collections.deque(
+            maxlen=_RING_CAPACITY)
+        self._degraded = False
+        self._dropped = 0
+
+    # ---------------- degradation policy ------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        """True while the sink is io-degraded (records ring-buffered)."""
+        return self._degraded
+
+    def _enter_degraded(self, exc: BaseException,
+                        line: Optional[str]) -> None:
+        if not self._degraded:
+            self._degraded = True
+            warnings.warn(
+                f"metrics sink {self.path or self._f!r} is io-degraded "
+                f"({exc!r}); buffering records in memory (capacity "
+                f"{_RING_CAPACITY}) and retrying on every write")
+        if line is not None:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(line)
+
+    def _try_recover(self) -> bool:
+        """Attempt to re-drain the ring to the sink; True on success.
+        The recovery record is appended DIRECTLY (not via write()) so a
+        failure mid-drain can never recurse back into degradation
+        bookkeeping with half the ring gone — lines leave the ring only
+        after they hit the file."""
+        if not self._degraded:
+            return True
+        try:
+            if self._owns_file and getattr(self._f, "closed", False):
+                if self.path is not None:
+                    _storage_io().gate(self.path, "open")
+                self._f = open(self.path, "a", encoding="utf-8")
+            if self.path is not None:
+                _storage_io().gate(self.path, "write")
+            redrained = 0
+            while self._ring:
+                self._f.write(self._ring[0])
+                self._ring.popleft()
+                redrained += 1
+            from ..resilience.storage import IO_DEGRADED
+            self._f.write(json.dumps({
+                "event": "recovery", "kind": IO_DEGRADED, "epoch": -1,
+                "rank": _local_rank(), "redrained": redrained,
+                "dropped": self._dropped,
+                "time_unix": time.time()}) + "\n")
+            self._f.flush()
+        except (OSError, ValueError):
+            return False
+        self._degraded = False
+        self._dropped = 0
+        return True
 
     # ---------------- record writers ----------------------------------
 
@@ -72,8 +155,17 @@ class MetricsLogger:
         rec = {k: _jsonable(v) for k, v in rec.items()}
         if self._validate:
             validate_record(rec)
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        line = json.dumps(rec) + "\n"
+        if self._degraded and not self._try_recover():
+            self._enter_degraded(OSError("sink still degraded"), line)
+            return rec
+        try:
+            if self.path is not None:
+                _storage_io().gate(self.path, "write")
+            self._f.write(line)
+            self._f.flush()
+        except OSError as exc:
+            self._enter_degraded(exc, line)
         return rec
 
     def run_header(self, config: Optional[dict] = None,
@@ -380,6 +472,26 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def soak(self, episode: int, seed: int, schedule: Sequence[str],
+             invariants: Dict[str, Any], verdict: str,
+             **extra) -> Dict[str, Any]:
+        """One chaos-soak episode verdict (resilience/soak.py): the
+        composed fault schedule and the per-invariant results. Hard-
+        flushed — a red verdict must survive even if the soak driver
+        itself dies right after."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "soak",
+            "episode": int(episode),
+            "seed": int(seed),
+            "schedule": list(schedule),
+            "invariants": dict(invariants),
+            "verdict": str(verdict),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""
@@ -392,19 +504,47 @@ class MetricsLogger:
         skips atexit handlers and io teardown) or a SIGKILL an instant
         later. Call before every hard-exit / crash-checkpoint path;
         fault/recovery writers call it automatically. Best-effort on
-        sinks without a file descriptor (StringIO tests)."""
-        try:
-            self._f.flush()
-        except (OSError, ValueError):
+        sinks without a file descriptor (StringIO tests); a DISK
+        failure here enters io-degraded instead of being swallowed —
+        the records this method exists to make durable are exactly the
+        ones that must not vanish without a trace."""
+        if self._degraded and not self._try_recover():
             return
         try:
-            os.fsync(self._f.fileno())
-        except (OSError, ValueError, AttributeError):
-            pass
+            self._f.flush()
+        except ValueError:
+            return  # closed/detached sink: nothing to make durable
+        except OSError as exc:
+            self._enter_degraded(exc, None)
+            return
+        try:
+            # isolated: io.UnsupportedOperation (StringIO sinks) is BOTH
+            # an OSError and a ValueError — a missing fd means "nothing
+            # to fsync", never "the disk failed"
+            fd = self._f.fileno()
+        except (AttributeError, OSError, ValueError):
+            return
+        try:
+            if self.path is not None:
+                _storage_io().gate(self.path, "fsync")
+            os.fsync(fd)
+        except OSError as exc:
+            self._enter_degraded(exc, None)
 
     def close(self) -> None:
+        if self._degraded:
+            self._try_recover()
+        if self._degraded and (self._ring or self._dropped):
+            warnings.warn(
+                f"metrics sink {self.path or self._f!r} closed while "
+                f"io-degraded: {len(self._ring)} buffered and "
+                f"{self._dropped} dropped records were lost")
         if self._owns_file and not self._f.closed:
-            self._f.close()
+            try:
+                self._f.close()
+            except OSError:
+                pass  # close-flush of a dead disk; the ring warning
+                # above already reported the loss
 
     def __enter__(self) -> "MetricsLogger":
         return self

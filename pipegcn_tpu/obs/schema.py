@@ -17,8 +17,10 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 8  # v8: stream record kind (graph-delta ingestion,
-#                         docs/STREAMING.md)
+SCHEMA_VERSION = 9  # v9: soak record kind (chaos-soak episode
+#                         verdicts, resilience/soak.py) + the
+#                         io-degraded fault/recovery kind
+#                         (docs/RESILIENCE.md "Storage faults")
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -60,7 +62,10 @@ SUMMARY_FIELDS: Dict[str, str] = {
 
 # one record per detected fault (divergence trip, preemption request,
 # injected fault, corrupt checkpoint generation, cross-rank desync,
-# lost peer); extras carry the kind-specific detail (reason, retry
+# lost peer, or — v9 — an ``io-degraded`` durable-write failure: the
+# disk rejected a checkpoint / ledger / metrics write and the writer
+# fell back to its degradation policy, resilience/storage.py);
+# extras carry the kind-specific detail (reason, retry
 # count, trip values). Multi-host extras the MetricsLogger always adds
 # (optional in the contract so v1 files stay valid):
 #   rank         integer — process that wrote the record
@@ -271,6 +276,22 @@ STREAM_FIELDS: Dict[str, str] = {
     "drift": "number?",            # forced probe max_rel_drift
 }
 
+# one record per chaos-soak episode (resilience/soak.py +
+# scripts/soak.py): the seeded fault schedule the episode composed and
+# the per-invariant verdict. schedule is the fault-plan entry list
+# (strings, kind@epoch[...] grammar); invariants maps each invariant
+# name (checkpoint | ledger | metrics | tickets | resume) to
+# {ok: bool, detail: str}; verdict is "green" | "red". Extras:
+# episode wall time, restart counts.
+SOAK_FIELDS: Dict[str, str] = {
+    "event": "string",             # "soak"
+    "episode": "integer",          # 0-based episode index
+    "seed": "integer",             # the driving soak seed
+    "schedule": "array",           # composed fault-plan entries
+    "invariants": "object",        # {name: {ok, detail}}
+    "verdict": "string",           # green | red
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -288,6 +309,7 @@ _BY_EVENT = {
     "membership": MEMBERSHIP_FIELDS,
     "fleet": FLEET_FIELDS,
     "stream": STREAM_FIELDS,
+    "soak": SOAK_FIELDS,
 }
 
 _JSON_TYPES = {

@@ -432,12 +432,14 @@ def tuning_path(cache_dir: str) -> str:
 def save_tuning(cache_dir: str, record: Dict[str, Any]) -> None:
     """Atomically persist the tuning record next to the artifact's
     npz/mmap payload (both formats are directories, so the sidecar
-    rides along for free and versions with the artifact)."""
-    path = tuning_path(cache_dir)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(record, f, indent=1)
-    os.replace(tmp, path)
+    rides along for free and versions with the artifact). Routed
+    through the storage-fault seams (resilience/storage.py): a torn or
+    failed write leaves the previous sidecar — or nothing — and
+    load_tuning's never-raise contract degrades to a live re-tune."""
+    from ..resilience.storage import write_text_atomic
+
+    write_text_atomic(tuning_path(cache_dir),
+                      json.dumps(record, indent=1), fsync=False)
 
 
 def load_tuning(cache_dir: str, *,

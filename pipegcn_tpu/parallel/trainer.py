@@ -45,6 +45,7 @@ from ..obs.trace import PhaseTimer, named_phase
 from ..ops.spmm import spmm_mean
 from ..partition.halo import ShardedGraph
 from ..resilience import DivergenceError, PeerLost, Preempted, SentinelConfig
+from ..resilience.storage import FAULTY_IO, IO_DEGRADED, IO_KINDS
 from ..train.losses import bce_logits_sum, cross_entropy_sum
 from ..train.metrics import calc_acc
 from ..train.optim import adam_init, adam_update
@@ -339,6 +340,8 @@ class Trainer:
                     try:
                         os.remove(tmp)
                     except OSError:
+                        # genuinely-optional (storage-fault audit):
+                        # orphaned temp in a cache dir, never read
                         pass
         return tables
 
@@ -506,8 +509,17 @@ class Trainer:
                 if cd:
                     try:
                         tuner.save_tuning(cd, rec)
-                    except OSError:
-                        pass  # read-only artifact: table is session-only
+                    except OSError as exc:
+                        # routed-through-degradation (storage-fault
+                        # audit): the run proceeds on the measured
+                        # in-memory table, but silently losing the
+                        # sidecar means every future run re-pays the
+                        # micro-bench campaign — say so
+                        warnings.warn(
+                            f"tuning sidecar write to {cd} failed "
+                            f"({exc!r}); io-degraded — the measured "
+                            f"table is session-only and the next run "
+                            f"will re-tune")
             else:
                 source = "default"
                 why = ("tuning disabled (--no-tune)"
@@ -1526,6 +1538,7 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 100,
         checkpoint_keep: int = 3,
+        checkpoint_fallback_dir: Optional[str] = None,
         profile_dir: Optional[str] = None,
         profile_epochs: Optional[Tuple[int, int]] = None,
         staleness_probe_every: int = 0,
@@ -1604,6 +1617,15 @@ class Trainer:
 
         `checkpoint_keep` bounds the on-disk checkpoint generations
         (keep-last-N; utils/checkpoint.py rotation).
+
+        `checkpoint_fallback_dir` names a second directory (ideally a
+        different volume) to save into when a checkpoint write into
+        `checkpoint_dir` fails with OSError. With or without it, a
+        failed periodic save degrades loudly instead of aborting the
+        run: an ``io-degraded`` fault record is emitted, the previous
+        on-disk generation stays the authoritative resume point, and
+        the save is retried with FRESH state at subsequent epoch
+        boundaries until one lands (``io-degraded`` recovery record).
 
         Profiling (docs/OBSERVABILITY.md "Profiling"):
 
@@ -1824,6 +1846,11 @@ class Trainer:
             last_good = (start_epoch, self.host_state())
         snap_every = max(int((sentinel.cfg if sentinel is not None
                               else SentinelConfig()).snapshot_every), 1)
+        # ---- storage-fault state (resilience/storage.py) ----
+        io_armed: Dict[str, int] = {}  # armed IO kind -> disarm epoch
+        ckpt_pending = None  # epoch of a failed periodic save awaiting
+        #                      retry; the previous generation stays the
+        #                      authoritative resume point until it lands
         if fault_plan is not None:
             # a resumed run gets the same --fault-plan; entries it
             # already lived through must not re-fire
@@ -1852,6 +1879,53 @@ class Trainer:
                     # a dead peer can never complete a collective:
                     # raise PeerLost BEFORE dispatching anything
                     coord.check_peers()
+                # ---- storage faults: arm/disarm the process-wide IO
+                # shim at the boundary. The window closes at the next
+                # checkpoint boundary (next epoch when checkpointing is
+                # off) so each run exercises BOTH the degradation and
+                # the recovery side of every writer's policy ----
+                for kind, until in list(io_armed.items()):
+                    if epoch >= until:
+                        FAULTY_IO.disarm(kind)
+                        del io_armed[kind]
+                        log_fn(f"storage fault {kind} window closed at "
+                               f"epoch {epoch}")
+                if fault_plan is not None:
+                    for kind in IO_KINDS:
+                        arg = fault_plan.due_arg(kind, epoch)
+                        if arg is None:
+                            continue
+                        FAULTY_IO.arm(kind, ms=arg)
+                        io_armed[kind] = (epoch + checkpoint_every
+                                          if checkpoint_dir else epoch + 1)
+                        log_fn(f"fault-injected {kind} at epoch {epoch} "
+                               f"(window closes at epoch "
+                               f"{io_armed[kind]})")
+                        if metrics is not None:
+                            metrics.fault(kind="injected", epoch=epoch,
+                                          reason=kind)
+                if (ckpt_pending is not None and checkpoint_dir
+                        and jax.process_count() == 1):
+                    # retry the failed periodic save with FRESH state.
+                    # Multi-process runs retry at the next checkpoint
+                    # boundary instead: host_state() is a lockstep
+                    # allgather, and only rank 0 knows a save failed
+                    try:
+                        save_checkpoint(checkpoint_dir,
+                                        self.host_state(), epoch,
+                                        keep=checkpoint_keep)
+                    except OSError as io_exc:
+                        log_fn(f"checkpoint retry at epoch {epoch} "
+                               f"still failing ({io_exc!r})")
+                    else:
+                        log_fn(f"checkpoint save recovered at epoch "
+                               f"{epoch} (pending since epoch "
+                               f"{ckpt_pending})")
+                        if metrics is not None:
+                            metrics.recovery(kind=IO_DEGRADED,
+                                             epoch=epoch,
+                                             pending_since=ckpt_pending)
+                        ckpt_pending = None
                 # ---- streaming deltas: the graph changes HERE, at the
                 # boundary where the donated state is consistent ----
                 stream_reports = []
@@ -2405,20 +2479,66 @@ class Trainer:
                     # shared filesystem)
                     host = self.host_state()
                     if jax.process_index() == 0:
-                        save_checkpoint(checkpoint_dir, host, epoch + 1,
-                                        keep=checkpoint_keep)
-                        if fault_plan is not None and \
-                                fault_plan.due("corrupt-ckpt", epoch + 1):
-                            from ..resilience.faults import \
-                                corrupt_latest_checkpoint
-
-                            p = corrupt_latest_checkpoint(checkpoint_dir)
-                            log_fn(f"fault-injected checkpoint "
-                                   f"corruption: {p}")
-                            if metrics is not None:
-                                metrics.fault(kind="injected",
+                        try:
+                            save_checkpoint(checkpoint_dir, host,
+                                            epoch + 1,
+                                            keep=checkpoint_keep)
+                        except OSError as io_exc:
+                            # storage degradation, never an abort: the
+                            # previous generation stays the
+                            # authoritative resume point; retried with
+                            # fresh state at later boundaries
+                            was_pending = ckpt_pending
+                            ckpt_pending = epoch + 1
+                            log_fn(f"CHECKPOINT SAVE FAILED at epoch "
+                                   f"{epoch + 1} ({io_exc!r}); "
+                                   f"io-degraded — the previous "
+                                   f"generation stays authoritative, "
+                                   f"retrying at the next boundary")
+                            if metrics is not None and was_pending is None:
+                                metrics.fault(kind=IO_DEGRADED,
                                               epoch=epoch + 1,
-                                              reason="corrupt-ckpt")
+                                              reason=repr(io_exc),
+                                              component="checkpoint")
+                            if checkpoint_fallback_dir:
+                                try:
+                                    save_checkpoint(
+                                        checkpoint_fallback_dir, host,
+                                        epoch + 1,
+                                        keep=checkpoint_keep)
+                                    log_fn(
+                                        f"checkpoint epoch {epoch + 1} "
+                                        f"saved to fallback dir "
+                                        f"{checkpoint_fallback_dir}")
+                                except OSError as fb_exc:
+                                    log_fn(f"fallback checkpoint dir "
+                                           f"{checkpoint_fallback_dir} "
+                                           f"also failed ({fb_exc!r})")
+                        else:
+                            if ckpt_pending is not None:
+                                log_fn(f"checkpoint save recovered at "
+                                       f"epoch {epoch + 1} (pending "
+                                       f"since epoch {ckpt_pending})")
+                                if metrics is not None:
+                                    metrics.recovery(
+                                        kind=IO_DEGRADED,
+                                        epoch=epoch + 1,
+                                        pending_since=ckpt_pending)
+                                ckpt_pending = None
+                            if fault_plan is not None and \
+                                    fault_plan.due("corrupt-ckpt",
+                                                   epoch + 1):
+                                from ..resilience.faults import \
+                                    corrupt_latest_checkpoint
+
+                                p = corrupt_latest_checkpoint(
+                                    checkpoint_dir)
+                                log_fn(f"fault-injected checkpoint "
+                                       f"corruption: {p}")
+                                if metrics is not None:
+                                    metrics.fault(kind="injected",
+                                                  epoch=epoch + 1,
+                                                  reason="corrupt-ckpt")
                 epoch += 1
 
         except BaseException as exc:
@@ -2491,6 +2611,16 @@ class Trainer:
             if converted is not None:
                 raise converted from exc
             raise
+        finally:
+            # a fit-armed storage fault must never outlive fit: the
+            # shim is process-wide, and later in-process work (tests,
+            # a clean resume in the same interpreter) would otherwise
+            # inherit a permanently "full" disk. The crash handler
+            # above runs BEFORE this, still degraded — exactly like a
+            # real host whose disk is full when it dies
+            for kind in list(io_armed):
+                FAULTY_IO.disarm(kind)
+            io_armed.clear()
 
         if pending is not None:
             # harvest the final in-flight evaluation

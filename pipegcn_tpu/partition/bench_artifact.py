@@ -76,6 +76,10 @@ def _publish(sg, path: str, log) -> None:
         os.rename(tmp, path)
         return
     except OSError:
+        # load-bearing but locally handled (storage-fault audit): the
+        # fall-through below re-checks, displaces, retries, and RAISES
+        # RuntimeError when nothing publishable lands — publish failure
+        # is never silent
         pass
     # re-check RIGHT before displacing anything: a concurrent winner
     # may have renamed a valid artifact into place since our failed

@@ -33,6 +33,15 @@ all three (docs/RESILIENCE.md):
                  the last good checkpoint; exponential backoff,
                  --max-restarts and a restart-storm circuit breaker
                  bound crash loops
+  storage.py     process-wide storage-fault shim (enospc / torn-write
+                 / ro-dir / slow-fs at the open/write/fsync/rename
+                 seams every durable writer shares) and the one
+                 temp+rename atomic text writer; each writer's
+                 io-degraded policy lives with the writer
+  soak.py        seeded full-stack chaos soak — per-episode fault
+                 schedules composed from ALL kinds above over an
+                 elastic-supervised streaming run, five structural
+                 invariants over the artifacts (scripts/soak.py)
 
 Checkpoint hardening (per-leaf digests, keep-last-N generations,
 corrupt-generation fallback) lives in utils/checkpoint.py; the fault /
@@ -61,6 +70,13 @@ from .elastic import (
     plan_assignment,
 )
 from .faults import FaultPlan, corrupt_latest_checkpoint
+from .storage import (
+    FAULTY_IO,
+    IO_DEGRADED,
+    IO_KINDS,
+    FaultyIO,
+    write_text_atomic,
+)
 from .numerics import (
     PHASES,
     KernelFallbackError,
@@ -98,6 +114,11 @@ __all__ = [
     "plan_assignment",
     "FaultPlan",
     "corrupt_latest_checkpoint",
+    "FAULTY_IO",
+    "FaultyIO",
+    "IO_DEGRADED",
+    "IO_KINDS",
+    "write_text_atomic",
     "Agreed",
     "CoordConfig",
     "Coordinator",

@@ -283,7 +283,16 @@ class HeartbeatWatchdog:
             with open(p, "a"):
                 os.utime(p, None)
         except OSError:
-            pass  # a missed beat is survivable; a raise here is not
+            # genuinely-optional (storage-fault audit): a missed beat
+            # is survivable, a raise from the watchdog thread is not —
+            # and sustained beat failure already HAS a degradation
+            # policy upstream: the peers' watchdogs cull this rank and
+            # the elastic supervisor redistributes its shards. The
+            # heartbeat channel is deliberately NOT routed through the
+            # FaultyIO shim: it must keep beating while the shim
+            # simulates a full data disk, exactly like a real host
+            # whose scratch volume fills while /dev/shm stays fine.
+            pass
 
     def suspend(self) -> None:
         """Stop beating (the ``hang`` chaos fault: simulate a frozen
@@ -312,6 +321,10 @@ class HeartbeatWatchdog:
         try:
             os.remove(self.path_for(self.rank))
         except OSError:
+            # genuinely-optional (storage-fault audit): the next
+            # generation's supervisor clears stale heartbeats anyway
+            # (elastic._clear_stale_heartbeats) and filenames are
+            # generation-keyed
             pass
 
     # ---------------- monitor thread ----------------------------------

@@ -50,6 +50,7 @@ import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .preemption import EXIT_PREEMPTED, classify_exit
+from .storage import IO_DEGRADED, write_text_atomic
 
 # env vars the supervisor sets on every child; cli/main.py reads the
 # generation into CoordConfig so heartbeat files are generation-keyed
@@ -226,12 +227,10 @@ class MembershipLedger:
             payload["restart_latency_s"] = float(restart_latency_s)
         rec = {"crc32": _crc_of(payload), "payload": payload}
         path = self.path_for(generation)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(rec, f, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        # temp+rename through the storage-fault seams: a torn or failed
+        # append leaves no membership-<gen>.json at all, so latest()
+        # keeps answering with the previous durable generation
+        write_text_atomic(path, json.dumps(rec, sort_keys=True))
         return payload
 
     # -- rejoin requests ---------------------------------------------------
@@ -243,10 +242,10 @@ class MembershipLedger:
         """Register a returning rank; the supervisor folds it into the
         next generation's assignment."""
         path = self.rejoin_path(member)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"member": int(member), "time_unix": time.time()}, f)
-        os.replace(tmp, path)
+        write_text_atomic(
+            path,
+            json.dumps({"member": int(member), "time_unix": time.time()}),
+            fsync=False)
         return path
 
     def pending_rejoins(self) -> List[int]:
@@ -476,6 +475,12 @@ class ElasticSupervisor:
         self._children: List[_Child] = []
         self._shutdown: Optional[int] = None
         self._stopping = False
+        # generations whose ledger append failed (disk full / read-only
+        # coord dir), queued for in-order retry: the last DURABLE
+        # generation stays authoritative — a supervisor restart resumes
+        # from ledger.latest(), never from progress that was only
+        # acked in memory
+        self._ledger_pending: List[Dict] = []
         # rejoin@G entries in the fault plan are the supervisor's to
         # honor (inert in the trainer): member rank rejoins at gen G
         self._rejoin_schedule: List[Tuple[int, Optional[int]]] = []
@@ -504,6 +509,10 @@ class ElasticSupervisor:
             try:
                 os.unlink(p)
             except OSError:
+                # genuinely-optional (storage-fault audit): heartbeat
+                # filenames are generation-keyed, so a ghost that
+                # refuses to unlink can never be mistaken for a live
+                # peer of the NEXT generation anyway
                 pass
 
     def _watchdog_horizon_s(self) -> float:
@@ -656,12 +665,56 @@ class ElasticSupervisor:
                       f"at generation {generation + 1}")
         return members, trigger
 
+    def _flush_ledger_pending(self) -> bool:
+        """Retry queued ledger appends in generation order, stopping at
+        the first failure — appending a LATER generation while an
+        earlier one is still pending would make the earlier one
+        permanently unappendable (the ledger enforces monotonicity).
+        True when the queue fully drained."""
+        drained = 0
+        while self._ledger_pending:
+            kw = self._ledger_pending[0]
+            try:
+                self.ledger.append(**kw)
+            except OSError as exc:
+                self._log(f"ledger append for generation "
+                          f"{kw['generation']} still failing ({exc}); "
+                          f"{len(self._ledger_pending)} generations "
+                          f"pending")
+                return False
+            self._ledger_pending.pop(0)
+            drained += 1
+        if drained:
+            self._metrics_logger().recovery(
+                IO_DEGRADED, -1, redrained=drained,
+                component="membership-ledger")
+            self._log(f"ledger recovered: {drained} pending "
+                      f"generations appended")
+        return True
+
     def _record(self, generation: int, members: List[int],
                 assignment: Assignment, trigger: str,
                 latency: Optional[float]) -> None:
-        self.ledger.append(generation=generation, members=members,
-                           assignment=assignment, trigger=trigger,
-                           restart_latency_s=latency)
+        kw = dict(generation=generation, members=list(members),
+                  assignment=assignment, trigger=trigger,
+                  restart_latency_s=latency)
+        appended = False
+        if self._flush_ledger_pending():
+            try:
+                self.ledger.append(**kw)
+                appended = True
+            except OSError as exc:
+                self._log(f"LEDGER WRITE FAILED for generation "
+                          f"{generation} ({exc}); the last durable "
+                          f"generation {self.ledger.latest_generation()} "
+                          f"stays authoritative — queuing for retry at "
+                          f"the next membership event")
+                self._metrics_logger().fault(
+                    IO_DEGRADED, -1, reason=repr(exc),
+                    generation=generation,
+                    component="membership-ledger")
+        if not appended:
+            self._ledger_pending.append(kw)
         self._metrics_logger().membership(
             generation=generation, assignment=assignment.as_json(),
             trigger=trigger, restart_latency_s=latency,

@@ -56,6 +56,20 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 :meth:`due_member`) and hard-kills the replica process
                 so the router's failover path is drillable from the
                 standard chaos harness. Boundary-retired like kill@E.
+  enospc        ``enospc@E[:rN]``: from the start of epoch E until the
+                next checkpoint boundary, every durable write/fsync on
+                this rank raises ENOSPC (resilience/storage.py shim) —
+                exercises the checkpoint retry-next-boundary policy,
+                the metrics ring buffer, and the ledger pending queue
+  torn-write    ``torn-write@E``: durable writes over the same window
+                are truncated mid-flight and fail with EIO before
+                their rename — exercises the temp+rename guarantee
+                that a torn artifact is indistinguishable from absent
+  ro-dir        ``ro-dir@E``: opens-for-write raise EROFS over the
+                window — the artifact directory went read-only
+  slow-fs       ``slow-fs@E:<ms>``: every durable-write seam op sleeps
+                <ms> milliseconds over the window — a degraded shared
+                filesystem; nothing fails, progress just crawls
 
 The optional ``:rN`` qualifier targets one rank (``jax.process_index``)
 so multi-process chaos drills can kill, desynchronize, or hang a single
@@ -84,15 +98,21 @@ import os
 import re
 from typing import List, Optional
 
+from .storage import IO_KINDS
+
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
          "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin",
-         "replica-kill", "graph-delta")
+         "replica-kill", "graph-delta") + IO_KINDS
 # kinds that fire at the start of an epoch boundary: a resume whose
-# start_epoch equals the scheduled epoch has already seen them fire
+# start_epoch equals the scheduled epoch has already seen them fire.
+# IO kinds arm at the boundary and disarm by the next checkpoint
+# boundary, so a resume past the arming epoch has outlived them too.
 _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
-                   "kill", "replica-kill", "graph-delta")
+                   "kill", "replica-kill", "graph-delta") + IO_KINDS
 
-_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm])(\d+))?$")
+# the optional third group is 'r<N>' (rank), 'm<K>' (member), or a bare
+# number — the per-kind argument (only slow-fs takes one: milliseconds)
+_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm]?)(\d+))?$")
 
 
 @dataclasses.dataclass
@@ -101,6 +121,7 @@ class _Entry:
     epoch: int
     rank: Optional[int] = None    # None = every rank (``:rN``)
     member: Optional[int] = None  # serving replica target (``:mK``)
+    arg: Optional[int] = None     # per-kind argument (slow-fs ms)
     consumed: bool = False
 
 
@@ -129,16 +150,23 @@ class FaultPlan:
                     f"kind@epoch[:rN] or kind@window[:mK] (e.g. "
                     f"nan-loss@5:r1,sigterm@8,replica-kill@2:m1)")
             kind, epoch = m.group(1), int(m.group(2))
-            erank = emember = None
+            erank = emember = earg = None
             if m.group(3) == "r":
                 erank = int(m.group(4))
             elif m.group(3) == "m":
                 emember = int(m.group(4))
+            elif m.group(3) == "" and m.group(4) is not None:
+                if kind != "slow-fs":
+                    raise ValueError(
+                        f"bad fault-plan entry {raw!r}: a bare "
+                        f"numeric qualifier (kind@E:<N>) is only "
+                        f"valid for slow-fs (milliseconds)")
+                earg = int(m.group(4))
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r}; known: "
                     f"{', '.join(KINDS)}")
-            entries.append(_Entry(kind, epoch, erank, emember))
+            entries.append(_Entry(kind, epoch, erank, emember, earg))
         return cls(entries, rank=rank)
 
     def _mine(self, e: _Entry) -> bool:
@@ -154,6 +182,7 @@ class FaultPlan:
         return [f"{e.kind}@{e.epoch}"
                 + (f":r{e.rank}" if e.rank is not None else "")
                 + (f":m{e.member}" if e.member is not None else "")
+                + (f":{e.arg}" if e.arg is not None else "")
                 for e in self._entries if not e.consumed]
 
     def skip_before(self, start_epoch: int) -> None:
@@ -205,6 +234,17 @@ class FaultPlan:
             if not e.consumed and e.kind == kind and e.epoch <= window:
                 e.consumed = True
                 return e.member if e.member is not None else 0
+        return None
+
+    def due_arg(self, kind: str, epoch: int) -> Optional[int]:
+        """Like :meth:`due`, but returns the entry's per-kind argument
+        (0 when none was given) instead of True — for kinds that carry
+        one, currently ``slow-fs@E:<ms>``. None when nothing is due."""
+        for e in self._entries:
+            if not e.consumed and e.kind == kind and e.epoch <= epoch \
+                    and self._mine(e):
+                e.consumed = True
+                return e.arg if e.arg is not None else 0
         return None
 
     def due_in(self, kind: str, lo: int, hi: int) -> Optional[int]:

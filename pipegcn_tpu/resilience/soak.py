@@ -1,0 +1,445 @@
+"""Seeded full-stack chaos soak: composed fault schedules + invariants.
+
+The unit tests in tests/ each drill ONE recovery path; this module
+drills their COMPOSITION. A soak run is a sequence of episodes; each
+episode derives a deterministic fault schedule from
+``seed * 1000003 + episode`` (same seed -> same schedules -> same
+verdict), runs an elastic-supervised trainer (cli.elastic subprocess)
+with a streaming delta plan and the schedule as ``--fault-plan``, then
+a final clean ``--resume`` (cli.main subprocess), and checks five
+structural invariants over the artifacts left behind:
+
+  checkpoint  the newest digest-valid generation exists and verifies
+              (utils/checkpoint.py per-leaf CRCs); after the clean
+              resume it sits at the nominal epoch count
+  ledger      membership generations are contiguous from 0 and every
+              record is CRC-clean (resilience/elastic.py)
+  metrics     every metrics JSONL parses (a torn FINAL line is the one
+              legal wound — SIGKILL mid-write) and the union of epoch
+              records across generations + the resume covers every
+              epoch exactly 0..n_epochs-1: nothing silently lost, even
+              through the io-degraded ring-buffer path (obs/metrics.py)
+  tickets     (``serve`` episodes only) the serving fleet drill's
+              summary reports conserved=drained=True — zero accepted
+              tickets lost (serve/fleet.py)
+  resume      the final clean ``--resume`` exits 0 and reaches
+              n_epochs
+
+Schedule composition rules (all deterministic per episode seed):
+
+  * terminal kinds (kill / sigterm / crash) land only on checkpoint-
+    boundary epochs, so the boundary-kind retirement in FaultPlan
+    .skip_before stops them from re-firing forever on resume — every
+    terminal fault costs exactly one restart budget unit (plus one
+    more when a corrupt-ckpt forces the loader one generation back)
+  * the streaming delta applies AFTER the last terminal epoch: there
+    is no delta replay on resume (stream.StreamPlan.skip_before), so
+    a delta must never precede a restart boundary
+  * hang / desync / replica-kill / rejoin are excluded from the
+    default pool — the episodes run one member (streaming is single-
+    process), where those kinds either stall on the watchdog horizon
+    or are inert; force them via ``force_faults`` when running a
+    multi-member config
+  * the storage kinds (resilience/storage.py) ride the same grammar;
+    ``force_faults=("enospc@4",)`` is the acceptance proof that the
+    previous checkpoint generation stays loadable and the re-drained
+    metrics records survive
+
+Each episode emits a schema-contracted ``soak`` record
+(obs/schema.py) and the run writes ``soak-seed<seed>.json`` next to
+the episode dirs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .storage import IO_KINDS
+
+# terminal kinds end the generation; the supervisor relaunches
+TERMINAL_KINDS = ("kill", "sigterm", "crash")
+# in-process kinds: the run recovers without a restart
+SOFT_KINDS = ("nan-loss", "kernel-crash", "corrupt-ckpt",
+              "graph-delta") + IO_KINDS
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakConfig:
+    """One soak run: `episodes` episodes derived from `seed`."""
+
+    seed: int = 0
+    episodes: int = 5
+    n_epochs: int = 8
+    n_parts: int = 2
+    checkpoint_every: int = 2
+    out_dir: str = os.path.join("results", "soak")
+    dataset: str = "synthetic:300:6:8:3"
+    # entries prepended VERBATIM to every episode's schedule (e.g.
+    # ("enospc@4",) for the storage-fault acceptance proof)
+    force_faults: Tuple[str, ...] = ()
+    # adds the serving-fleet ticket-conservation drill to each episode
+    serve: bool = False
+    max_restarts: int = 6
+    episode_timeout_s: float = 900.0
+    keep_dirs: bool = False  # keep green episode dirs for inspection
+
+
+def episode_seed(cfg: SoakConfig, episode: int) -> int:
+    return cfg.seed * 1000003 + episode
+
+
+def compose_schedule(cfg: SoakConfig, episode: int) \
+        -> Tuple[List[str], int]:
+    """(fault entries, stream-delta epoch) for one episode — a pure
+    function of (cfg.seed, episode), never of wall clock or pid."""
+    rng = random.Random(episode_seed(cfg, episode))
+    entries = list(cfg.force_faults)
+    boundaries = list(range(cfg.checkpoint_every,
+                            cfg.n_epochs - 1, cfg.checkpoint_every))
+    n_term = rng.randint(0, min(2, len(boundaries)))
+    term_epochs = sorted(rng.sample(boundaries, n_term))
+    for b in term_epochs:
+        entries.append(f"{rng.choice(TERMINAL_KINDS)}@{b}")
+    for kind in rng.sample(SOFT_KINDS, rng.randint(1, 2)):
+        if kind == "corrupt-ckpt":
+            e = rng.choice(boundaries)
+        else:
+            e = rng.randrange(1, cfg.n_epochs - 1)
+        if kind == "slow-fs":
+            entries.append(f"slow-fs@{e}:{rng.choice((5, 20))}")
+        else:
+            entries.append(f"{kind}@{e}")
+    stream_epoch = min((term_epochs[-1] if term_epochs else 0) + 1,
+                       cfg.n_epochs - 1)
+    return entries, stream_epoch
+
+
+# ---------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------
+
+
+def _inv(ok: bool, **detail) -> Dict:
+    return {"ok": bool(ok), **detail}
+
+
+def check_checkpoint(ck_dir: str,
+                     want_epoch: Optional[int] = None) -> Dict:
+    """Newest digest-valid generation verifies; optionally it must sit
+    at `want_epoch` (after the clean resume)."""
+    from ..utils.checkpoint import CheckpointCorrupt, verify_checkpoint
+
+    gens = sorted(glob.glob(os.path.join(ck_dir, "state-*.npz")),
+                  reverse=True)
+    if not gens:
+        return _inv(False, error="no checkpoint generations on disk")
+    for path in gens:
+        try:
+            epoch = verify_checkpoint(path)
+        except CheckpointCorrupt as exc:
+            # a corrupt-ckpt fault may leave the newest torn; the walk
+            # below must find a valid older generation
+            last_err = repr(exc)
+            continue
+        ok = want_epoch is None or epoch == want_epoch
+        return _inv(ok, path=os.path.basename(path), epoch=epoch,
+                    **({} if ok else {"error": f"epoch {epoch} != "
+                                               f"{want_epoch}"}))
+    return _inv(False, error=f"every generation corrupt ({last_err})")
+
+
+def check_ledger(coord_dir: str) -> Dict:
+    """Generations contiguous from 0, every record CRC-clean."""
+    from .elastic import LedgerCorrupt, MembershipLedger
+
+    led = MembershipLedger(coord_dir)
+    gens = led.generations()
+    if gens != list(range(len(gens))) or not gens:
+        return _inv(False, generations=gens,
+                    error="generations not contiguous from 0")
+    prev = -1
+    for g in gens:
+        try:
+            rec = led.read(g)
+        except LedgerCorrupt as exc:
+            return _inv(False, generations=gens, error=repr(exc))
+        if rec["generation"] <= prev:
+            return _inv(False, generations=gens,
+                        error=f"generation {g} not monotonic")
+        prev = rec["generation"]
+    return _inv(True, generations=gens)
+
+
+def check_metrics(paths: Sequence[str], n_epochs: int) -> Dict:
+    """Every line parses (one torn FINAL line per file tolerated —
+    SIGKILL lands mid-write) and epoch records cover 0..n_epochs-1."""
+    seen: set = set()
+    torn = 0
+    n_files = 0
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        n_files += 1
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    torn += 1  # the one legal wound
+                    continue
+                return _inv(False, file=os.path.basename(path),
+                            error=f"unparseable line {i + 1} (not the "
+                                  f"file tail)")
+            if rec.get("event") == "epoch":
+                seen.add(int(rec["epoch"]))
+    if not n_files:
+        return _inv(False, error="no metrics files found")
+    missing = sorted(set(range(n_epochs)) - seen)
+    return _inv(not missing, files=n_files, torn_tails=torn,
+                epochs_seen=len(seen),
+                **({"missing": missing} if missing else {}))
+
+
+def check_tickets(fleet_summary: Optional[Dict]) -> Dict:
+    """Zero accepted tickets lost in the serving drill (skipped —
+    vacuously green — when the episode did not serve)."""
+    if fleet_summary is None:
+        return _inv(True, skipped=True)
+    ok = (fleet_summary.get("conserved") is True
+          and fleet_summary.get("drained") is True
+          and fleet_summary.get("n_submitted")
+          == fleet_summary.get("n_served", 0)
+          + fleet_summary.get("n_shed", 0))
+    return _inv(ok, conserved=fleet_summary.get("conserved"),
+                drained=fleet_summary.get("drained"),
+                n_submitted=fleet_summary.get("n_submitted"),
+                n_served=fleet_summary.get("n_served"),
+                n_shed=fleet_summary.get("n_shed"))
+
+
+# ---------------------------------------------------------------------
+# episode driver
+# ---------------------------------------------------------------------
+
+
+def _episode_env() -> Dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = _REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["PYTHONUNBUFFERED"] = "1"
+    return env
+
+
+def _train_argv(cfg: SoakConfig, ep_dir: str, delta_path: str,
+                stream_epoch: int) -> List[str]:
+    return [
+        "--dataset", cfg.dataset,
+        "--n-partitions", str(cfg.n_parts),
+        "--parts-per-node", str(cfg.n_parts),  # one member: streaming
+        #                                        is single-process
+        "--n-epochs", str(cfg.n_epochs),
+        "--n-hidden", "8", "--dropout", "0.0",
+        "--log-every", "1000", "--no-eval",
+        "--fix-seed", "--seed", "7",
+        "--local-reorder", "none",
+        "--partition-dir", os.path.join(ep_dir, "parts"),
+        "--checkpoint-dir", os.path.join(ep_dir, "ck"),
+        "--checkpoint-every", str(cfg.checkpoint_every),
+        "--checkpoint-keep", "0",  # keep every generation: the
+        #                            invariants audit the full history
+        "--stream-plan", f"{delta_path}@{stream_epoch}",
+        "--metrics-out", os.path.join(ep_dir, "metrics.jsonl"),
+    ]
+
+
+def _write_delta_file(cfg: SoakConfig, episode: int, path: str) -> None:
+    """One small CRC-guarded delta batch, deterministic per episode.
+    The base graph comes from the same dataset string the episode
+    trains on (synthetic loads are seed-stable), so the batch is valid
+    against every generation's rebuild of the graph."""
+    from ..graph import load_data
+    from ..graph.synthetic import synthetic_delta_schedule
+    from ..stream.deltas import save_deltas
+
+    g = load_data(cfg.dataset)
+    batches = synthetic_delta_schedule(
+        g, n_batches=1, edges_per_batch=4, dels_per_batch=2,
+        nodes_per_batch=1, seed=episode_seed(cfg, episode))
+    save_deltas(path, batches)
+
+
+def _run_fleet_drill(cfg: SoakConfig, episode: int, ep_dir: str,
+                     log: Callable[[str], None]) -> Optional[Dict]:
+    """Short serving-fleet load drill; returns the driver's summary
+    dict (None on a crash, which check_tickets turns red)."""
+    rng = random.Random(episode_seed(cfg, episode) ^ 0x5EA5)
+    cmd = [
+        sys.executable, "-m", "pipegcn_tpu.cli.fleet",
+        "--dataset", cfg.dataset, "--n-partitions", str(cfg.n_parts),
+        "--n-hidden", "8", "--fix-seed",
+        "--partition-dir", os.path.join(ep_dir, "parts-serve"),
+        "--serve-build", "--replicas", "2", "--fleet-policy", "hash",
+        "--serve-duration", "6", "--serve-qps", "40",
+        "--serve-report-every", "0.5",
+        "--metrics-out", os.path.join(ep_dir, "fleet.jsonl"),
+    ]
+    if rng.random() < 0.5:
+        cmd += ["--fault-plan", "replica-kill@2:m1",
+                "--fleet-retry-timeout", "15"]
+    env = _episode_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PIPEGCN_PLATFORM"] = "cpu"
+    try:
+        proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                              timeout=cfg.episode_timeout_s,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        log("  fleet drill timed out")
+        return None
+    tails = [ln for ln in proc.stdout.splitlines()
+             if '"fleet": true' in ln]
+    if proc.returncode != 0 or not tails:
+        log(f"  fleet drill rc={proc.returncode}, no summary")
+        return None
+    return json.loads(tails[-1])
+
+
+def run_episode(cfg: SoakConfig, episode: int,
+                log: Callable[[str], None] = print) -> Dict:
+    """Run one episode end-to-end and return its soak record body."""
+    schedule, stream_epoch = compose_schedule(cfg, episode)
+    ep_dir = os.path.abspath(os.path.join(
+        cfg.out_dir, f"ep{cfg.seed:04d}-{episode:03d}"))
+    shutil.rmtree(ep_dir, ignore_errors=True)
+    os.makedirs(ep_dir)
+    delta_path = os.path.join(ep_dir, "deltas.jsonl")
+    _write_delta_file(cfg, episode, delta_path)
+    argv = _train_argv(cfg, ep_dir, delta_path, stream_epoch)
+    log(f"episode {episode}: faults={schedule} "
+        f"stream@{stream_epoch}")
+
+    env = _episode_env()
+    sup_cmd = [
+        sys.executable, "-m", "pipegcn_tpu.cli.elastic",
+        "--max-restarts", str(cfg.max_restarts),
+        "--backoff-base", "0.1",
+        "--metrics-out", os.path.join(ep_dir, "sup.jsonl"),
+        "--", *argv,
+    ]
+    if schedule:
+        sup_cmd += ["--fault-plan", ",".join(schedule)]
+    try:
+        sup = subprocess.run(sup_cmd, env=env, cwd=_REPO,
+                             timeout=cfg.episode_timeout_s,
+                             capture_output=True, text=True)
+        sup_rc: Optional[int] = sup.returncode
+        sup_tail = (sup.stdout + sup.stderr)[-2000:]
+    except subprocess.TimeoutExpired as exc:
+        sup_rc, sup_tail = None, f"TIMEOUT: {exc}"
+    log(f"  supervised phase rc={sup_rc}")
+
+    # final clean resume: no fault plan, fresh metrics file
+    resume_argv = [a for a in argv]
+    mi = resume_argv.index("--metrics-out")
+    resume_metrics = os.path.join(ep_dir, "metrics-resume.jsonl")
+    resume_argv[mi + 1] = resume_metrics
+    res_cmd = [sys.executable, "-m", "pipegcn_tpu.cli.main",
+               *resume_argv, "--resume"]
+    try:
+        res = subprocess.run(res_cmd, env=env, cwd=_REPO,
+                             timeout=cfg.episode_timeout_s,
+                             capture_output=True, text=True)
+        res_rc: Optional[int] = res.returncode
+        res_tail = (res.stdout + res.stderr)[-2000:]
+    except subprocess.TimeoutExpired as exc:
+        res_rc, res_tail = None, f"TIMEOUT: {exc}"
+    log(f"  clean resume rc={res_rc}")
+
+    fleet_summary = (_run_fleet_drill(cfg, episode, ep_dir, log)
+                     if cfg.serve else None)
+
+    ck_dir = os.path.join(ep_dir, "ck")
+    coord_dir = os.path.join(ep_dir, "parts", "coord-elastic")
+    metric_files = sorted(glob.glob(
+        os.path.join(ep_dir, "metrics*.jsonl")))
+    invariants = {
+        "checkpoint": check_checkpoint(ck_dir, want_epoch=cfg.n_epochs),
+        "ledger": check_ledger(coord_dir),
+        "metrics": check_metrics(metric_files, cfg.n_epochs),
+        "tickets": (check_tickets(fleet_summary) if cfg.serve
+                    else _inv(True, skipped=True)),
+        "resume": _inv(res_rc == 0,
+                       rc=res_rc,
+                       **({} if res_rc == 0
+                          else {"tail": res_tail[-500:]})),
+    }
+    verdict = ("green" if all(v["ok"] for v in invariants.values())
+               else "red")
+    for name, v in invariants.items():
+        log(f"  invariant {name}: {'ok' if v['ok'] else 'RED ' + str(v)}")
+    if verdict == "red":
+        log(f"  supervised tail:\n{sup_tail}")
+    elif not cfg.keep_dirs:
+        shutil.rmtree(ep_dir, ignore_errors=True)
+    return {
+        "episode": episode,
+        "seed": episode_seed(cfg, episode),
+        "schedule": list(schedule),
+        "stream_epoch": stream_epoch,
+        "supervised_rc": sup_rc,
+        "invariants": invariants,
+        "verdict": verdict,
+    }
+
+
+def run_soak(cfg: SoakConfig,
+             log: Callable[[str], None] = print) -> Dict:
+    """Run every episode, write the soak JSONL + summary JSON, return
+    the summary (verdict 'green' iff every episode is green)."""
+    from ..obs.metrics import MetricsLogger
+
+    os.makedirs(cfg.out_dir, exist_ok=True)
+    records = []
+    soak_jsonl = os.path.join(cfg.out_dir,
+                              f"soak-seed{cfg.seed}.jsonl")
+    m = MetricsLogger(soak_jsonl)
+    try:
+        for i in range(cfg.episodes):
+            rec = run_episode(cfg, i, log=log)
+            records.append(rec)
+            m.soak(episode=rec["episode"], seed=rec["seed"],
+                   schedule=rec["schedule"],
+                   invariants=rec["invariants"],
+                   verdict=rec["verdict"],
+                   supervised_rc=rec["supervised_rc"])
+    finally:
+        m.close()
+    verdict = ("green" if records and
+               all(r["verdict"] == "green" for r in records)
+               else "red")
+    summary = {"seed": cfg.seed, "episodes": records,
+               "n_episodes": len(records), "verdict": verdict}
+    out = os.path.join(cfg.out_dir, f"soak-seed{cfg.seed}.json")
+    from .storage import write_text_atomic
+
+    write_text_atomic(out, json.dumps(summary, indent=1), fsync=False)
+    log(f"soak seed {cfg.seed}: {len(records)} episode(s), "
+        f"verdict {verdict} -> {out}")
+    return summary

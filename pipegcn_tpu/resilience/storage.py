@@ -1,0 +1,152 @@
+"""Storage-fault injection + the atomic-write seam for durable writers.
+
+Every CRC/digest mechanism in this repo (checkpoint generations,
+membership ledger, stream delta files, tuning sidecar) verifies READS;
+this module is where WRITE failure becomes injectable and survivable.
+A process-wide :class:`FaultyIO` shim sits at the open/write/fsync/
+rename seams the durable writers share; the fault-plan grammar
+(resilience/faults.py) arms it with four storage-fault kinds:
+
+  enospc      writes raise OSError(ENOSPC) — the disk is full
+  torn-write  the temp file is truncated to half and EIO raised
+              BEFORE the rename, so the destination is untouched: a
+              torn artifact is indistinguishable from an absent one
+              (the property temp+rename exists to guarantee)
+  ro-dir      opens-for-write raise OSError(EROFS) — the artifact
+              directory went read-only (remount, quota, NFS hiccup)
+  slow-fs     every seam op sleeps a configured number of
+              milliseconds — a degraded shared filesystem
+
+When nothing is armed every seam is a no-op (one falsy dict check), so
+production writers pay nothing. Degradation policies live with the
+writers: checkpoint saves retry at the next boundary (+ optional
+fallback dir, parallel/trainer.py), the metrics sink ring-buffers and
+re-drains (obs/metrics.py), the membership ledger queues payloads and
+keeps the last durable generation authoritative
+(resilience/elastic.py). All of them emit loud ``io-degraded``
+fault/recovery records (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+# fault kinds this shim understands; resilience/faults.py registers
+# them in the fault-plan grammar and the trainer arms/disarms the
+# process-wide shim at epoch boundaries
+IO_KINDS = ("enospc", "torn-write", "ro-dir", "slow-fs")
+
+# the fault/recovery record kind every storage degradation policy emits
+IO_DEGRADED = "io-degraded"
+
+
+class FaultyIO:
+    """Process-wide armable IO-fault state + the seam checks.
+
+    Writers never branch on fault kinds themselves — they call
+    :meth:`gate` at each seam (open / write / fsync / rename) and
+    :meth:`maybe_tear` on their finished temp file just before the
+    rename. Unarmed, both are single-dict-lookup no-ops.
+    """
+
+    def __init__(self):
+        self._armed: Dict[str, Dict[str, int]] = {}
+
+    # -- arming -----------------------------------------------------------
+
+    def arm(self, kind: str, *, ms: int = 0) -> None:
+        if kind not in IO_KINDS:
+            raise ValueError(
+                f"unknown IO fault kind {kind!r}; known: "
+                f"{', '.join(IO_KINDS)}")
+        self._armed[kind] = {"ms": int(ms)}
+
+    def disarm(self, kind: str) -> bool:
+        """True when `kind` was armed (and is now disarmed)."""
+        return self._armed.pop(kind, None) is not None
+
+    def disarm_all(self) -> Tuple[str, ...]:
+        kinds = tuple(sorted(self._armed))
+        self._armed.clear()
+        return kinds
+
+    def active(self, kind: str) -> bool:
+        return kind in self._armed
+
+    def armed_kinds(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._armed))
+
+    # -- seams ------------------------------------------------------------
+
+    def gate(self, path: str, op: str) -> None:
+        """Apply armed faults at one seam. `op` is one of 'open',
+        'write', 'fsync', 'rename'. ro-dir fires at open-for-write,
+        enospc at write/fsync (a full disk lets you open but not
+        flush), slow-fs sleeps at every seam."""
+        if not self._armed:
+            return
+        slow = self._armed.get("slow-fs")
+        if slow is not None and slow["ms"] > 0:
+            time.sleep(slow["ms"] / 1000.0)
+        if op == "open" and "ro-dir" in self._armed:
+            raise OSError(errno.EROFS,
+                          "read-only file system (injected ro-dir)", path)
+        if op in ("write", "fsync") and "enospc" in self._armed:
+            raise OSError(errno.ENOSPC,
+                          "no space left on device (injected enospc)",
+                          path)
+
+    def maybe_tear(self, tmp_path: str) -> None:
+        """torn-write seam: called on a fully-written TEMP file just
+        before its rename. Truncates the temp to half its bytes and
+        raises EIO — the destination is never touched, so recovery sees
+        the previous good artifact (or nothing), never half of one."""
+        if "torn-write" not in self._armed:
+            return
+        try:
+            size = os.path.getsize(tmp_path)
+            with open(tmp_path, "r+b") as f:
+                f.truncate(size // 2)
+        except OSError:
+            pass  # the raise below is the injection either way
+        raise OSError(errno.EIO,
+                      "interrupted write (injected torn-write)", tmp_path)
+
+
+# THE process-wide shim every durable writer routes through. Tests and
+# the trainer's boundary arming mutate this instance; anything not
+# armed here behaves exactly as before this module existed.
+FAULTY_IO = FaultyIO()
+
+
+def write_text_atomic(path: str, text: str, *, fsync: bool = True,
+                      io: Optional[FaultyIO] = None) -> None:
+    """The one temp+rename text writer (membership ledger, rejoin
+    requests, tuning sidecar, readiness files, stream delta JSONL):
+    write to a pid-suffixed temp, optionally fsync, rename into place.
+    An interrupted (or injected-torn) write leaves the destination
+    untouched. Raises OSError on any failure — degradation policy is
+    the CALLER's job."""
+    io = io if io is not None else FAULTY_IO
+    io.gate(path, "open")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            io.gate(path, "write")
+            f.write(text)
+            f.flush()
+            if fsync:
+                io.gate(path, "fsync")
+                os.fsync(f.fileno())
+        io.maybe_tear(tmp)
+        io.gate(path, "rename")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass  # orphaned temp: cosmetic, never load-bearing

@@ -111,15 +111,19 @@ def _ready_path(fleet_dir: str, replica: int) -> str:
 
 def _write_ready(fleet_dir: str, replica: int, incarnation: int,
                  port: int) -> None:
-    """Atomic publish: the manager must never read a torn port."""
-    path = _ready_path(fleet_dir, replica)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump({"replica": int(replica),
-                   "incarnation": int(incarnation),
-                   "port": int(port), "pid": os.getpid(),
-                   "t_ready": time.time()}, f)
-    os.replace(tmp, path)
+    """Atomic publish: the manager must never read a torn port. Routed
+    through the storage-fault seams (resilience/storage.py); a failed
+    publish propagates and the replica dies unready — the manager's
+    ready-timeout + relaunch policy IS the degradation path here."""
+    from ..resilience.storage import write_text_atomic
+
+    write_text_atomic(
+        _ready_path(fleet_dir, replica),
+        json.dumps({"replica": int(replica),
+                    "incarnation": int(incarnation),
+                    "port": int(port), "pid": os.getpid(),
+                    "t_ready": time.time()}),
+        fsync=False)
 
 
 def _read_ready(fleet_dir: str, replica: int) -> Optional[dict]:
@@ -286,6 +290,9 @@ class ReplicaServer:
                     try:
                         _send_msg(self.request, resp)
                     except OSError:
+                        # genuinely-optional (storage-fault audit): the
+                        # CLIENT hung up mid-error-reply; it will retry
+                        # against a survivor via the router's failover
                         return
 
         class _Server(socketserver.ThreadingTCPServer):
@@ -385,6 +392,8 @@ class TcpReplicaClient:
             try:
                 self._sock.close()
             except OSError:
+                # genuinely-optional (storage-fault audit): closing an
+                # already-dead socket; the fd is gone either way
                 pass
             self._sock = None
 
@@ -501,6 +510,9 @@ class FleetManager:
         try:
             os.remove(_ready_path(self.fleet_dir, rid))
         except OSError:
+            # genuinely-optional (storage-fault audit): wait_ready
+            # matches on the NEW incarnation number, so a stale file
+            # that refuses to unlink is ignored, not trusted
             pass
         log_path = os.path.join(
             self.fleet_dir, f"replica-m{rid}-i{rep.incarnation}.log")
@@ -568,6 +580,8 @@ class FleetManager:
             try:
                 rep.proc.kill()
             except OSError:
+                # genuinely-optional (storage-fault audit): the process
+                # already exited between poll() and kill()
                 pass
         if router is not None:
             # the router's on_fault hook (wired in cli/fleet.py) emits
@@ -664,6 +678,8 @@ class FleetManager:
             try:
                 os.kill(rep.proc.pid, signal.SIGKILL)
             except OSError:
+                # genuinely-optional (storage-fault audit): the chaos
+                # drill wanted it dead and it already is
                 pass
 
     def stop_all(self, timeout_s: float = 10.0) -> None:
@@ -680,6 +696,9 @@ class FleetManager:
                 try:
                     rep.proc.terminate()
                 except OSError:
+                    # genuinely-optional (storage-fault audit): races
+                    # the replica's own exit; SIGKILL below is the
+                    # backstop
                     pass
             while rep.proc.poll() is None \
                     and time.monotonic() < deadline:
@@ -688,6 +707,8 @@ class FleetManager:
                 try:
                     rep.proc.kill()
                 except OSError:
+                    # genuinely-optional (storage-fault audit): already
+                    # dead; wait() below reaps either way
                     pass
                 rep.proc.wait()
         for rep in self.replicas.values():

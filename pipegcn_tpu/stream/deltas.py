@@ -155,20 +155,28 @@ def batch_crc(b: DeltaBatch) -> int:
 # ---------------------------------------------------------------------
 
 def save_deltas(path: str, batches: Sequence[DeltaBatch]) -> None:
-    """Write a delta file (format by extension: .npz or JSONL)."""
+    """Write a delta file (format by extension: .npz or JSONL).
+
+    Both formats write temp+rename through the storage-fault seams
+    (resilience/storage.py): the loaders' CRC checks catch a torn file
+    after the fact, but a serving topology polling `path` must never
+    even SEE a half-written one (storage-fault audit: this writer used
+    to write in place)."""
     _check_monotonic(batches, path)
     if path.endswith(".npz"):
         _save_npz(path, batches)
         return
-    with open(path, "w") as f:
-        hdr = {"format": _FORMAT_NAME, "version": DELTA_FORMAT_VERSION,
-               "n_batches": len(batches)}
-        hdr["crc"] = _json_crc(hdr)
-        f.write(json.dumps(hdr, sort_keys=True) + "\n")
-        for b in batches:
-            payload = _canon_payload(b)
-            payload["crc"] = _json_crc(payload)
-            f.write(json.dumps(payload, sort_keys=True) + "\n")
+    from ..resilience.storage import write_text_atomic
+
+    hdr = {"format": _FORMAT_NAME, "version": DELTA_FORMAT_VERSION,
+           "n_batches": len(batches)}
+    hdr["crc"] = _json_crc(hdr)
+    lines = [json.dumps(hdr, sort_keys=True)]
+    for b in batches:
+        payload = _canon_payload(b)
+        payload["crc"] = _json_crc(payload)
+        lines.append(json.dumps(payload, sort_keys=True))
+    write_text_atomic(path, "\n".join(lines) + "\n", fsync=False)
 
 
 def load_deltas(path: str) -> List[DeltaBatch]:
@@ -246,7 +254,23 @@ def _save_npz(path: str, batches: Sequence[DeltaBatch]) -> None:
         arrs[k + "nbr_flat"] = parts[5]
         arrs[k + "nbr_ptr"] = parts[6]
         arrs[k + "crc"] = np.int64(_array_crc(parts))
-    np.savez(path, **arrs)
+    from ..resilience.storage import FAULTY_IO
+
+    # np.savez appends ".npz" unless the name already ends with it
+    FAULTY_IO.gate(path, "open")
+    tmp = f"{path}.{os.getpid()}.tmp.npz"
+    try:
+        np.savez(tmp, **arrs)
+        FAULTY_IO.gate(path, "write")
+        FAULTY_IO.maybe_tear(tmp)
+        FAULTY_IO.gate(path, "rename")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def _load_npz(path: str) -> List[DeltaBatch]:

@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
 import warnings
 import zipfile
 import zlib
@@ -44,6 +45,13 @@ import numpy as np
 
 class CheckpointCorrupt(RuntimeError):
     """A checkpoint file failed to open, read, or verify."""
+
+
+def _io():
+    # lazy: resilience/__init__ -> elastic -> this module would cycle
+    # on a top-level import of the storage shim
+    from ..resilience.storage import FAULTY_IO
+    return FAULTY_IO
 
 
 def _path_str(path) -> str:
@@ -102,9 +110,14 @@ def save_pytree(path: str, tree: Any, extra: dict = None) -> None:
     # to the same shared-filesystem path — from renaming each other's
     # half-written temp away (observed as FileNotFoundError on rank 1).
     # (np.savez appends ".npz" unless the name already ends with it)
+    io = _io()
+    io.gate(path, "open")
     tmp = f"{path}.{os.getpid()}.tmp.npz"
     try:
         np.savez_compressed(tmp, **arrays)
+        io.gate(path, "write")
+        io.maybe_tear(tmp)
+        io.gate(path, "rename")
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -250,6 +263,35 @@ def _candidates(directory: str) -> List[str]:
     return out
 
 
+def _estimate_nbytes(state: Any) -> int:
+    """Upper bound on the serialized generation size: raw leaf bytes
+    (savez_compressed only shrinks) + a fixed zip/manifest allowance."""
+    total = 65536
+    for leaf in jax.tree_util.tree_leaves(state):
+        try:
+            total += int(np.asarray(leaf).nbytes)
+        except (TypeError, ValueError):
+            pass
+    return total
+
+
+def disk_preflight(directory: str, state: Any,
+                   margin_bytes: int = 64 << 20) -> bool:
+    """True when `directory`'s filesystem has headroom for another
+    generation of `state` (estimate + margin). False — space is tight —
+    means the caller should still ATTEMPT the save (the estimate is an
+    upper bound and the write is temp+rename-safe) but must not delete
+    older generations to make room: never trade the only loadable
+    generation for a write that may fail. Probe errors count as
+    headroom — a broken statvfs must not fail an otherwise-healthy
+    save path."""
+    try:
+        free = shutil.disk_usage(directory).free
+    except OSError:
+        return True
+    return free >= _estimate_nbytes(state) + margin_bytes
+
+
 def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
                     keep: int = 3) -> str:
     """Save full training state for resume; returns the generation path.
@@ -260,17 +302,31 @@ def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
     a new ``state-<epoch>.npz`` generation, repoints ``latest``
     atomically, and prunes generations beyond the newest `keep`
     (keep <= 0 keeps everything; the legacy state.npz is never
-    pruned — it may be the only pre-rotation fallback)."""
+    pruned — it may be the only pre-rotation fallback). When the disk
+    preflight says space is tight the save is still attempted but the
+    prune is skipped for this save."""
     os.makedirs(directory, exist_ok=True)
     _sweep_stale_tmps(directory)
+    headroom = disk_preflight(directory, state)
+    if not headroom:
+        warnings.warn(
+            f"checkpoint disk preflight: {directory} is low on space "
+            f"for another ~{_estimate_nbytes(state) >> 20} MiB "
+            f"generation; attempting the save anyway but KEEPING all "
+            f"older generations (rotation-deletion skipped)")
     path = os.path.join(directory, _gen_name(epoch))
     save_pytree(path, state,
                 extra={"__epoch__": np.asarray(epoch, np.int64)})
+    io = _io()
     lp = os.path.join(directory, _LATEST)
+    io.gate(lp, "open")
     tmp = f"{lp}.{os.getpid()}.tmp"
     try:
         with open(tmp, "w") as f:
+            io.gate(lp, "write")
             f.write(os.path.basename(path) + "\n")
+        io.maybe_tear(tmp)
+        io.gate(lp, "rename")
         os.replace(tmp, lp)
     finally:
         if os.path.exists(tmp):
@@ -278,7 +334,7 @@ def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
                 os.remove(tmp)
             except OSError:
                 pass
-    if keep and keep > 0:
+    if keep and keep > 0 and headroom:
         gens = [g for g in _generations(directory) if g[0] >= 0]
         for _, p in gens[keep:]:
             if os.path.abspath(p) == os.path.abspath(path):
@@ -286,7 +342,8 @@ def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
             try:
                 os.remove(p)
             except OSError:
-                pass
+                pass  # a still-open or vanished old generation is
+                # not worth failing a successful save over
     return path
 
 
@@ -471,6 +528,54 @@ def load_checkpoint_carry(directory: str, template_comm: Any,
     raise CheckpointCorrupt(
         f"every checkpoint generation in {directory} failed "
         f"verification; last error: {last_exc}")
+
+
+def verify_checkpoint(path: str) -> int:
+    """Template-free full verification of one generation: every stored
+    member is decompressed and checked against the ``__digests__``
+    manifest. Returns the stored epoch (-1 for a legacy pre-__epoch__
+    file). Raises :class:`CheckpointCorrupt` on any open/read/digest
+    failure — including a missing manifest, since an unverifiable
+    checkpoint is exactly what the soak invariants exist to reject.
+    This is the soak harness's invariant 1 (resilience/soak.py); the
+    trainer's load path stays on the template-driven
+    :func:`load_checkpoint`."""
+    try:
+        data = np.load(path)
+    except _READ_ERRORS as exc:
+        raise CheckpointCorrupt(
+            f"cannot open checkpoint {path}: {exc!r}") from exc
+    try:
+        if _DIGEST_KEY not in data.files:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} has no digest manifest")
+        try:
+            digests = json.loads(str(data[_DIGEST_KEY][()]))
+        except (*_READ_ERRORS, ValueError) as exc:
+            raise CheckpointCorrupt(
+                f"unreadable digest manifest in {path}: {exc!r}") from exc
+        epoch = -1
+        for key in data.files:
+            if key == _DIGEST_KEY:
+                continue
+            try:
+                arr = data[key]
+            except _READ_ERRORS as exc:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: member {key!r} unreadable "
+                    f"({exc!r})") from exc
+            if key not in digests:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: member {key!r} missing from "
+                    f"digest manifest")
+            if _crc(arr) != digests[key]:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path}: digest mismatch for {key!r}")
+            if key == "__epoch__":
+                epoch = int(arr)
+    finally:
+        data.close()
+    return epoch
 
 
 def peek_epoch(directory: str):

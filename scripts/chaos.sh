@@ -76,3 +76,18 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
 timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults or fleet" \
     -k "fleet" -p no:cacheprovider "$@"
+
+# Soak lane (docs/RESILIENCE.md "Storage faults"): the harness unit
+# tests (schedule-composition determinism, invariant checkers, the
+# full subprocess episode) plus a short fixed-seed real soak —
+# 2 seeded episodes through scripts/soak.py, schedules covering
+# terminal kills and storage faults, every per-episode invariant
+# (checkpoint loadable, ledger monotonic+CRC-clean, metrics coverage
+# gap-free, clean resume) checked for real. Summary JSON lands in
+# results/soak/ for CI artifact upload. Deterministic: a red lane
+# reproduces locally with the same command.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m soak \
+    -p no:cacheprovider "$@"
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    python scripts/soak.py --seed 0 --episodes 2 --out-dir results/soak

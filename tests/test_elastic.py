@@ -154,6 +154,66 @@ def test_ledger_crc_rejects_tamper_and_falls_back(tmp_path):
         led.read(1)
 
 
+def test_ledger_truncated_generation_walks_back(tmp_path):
+    """A half-written membership-<gen>.json (a torn write that somehow
+    landed, e.g. a crash in a pre-atomic-writer version, or filesystem
+    rot) must read as LedgerCorrupt and walk back — never crash the
+    supervisor or resurrect a phantom generation."""
+    led = MembershipLedger(str(tmp_path))
+    a = plan_assignment(2, [0, 1])
+    led.append(generation=0, members=[0, 1], assignment=a,
+               trigger="start")
+    led.append(generation=1, members=[0], assignment=a,
+               trigger="rank-death")
+    path = led.path_for(1)
+    full = open(path).read()
+    with open(path, "w") as f:
+        f.write(full[:len(full) // 2])  # truncate mid-record
+    with pytest.raises(LedgerCorrupt):
+        led.read(1)
+    assert led.latest()["generation"] == 0
+    # monotonicity still counts the torn file: gen 1 is burned, the
+    # next append must go to 2 (a fresh gen-1 could be mistaken for
+    # the torn one by a reader holding its path)
+    assert led.latest_generation() == 1
+    led.append(generation=2, members=[0], assignment=a,
+               trigger="restart-all")
+    assert led.latest()["generation"] == 2
+
+
+def test_supervisor_ledger_pending_retries_on_next_event(tmp_path):
+    """Satellite: LEDGER WRITE FAILED -> the last durable generation
+    stays authoritative, the failed append queues, and the next
+    membership event drains the queue in generation order."""
+    from pipegcn_tpu.resilience.storage import FAULTY_IO, IO_DEGRADED
+
+    logs = []
+    sup = ElasticSupervisor(_train_argv(tmp_path), _fast_cfg(),
+                            log=logs.append)
+    a = plan_assignment(4, [0, 1])
+    sup._record(0, [0, 1], a, "start", None)
+    assert sup.ledger.generations() == [0]
+    FAULTY_IO.arm("enospc")
+    try:
+        sup._record(1, [0], a, "rank-death", 1.0)
+    finally:
+        FAULTY_IO.disarm_all()
+    # nothing half-landed; generation 0 is still the durable truth
+    assert sup.ledger.generations() == [0]
+    assert sup.ledger.latest()["generation"] == 0
+    assert any("LEDGER WRITE FAILED" in s for s in logs)
+    # disk recovered: the next event drains gen 1 THEN appends gen 2
+    sup._record(2, [0], a, "restart-all", None)
+    assert sup.ledger.generations() == [0, 1, 2]
+    assert sup.ledger.read(1)["trigger"] == "rank-death"
+    sup._metrics_logger().close()
+    recs = read_metrics(os.path.join(sup.coord_dir, "membership.jsonl"))
+    kinds = [(r["event"], r.get("kind")) for r in recs
+             if r["event"] in ("fault", "recovery")]
+    assert (("fault", IO_DEGRADED) in kinds
+            and ("recovery", IO_DEGRADED) in kinds)
+
+
 def test_ledger_rejoin_requests(tmp_path):
     led = MembershipLedger(str(tmp_path))
     assert led.pending_rejoins() == []

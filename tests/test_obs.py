@@ -154,6 +154,26 @@ def test_schema_v8_drift_guard():
         assert obs_schema.SCHEMA_VERSION > 8
 
 
+# FROZEN copy of the v9 additions (v8 + the `soak` kind the storage-
+# fault PR added, bumping the version to 9; the same PR added the
+# io-degraded fault/recovery kind, which needs no new fields). Same
+# contract as the earlier guards.
+_V9_SOAK_FIELDS = {
+    "event": "string", "episode": "integer", "seed": "integer",
+    "schedule": "array", "invariants": "object", "verdict": "string",
+}
+
+
+def test_schema_v9_drift_guard():
+    if obs_schema.SCHEMA_VERSION == 9:
+        for name, tag in _V9_SOAK_FIELDS.items():
+            assert obs_schema.SOAK_FIELDS.get(name) == tag, (
+                f"schema field soak.{name} removed or retyped "
+                f"without bumping SCHEMA_VERSION")
+    else:
+        assert obs_schema.SCHEMA_VERSION > 9
+
+
 def test_validate_record():
     validate_record({"event": "epoch", "epoch": 0, "step_time_s": 0.1,
                      "loss": 1.0, "grad_norm": 0.5, "halo_bytes": 128,

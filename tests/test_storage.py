@@ -1,0 +1,419 @@
+"""Storage-fault tests (resilience/storage.py + every writer's
+io-degraded policy): the FaultyIO shim and grammar, checkpoint-save
+degradation (previous generation stays authoritative), the metrics
+ring buffer with re-drain, ledger durability, delta-file atomicity,
+and the chaos-soak harness (resilience/soak.py).
+
+Everything here is marked `faults` (+ `soak` for the harness tests);
+the full subprocess episode is additionally `slow`. The unit tests
+never start jax — the shim and the writers are pure host code.
+"""
+
+import errno
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.obs import MetricsLogger
+from pipegcn_tpu.resilience import FaultPlan
+from pipegcn_tpu.resilience.storage import (
+    FAULTY_IO,
+    IO_KINDS,
+    FaultyIO,
+    write_text_atomic,
+)
+from pipegcn_tpu.utils.checkpoint import (
+    CheckpointCorrupt,
+    disk_preflight,
+    latest_checkpoint_path,
+    peek_epoch,
+    save_checkpoint,
+    verify_checkpoint,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """The shim is process-wide: no test may leak an armed fault."""
+    yield
+    FAULTY_IO.disarm_all()
+
+
+def _state(v=1.0):
+    return {"params": {"w": np.full((4, 3), v, np.float32)},
+            "opt": {"t": np.array(7, np.int64)}}
+
+
+# ---------------- the shim -------------------------------------------
+
+
+def test_faulty_io_arm_disarm():
+    fio = FaultyIO()
+    assert fio.armed_kinds() == ()
+    fio.arm("enospc")
+    fio.arm("slow-fs", ms=5)
+    assert fio.active("enospc") and fio.active("slow-fs")
+    assert fio.armed_kinds() == ("enospc", "slow-fs")
+    assert fio.disarm("enospc") is True
+    assert fio.disarm("enospc") is False
+    assert fio.disarm_all() == ("slow-fs",)
+    with pytest.raises(ValueError, match="unknown IO fault kind"):
+        fio.arm("disk-on-fire")
+
+
+def test_gate_semantics(tmp_path):
+    fio = FaultyIO()
+    # unarmed: every seam is a no-op
+    for op in ("open", "write", "fsync", "rename"):
+        fio.gate("x", op)
+    fio.arm("ro-dir")
+    with pytest.raises(OSError) as ei:
+        fio.gate("x", "open")
+    assert ei.value.errno == errno.EROFS
+    fio.gate("x", "write")  # ro-dir only guards open-for-write
+    fio.disarm_all()
+    fio.arm("enospc")
+    fio.gate("x", "open")  # a full disk still lets you open
+    for op in ("write", "fsync"):
+        with pytest.raises(OSError) as ei:
+            fio.gate("x", op)
+        assert ei.value.errno == errno.ENOSPC
+
+
+def test_slow_fs_sleeps():
+    fio = FaultyIO()
+    fio.arm("slow-fs", ms=30)
+    t0 = time.perf_counter()
+    fio.gate("x", "write")
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_write_text_atomic_roundtrip_and_torn(tmp_path):
+    path = str(tmp_path / "a.json")
+    write_text_atomic(path, '{"v": 1}')
+    assert json.load(open(path)) == {"v": 1}
+    FAULTY_IO.arm("torn-write")
+    with pytest.raises(OSError) as ei:
+        write_text_atomic(path, '{"v": 2}')
+    assert ei.value.errno == errno.EIO
+    # the torn write is indistinguishable from an absent one: the
+    # destination still holds the PREVIOUS content, no temp remains
+    assert json.load(open(path)) == {"v": 1}
+    assert os.listdir(tmp_path) == ["a.json"]
+    FAULTY_IO.disarm_all()
+    write_text_atomic(path, '{"v": 3}', fsync=False)
+    assert json.load(open(path)) == {"v": 3}
+
+
+def test_write_text_atomic_enospc_and_ro_dir(tmp_path):
+    path = str(tmp_path / "b.txt")
+    for kind, eno in (("enospc", errno.ENOSPC), ("ro-dir", errno.EROFS)):
+        FAULTY_IO.arm(kind)
+        with pytest.raises(OSError) as ei:
+            write_text_atomic(path, "x")
+        assert ei.value.errno == eno
+        assert not os.path.exists(path)
+        FAULTY_IO.disarm_all()
+
+
+# ---------------- the fault-plan grammar -----------------------------
+
+
+def test_fault_grammar_io_kinds():
+    p = FaultPlan.parse("enospc@4,slow-fs@3:20,torn-write@6,ro-dir@2")
+    # remaining() round-trips entries (epoch-sorted), args included
+    assert p.remaining() == ["ro-dir@2", "slow-fs@3:20",
+                             "enospc@4", "torn-write@6"]
+    # due_arg is at-or-after + single-shot, like every boundary kind
+    assert p.due_arg("slow-fs", 2) is None
+    assert p.due_arg("slow-fs", 5) == 20
+    assert p.due_arg("slow-fs", 5) is None
+    # argless kinds report 0 when due
+    assert p.due_arg("enospc", 4) == 0
+    assert p.due_arg("enospc", 4) is None
+    # bare numeric args are slow-fs-only: ":20" on any other kind is a
+    # typo'd rank/member filter, not a silent no-op
+    with pytest.raises(ValueError, match="slow-fs"):
+        FaultPlan.parse("enospc@4:20")
+
+
+def test_io_kinds_retired_on_resume():
+    # a resumed run must not re-live an IO window it already outlived
+    p = FaultPlan.parse("enospc@4,torn-write@8")
+    p.skip_before(6)
+    assert p.remaining() == ["torn-write@8"]
+
+
+# ---------------- checkpoint degradation -----------------------------
+
+
+def test_checkpoint_enospc_keeps_previous_generation(tmp_path):
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, _state(1.0), 2, keep=0)
+    FAULTY_IO.arm("enospc")
+    with pytest.raises(OSError) as ei:
+        save_checkpoint(ck, _state(2.0), 4, keep=0)
+    assert ei.value.errno == errno.ENOSPC
+    # the previous generation is untouched and still authoritative
+    assert peek_epoch(ck) == 2
+    assert verify_checkpoint(latest_checkpoint_path(ck)) == 2
+    FAULTY_IO.disarm_all()
+    save_checkpoint(ck, _state(2.0), 4, keep=0)
+    assert peek_epoch(ck) == 4
+
+
+def test_checkpoint_torn_write_leaves_destination_absent(tmp_path):
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, _state(1.0), 2, keep=0)
+    FAULTY_IO.arm("torn-write")
+    with pytest.raises(OSError):
+        save_checkpoint(ck, _state(2.0), 4, keep=0)
+    FAULTY_IO.disarm_all()
+    # torn mid-rename: state-00000004.npz never appeared, and the walk
+    # back lands on the intact generation
+    assert not os.path.exists(os.path.join(ck, "state-00000004.npz"))
+    assert verify_checkpoint(latest_checkpoint_path(ck)) == 2
+
+
+def test_verify_checkpoint_rejects_corruption(tmp_path):
+    from pipegcn_tpu.resilience import corrupt_latest_checkpoint
+
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, _state(), 2, keep=0)
+    path = latest_checkpoint_path(ck)
+    assert verify_checkpoint(path) == 2
+    corrupt_latest_checkpoint(ck)
+    with pytest.raises(CheckpointCorrupt):
+        verify_checkpoint(path)
+
+
+def test_disk_preflight_tight_disk_skips_rotation(tmp_path, monkeypatch):
+    import shutil as _shutil
+
+    import pipegcn_tpu.utils.checkpoint as ckpt_mod
+
+    ck = str(tmp_path / "ck")
+    for e in (2, 4, 6):
+        save_checkpoint(ck, _state(float(e)), e, keep=1)
+    # keep=1 pruned the older generations under normal headroom
+    assert len([f for f in os.listdir(ck)
+                if f.startswith("state-")]) == 1
+    assert disk_preflight(ck, _state()) is True
+    # simulate a nearly-full volume: preflight warns loudly and the
+    # rotation-deletion is skipped (never delete what might be the
+    # last good copy when the new write may not land)
+    real_usage = _shutil.disk_usage
+    monkeypatch.setattr(ckpt_mod.shutil, "disk_usage",
+                        lambda p: real_usage(p)._replace(free=1024))
+    assert disk_preflight(ck, _state()) is False
+    with pytest.warns(UserWarning, match="preflight"):
+        save_checkpoint(ck, _state(8.0), 8, keep=1)
+    kept = [f for f in os.listdir(ck) if f.startswith("state-")]
+    assert len(kept) == 2  # epoch-6 generation NOT rotated away
+    assert peek_epoch(ck) == 8
+
+
+# ---------------- metrics sink degradation ---------------------------
+
+
+def test_metrics_ring_buffer_degrade_and_redrain(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    m = MetricsLogger(path)
+    m.fault(kind="injected", epoch=0, reason="warmup")
+    FAULTY_IO.arm("enospc")
+    with pytest.warns(UserWarning, match="io-degraded") as warned:
+        m.fault(kind="injected", epoch=1, reason="one")
+        m.fault(kind="injected", epoch=2, reason="two")
+    # ONE deduped warning for the whole degraded episode
+    assert len([w for w in warned
+                if "io-degraded" in str(w.message)]) == 1
+    assert m.degraded
+    FAULTY_IO.disarm_all()
+    m.fault(kind="injected", epoch=3, reason="three")  # triggers drain
+    assert not m.degraded
+    m.close()
+    recs = [json.loads(l) for l in open(path)]
+    faults = [r for r in recs if r.get("event") == "fault"]
+    # nothing silently lost: the buffered records re-drained in order
+    assert [r["reason"] for r in faults] == ["warmup", "one", "two",
+                                             "three"]
+    rec = [r for r in recs if r.get("event") == "recovery"
+           and r.get("kind") == "io-degraded"]
+    assert len(rec) == 1 and rec[0]["redrained"] == 2
+    assert rec[0]["dropped"] == 0
+
+
+def test_metrics_close_warns_when_still_degraded(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    m = MetricsLogger(path)
+    FAULTY_IO.arm("enospc")
+    with pytest.warns(UserWarning):
+        m.fault(kind="injected", epoch=1, reason="x")
+    with pytest.warns(UserWarning, match="lost"):
+        m.close()  # still armed: buffered records cannot land
+    FAULTY_IO.disarm_all()
+
+
+def test_metrics_stringio_sink_never_degrades():
+    buf = io.StringIO()
+    m = MetricsLogger(buf)
+    m.fault(kind="injected", epoch=0, reason="x")
+    m.hard_flush()  # fileno() raising UnsupportedOperation is benign
+    assert not m.degraded
+    assert '"injected"' in buf.getvalue()
+
+
+# ---------------- other durable writers ------------------------------
+
+
+def test_ledger_append_enospc_keeps_last_durable(tmp_path):
+    from pipegcn_tpu.resilience import MembershipLedger, plan_assignment
+
+    led = MembershipLedger(str(tmp_path))
+    a = plan_assignment(2, [0])
+    led.append(generation=0, members=[0], assignment=a, trigger="start")
+    FAULTY_IO.arm("enospc")
+    with pytest.raises(OSError):
+        led.append(generation=1, members=[0], assignment=a,
+                   trigger="restart-all")
+    FAULTY_IO.disarm_all()
+    # the failed generation never half-landed; the durable one rules
+    assert led.generations() == [0]
+    assert led.latest()["generation"] == 0
+    led.append(generation=1, members=[0], assignment=a,
+               trigger="restart-all")
+    assert led.generations() == [0, 1]
+
+
+def test_delta_files_atomic_under_torn_write(tmp_path):
+    from pipegcn_tpu.graph import synthetic_graph
+    from pipegcn_tpu.graph.synthetic import synthetic_delta_schedule
+    from pipegcn_tpu.stream.deltas import load_deltas, save_deltas
+
+    g = synthetic_graph(num_nodes=80, avg_degree=4, n_feat=4, n_class=2,
+                        seed=0)
+    batches = synthetic_delta_schedule(g, n_batches=1, edges_per_batch=3,
+                                       dels_per_batch=1,
+                                       nodes_per_batch=0, seed=0)
+    for ext in ("jsonl", "npz"):
+        path = str(tmp_path / f"d.{ext}")
+        save_deltas(path, batches)
+        FAULTY_IO.arm("torn-write")
+        with pytest.raises(OSError):
+            save_deltas(path, batches)
+        FAULTY_IO.disarm_all()
+        # destination untouched by the torn overwrite: still loads
+        assert len(load_deltas(path)) == 1
+
+
+def test_tuning_sidecar_atomic_under_enospc(tmp_path):
+    from pipegcn_tpu.ops.tuner import TUNER_FORMAT, load_tuning, save_tuning
+
+    rec = {"tuner_format": TUNER_FORMAT, "winner": {"impl": "xla"},
+           "costs": {}}
+    save_tuning(str(tmp_path), rec)
+    before, reason = load_tuning(str(tmp_path))
+    assert reason is None
+    FAULTY_IO.arm("enospc")
+    with pytest.raises(OSError):
+        save_tuning(str(tmp_path), {"tuner_format": TUNER_FORMAT,
+                                    "winner": {"impl": "block"},
+                                    "costs": {}})
+    FAULTY_IO.disarm_all()
+    after, reason = load_tuning(str(tmp_path))
+    assert reason is None and after == before
+
+
+# ---------------- soak harness (resilience/soak.py) ------------------
+
+
+soak = pytest.mark.soak
+
+
+@soak
+def test_compose_schedule_deterministic_and_constrained():
+    from pipegcn_tpu.resilience.soak import (
+        SOFT_KINDS,
+        TERMINAL_KINDS,
+        SoakConfig,
+        compose_schedule,
+    )
+
+    cfg = SoakConfig(seed=3, episodes=1)
+    for ep in range(20):
+        sched, stream_epoch = compose_schedule(cfg, ep)
+        assert (sched, stream_epoch) == compose_schedule(cfg, ep)
+        last_term = 0
+        for entry in sched:
+            kind, rest = entry.split("@", 1)
+            epoch = int(rest.split(":", 1)[0])
+            assert kind in TERMINAL_KINDS + SOFT_KINDS
+            assert 0 < epoch < cfg.n_epochs
+            if kind in TERMINAL_KINDS or kind == "corrupt-ckpt":
+                # boundary-kind retirement on resume only stops a
+                # re-fire when the fault lands ON a checkpoint boundary
+                assert epoch % cfg.checkpoint_every == 0
+            if kind in TERMINAL_KINDS:
+                last_term = max(last_term, epoch)
+        # no delta replay on resume exists: the delta must apply after
+        # the last restart boundary
+        assert stream_epoch > last_term or last_term == 0
+        FaultPlan.parse(",".join(sched))  # every schedule parses
+    forced = SoakConfig(seed=3, force_faults=("enospc@4",))
+    assert compose_schedule(forced, 0)[0][0] == "enospc@4"
+
+
+@soak
+def test_soak_invariant_checkers(tmp_path):
+    from pipegcn_tpu.resilience.soak import (
+        check_checkpoint,
+        check_metrics,
+    )
+
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, _state(1.0), 4, keep=0)
+    save_checkpoint(ck, _state(2.0), 6, keep=0)
+    assert check_checkpoint(ck, want_epoch=6)["ok"]
+    assert not check_checkpoint(ck, want_epoch=8)["ok"]
+    # a corrupt newest generation walks back to the valid one
+    from pipegcn_tpu.resilience import corrupt_latest_checkpoint
+
+    corrupt_latest_checkpoint(ck)
+    r = check_checkpoint(ck, want_epoch=4)
+    assert r["ok"] and r["epoch"] == 4
+
+    def _ep(e):
+        return json.dumps({"event": "epoch", "epoch": e}) + "\n"
+
+    a = tmp_path / "metrics.g0.m0.jsonl"
+    a.write_text(_ep(0) + _ep(1) + '{"event": "epo')  # SIGKILL tail
+    b = tmp_path / "metrics-resume.jsonl"
+    b.write_text(_ep(2) + _ep(3))
+    r = check_metrics([str(a), str(b)], 4)
+    assert r["ok"] and r["torn_tails"] == 1
+    assert not check_metrics([str(a), str(b)], 5)["ok"]  # gap: epoch 4
+    c = tmp_path / "bad.jsonl"
+    c.write_text('NOT JSON\n' + _ep(0))  # torn NON-tail line is red
+    assert not check_metrics([str(c)], 1)["ok"]
+
+
+@soak
+@pytest.mark.slow
+def test_soak_episode_end_to_end(tmp_path):
+    """One full subprocess episode: the seeded enospc schedule must
+    come back green with every invariant checked for real."""
+    from pipegcn_tpu.resilience.soak import SoakConfig, run_episode
+
+    cfg = SoakConfig(seed=0, episodes=1,
+                     out_dir=str(tmp_path / "soak"),
+                     episode_timeout_s=480.0)
+    rec = run_episode(cfg, 1)  # seed-0 episode 1 = enospc@1
+    assert rec["verdict"] == "green", rec
+    assert any(e.startswith("enospc@") for e in rec["schedule"])
+    assert rec["invariants"]["checkpoint"]["epoch"] == cfg.n_epochs

@@ -234,6 +234,31 @@ def test_multiprocess_never_live_tunes(tmp_path, monkeypatch):
     assert t.tuning["costs"] == []
 
 
+def test_truncated_sidecar_degrades_to_live_retune(tmp_path):
+    """Satellite torn-artifact check: a tuning.json cut off mid-record
+    (torn write that landed, disk rot) must come back as
+    (None, reason) from load_tuning — never an exception — and the
+    trainer re-tunes live exactly as for the unparseable case."""
+    sg = _sharded(seed=23)
+    path = str(tmp_path / "art")
+    sg.save(path)
+    sg0 = ShardedGraph.load(path)
+    Trainer(sg0, _cfg(sg0), TrainConfig(seed=0))  # live tune persists
+    rec, why = tuner.load_tuning(path)
+    assert why is None
+    full = open(tuner.tuning_path(path)).read()
+    with open(tuner.tuning_path(path), "w") as f:
+        f.write(full[:len(full) // 2])
+    got, reason = tuner.load_tuning(path)
+    assert got is None and "corrupt" in reason
+    sg1 = ShardedGraph.load(path)
+    t1 = Trainer(sg1, _cfg(sg1), TrainConfig(seed=0))
+    assert t1.tuning["source"] == "live"
+    # and the live result heals the sidecar on disk
+    rec2, why2 = tuner.load_tuning(path)
+    assert why2 is None and rec2["winner"] == t1.tuning["winner"]
+
+
 def test_tuning_record_schema_contract():
     """The trainer-emitted tuning dict must satisfy the contracted
     obs record kind (tests/test_obs.py pins the v4 field list)."""
