@@ -206,6 +206,7 @@ def _driver_main(args, argv) -> int:
             seed=args.seed,
             ml=ml,
             fault_plan=fault_plan,
+            trace_sample_rate=args.trace_sample_rate,
             stop=lambda: stop_flag["stop"],
         )
     finally:

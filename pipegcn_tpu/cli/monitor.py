@@ -1,0 +1,159 @@
+"""Live monitoring CLI (docs/OBSERVABILITY.md "Live monitoring").
+
+    python -m pipegcn_tpu.cli.monitor <run-dir|stem|file> \
+        [--serve-http PORT] [--follow] [--alert-rules rules.json] \
+        [--alerts-out alerts.jsonl] [--poll-s 1.0] [--duration-s N]
+
+Tail-follows every metrics JSONL stream the target names (per-
+generation elastic files, the supervisor ledger, replica streams,
+window.jsonl — discovered live as they appear, obs/live.py), evaluates
+the SLO alert rules each tick (edge-triggered `alert` records into
+--alerts-out, obs/health.py), and optionally serves /metrics
+(Prometheus text) + /health (JSON) on --serve-http.
+
+--follow prints a one-line snapshot per tick; --once does a single
+poll + evaluate, prints the /health JSON, and exits (the scriptable
+drill mode). Exit code: 0, or 2 with --once when a page-severity
+alert is firing (so shell drills can assert on health)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional, Sequence
+
+from ..obs.health import (AlertEngine, MonitorServer, health_json,
+                          load_rules)
+from ..obs.live import LiveAggregator
+from ..obs.metrics import MetricsLogger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m pipegcn_tpu.cli.monitor",
+        description="Live telemetry monitor: tail-follow a run's "
+                    "metrics streams, evaluate SLO alerts, export "
+                    "/metrics + /health")
+    p.add_argument("target",
+                   help="run directory, metrics stem, or JSONL file")
+    p.add_argument("--serve-http", type=int, default=None,
+                   metavar="PORT",
+                   help="serve /metrics (Prometheus text) and /health "
+                        "(JSON) on this port (0 = ephemeral; the "
+                        "bound port is printed)")
+    p.add_argument("--follow", action="store_true",
+                   help="print a one-line snapshot every poll tick")
+    p.add_argument("--once", action="store_true",
+                   help="single poll + alert evaluation, print the "
+                        "/health JSON, exit (rc 2 if a page-severity "
+                        "alert is firing)")
+    p.add_argument("--poll-s", type=float, default=1.0,
+                   help="tail-follow / alert evaluation cadence")
+    p.add_argument("--duration-s", type=float, default=0.0,
+                   help="stop after this long (0 = run until "
+                        "interrupted)")
+    p.add_argument("--alert-rules", default=None, metavar="RULES.JSON",
+                   help="JSON list of alert rule entries "
+                        "({'rule': id, ...overrides}); default: the "
+                        "built-in rule set (obs/health.RULE_DEFAULTS)")
+    p.add_argument("--alerts-out", default=None, metavar="PATH",
+                   help="JSONL sink for the contracted alert records "
+                        "(default: <target-dir>/alerts.jsonl; '-' "
+                        "disables the sink, alerts still print)")
+    return p
+
+
+def _alerts_path(target: str, flag: Optional[str]) -> Optional[str]:
+    if flag == "-":
+        return None
+    if flag:
+        return flag
+    d = target if os.path.isdir(target) else (os.path.dirname(
+        os.path.abspath(target)) or ".")
+    return os.path.join(d, "alerts.jsonl")
+
+
+def _follow_line(agg: LiveAggregator, engine: AlertEngine) -> str:
+    snap = agg.snapshot()
+    bits = [f"streams={snap['n_streams']}",
+            f"records={snap['n_records']}"]
+    train = snap.get("train") or {}
+    if train:
+        src, t = sorted(train.items())[-1]
+        bits.append(f"epoch={t.get('epoch')} "
+                    f"loss={t.get('loss'):.4f}"
+                    if isinstance(t.get("loss"), float)
+                    else f"epoch={t.get('epoch')}")
+    serving = snap.get("serving") or {}
+    if serving:
+        agg_qps = sum(v.get("qps") or 0.0 for v in serving.values())
+        bits.append(f"qps={agg_qps:.1f}")
+    if snap["fault_counts"]:
+        bits.append("faults=" + ",".join(
+            f"{k}:{v}" for k, v in sorted(snap["fault_counts"].items())))
+    firing = engine.firing()
+    bits.append(f"alerts={len(firing)}"
+                + ("" if not firing else
+                   " [" + " ".join(f"{a['rule']}@{a['source']}"
+                                   for a in firing) + "]"))
+    return "monitor: " + " ".join(bits)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    rules = load_rules(args.alert_rules)
+    alerts_path = _alerts_path(args.target, args.alerts_out)
+    ml = MetricsLogger(alerts_path) if alerts_path else None
+    agg = LiveAggregator(args.target)
+    engine = AlertEngine(rules, ml=ml)
+    lock = threading.Lock()
+
+    server = None
+    if args.serve_http is not None:
+        server = MonitorServer(
+            agg, engine,
+            sink_stats=(ml.stats if ml is not None else None),
+            port=args.serve_http, lock=lock).start()
+        print(f"monitor: serving /metrics and /health on "
+              f"http://127.0.0.1:{server.port}")
+
+    rc = 0
+    t_end = (time.monotonic() + args.duration_s
+             if args.duration_s > 0 else float("inf"))
+    try:
+        while True:
+            with lock:
+                agg.poll()
+                edges = engine.evaluate(agg)
+            for e in edges:
+                print(f"monitor: ALERT {e['state'].upper()} "
+                      f"{e['rule']} source={e['source']}: "
+                      f"{e['message']}")
+            if args.follow:
+                print(_follow_line(agg, engine))
+            if args.once:
+                print(json.dumps(health_json(
+                    agg, engine, ml.stats() if ml else None), indent=2))
+                rc = 2 if health_json(agg, engine)["status"] \
+                    == "critical" else 0
+                break
+            if time.monotonic() >= t_end:
+                break
+            time.sleep(args.poll_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if server is not None:
+            server.stop()
+        if ml is not None:
+            ml.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

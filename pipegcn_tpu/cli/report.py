@@ -587,12 +587,38 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _load_target(path: str):
+    """One CLI target's records: a plain JSONL file keeps the strict
+    single-file contract (read_metrics raises on malformed lines); a
+    run directory, a metrics stem, or a base file WITH per-generation
+    siblings goes through the aggregator's tolerant deduped
+    generation-ordered merge (obs/live.py). Returns (records,
+    n_streams)."""
+    import os
+
+    from ..obs.live import discover_streams, merge_streams
+
+    streams = discover_streams(path)
+    if streams == [path] and os.path.isfile(path):
+        return read_metrics(path), 1
+    if not streams:
+        raise OSError(f"no metrics streams found under {path!r}")
+    return merge_streams(streams), len(streams)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m pipegcn_tpu.cli.report",
         description="Summarize metrics JSONL files written with "
                     "--metrics-out (schema: pipegcn_tpu/obs/schema.py)")
-    ap.add_argument("files", nargs="+", help="metrics JSONL file(s)")
+    ap.add_argument("files", nargs="+",
+                    help="metrics JSONL file(s), run directories, or "
+                         "metrics stems: a directory or stem expands "
+                         "to every stream under it ({stem}.g*.m*.jsonl "
+                         "per-generation files, the supervisor ledger, "
+                         "replica streams) merged generation-ordered "
+                         "and deduped — the live monitor's discovery "
+                         "(obs/live.py), applied post-hoc")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON summary object per file")
     args = ap.parse_args(argv)
@@ -600,8 +626,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     rc = 0
     for path in args.files:
         try:
-            recs = read_metrics(path)
+            recs, n_streams = _load_target(path)
             s = summarize_run(recs)
+            if n_streams > 1:
+                s["n_streams_merged"] = n_streams
         except (OSError, ValueError) as exc:
             print(f"{path}: {exc}", file=sys.stderr)
             rc = 1

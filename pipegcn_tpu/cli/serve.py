@@ -69,6 +69,13 @@ def build_parser():
                    "--serve_ticket_deadline_ms", type=float, default=0.0,
                    help="shed tickets that waited past this deadline "
                         "at flush time. 0 = no deadline")
+    g.add_argument("--trace-sample-rate", "--trace_sample_rate",
+                   type=float, default=0.0,
+                   help="fraction of submitted queries that mint a "
+                        "trace id and land per-hop `span` records "
+                        "(queue/dispatch, rpc, replica, engine) in "
+                        "the metrics stream; cli.timeline renders "
+                        "them as Perfetto flows. 0 = tracing off")
     return p
 
 
@@ -237,6 +244,7 @@ def main(argv=None) -> int:
             ml=ml,
             max_queue=args.serve_max_queue or None,
             ticket_deadline_ms=args.serve_ticket_deadline_ms or None,
+            trace_sample_rate=args.trace_sample_rate,
             stop=lambda: stop_flag["stop"],
         )
     finally:

@@ -8,9 +8,12 @@ schema obs/schema.py) into a single Chrome-trace file loadable in
 Perfetto (ui.perfetto.dev) or chrome://tracing: ranks as processes,
 epochs as slices aligned at dispatch boundaries, faults/recoveries as
 instant events, loss and staleness drift as counters, profile-window
-phase decompositions as sub-slices (docs/OBSERVABILITY.md
-"Timelines"). Rank ids come from --ranks, else from each stream's own
-rank-tagged records, else from file order.
+phase decompositions as sub-slices, serving windows as counter
+tracks, fleet/membership/stream/soak/alert records as instants, and
+sampled serving spans (--trace-sample-rate) as slices stitched into
+per-query Perfetto flows (docs/OBSERVABILITY.md "Timelines"). Rank
+ids come from --ranks, else from each stream's own rank-tagged
+records, else from file order.
 """
 
 from __future__ import annotations

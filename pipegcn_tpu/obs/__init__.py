@@ -24,6 +24,13 @@ Three pillars (docs/OBSERVABILITY.md):
               from the optimized HLO + the on-chip ablation clock
   timeline.py cross-rank Perfetto/Chrome-trace timelines from merged
               metrics JSONL streams (cli/timeline.py is the CLI)
+  live.py     live telemetry plane: tail-following stream discovery
+              + the rolling LiveAggregator behind cli/monitor.py
+  health.py   SLO alert rules, the Prometheus /metrics + /health
+              exporters, and the MonitorServer HTTP endpoint
+  trend.py    bench trend tracking over BENCH_r*.json /
+              MULTICHIP_*.json with best-known-headline regression
+              flags (scripts/bench_trend.py is the CLI)
 
 The reporting CLI lives in cli/report.py (`python -m
 pipegcn_tpu.cli.report metrics.jsonl`); the timeline CLI in
@@ -35,6 +42,12 @@ machine-readable record every perf claim reports through.
 """
 
 from .format import epoch_line, reference_eval_line, reference_train_line
+from .live import (
+    LiveAggregator,
+    discover_streams,
+    merge_streams,
+    read_stream,
+)
 from .metrics import (
     MetricsLogger,
     device_info,
@@ -43,6 +56,7 @@ from .metrics import (
     read_metrics,
 )
 from .schema import (
+    ALERT_FIELDS,
     ANATOMY_FIELDS,
     EPOCH_FIELDS,
     EVAL_FIELDS,
@@ -51,6 +65,7 @@ from .schema import (
     RECOVERY_FIELDS,
     RUN_FIELDS,
     SCHEMA_VERSION,
+    SPAN_FIELDS,
     STALENESS_FIELDS,
     SUMMARY_FIELDS,
     validate_record,
@@ -68,7 +83,13 @@ __all__ = [
     "PROFILE_FIELDS",
     "ANATOMY_FIELDS",
     "STALENESS_FIELDS",
+    "ALERT_FIELDS",
+    "SPAN_FIELDS",
     "validate_record",
+    "LiveAggregator",
+    "discover_streams",
+    "merge_streams",
+    "read_stream",
     "MetricsLogger",
     "read_metrics",
     "device_info",

@@ -1,0 +1,462 @@
+"""Health rollup, SLO alert rules, and the /metrics exporter
+(docs/OBSERVABILITY.md "Live monitoring").
+
+Consumes a :class:`~.live.LiveAggregator`'s rolling state three ways:
+
+  AlertEngine       declarative SLO rules (``--alert-rules`` JSON or
+                    the built-in defaults), evaluated each monitor
+                    tick. Edge-triggered and deduped: a rule instance
+                    (rule, source) writes exactly one contracted
+                    ``alert`` record per fire edge and one per resolve
+                    edge, no matter how many ticks it stays red.
+  prometheus_text   the /metrics payload — Prometheus text exposition
+                    rendered straight from aggregator state, stdlib
+                    only.
+  MonitorServer     ``--serve-http``: a ThreadingHTTPServer with
+                    /metrics (Prometheus text) and /health (JSON
+                    rollup). Binds port 0 for an ephemeral port in
+                    tests.
+
+The engine's clock is injectable (fake-clock alert tests); rule
+evaluation never raises on missing data — a rule without its inputs
+simply does not fire.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .live import LiveAggregator
+
+# rule id -> parameter defaults; a rules file entry must name one of
+# these and may override any default (plus "severity")
+RULE_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    # latest epoch step time vs the rolling median of the window
+    # before it: fires when latest > factor * median
+    "epoch-time-regression": {"factor": 1.5, "min_points": 5,
+                              "window": 16, "severity": "warn"},
+    # shed rows / (served + shed) in the latest serving window
+    "shed-rate": {"threshold": 0.1, "severity": "warn"},
+    # staleness_age of the latest epoch or serving record
+    "staleness-age": {"threshold": 8, "severity": "warn"},
+    # >= threshold fault records (optionally of one kind) within the
+    # trailing horizon; resolves once the horizon passes quietly
+    "fault-rate": {"threshold": 1, "horizon_s": 60.0, "kind": None,
+                   "severity": "page"},
+    # a known source produced nothing for horizon_s (covers the
+    # missing-heartbeat case: heartbeat records stop arriving)
+    "silent-source": {"horizon_s": 30.0, "severity": "page"},
+}
+
+DEFAULT_RULES: List[Dict[str, Any]] = [
+    {"rule": "epoch-time-regression"},
+    {"rule": "shed-rate"},
+    {"rule": "staleness-age"},
+    {"rule": "fault-rate"},
+    {"rule": "silent-source"},
+]
+
+
+def load_rules(path: Optional[str]) -> List[Dict[str, Any]]:
+    """Rules from a JSON file (a list of ``{"rule": id, ...overrides}``
+    entries), or the defaults. Unknown rule ids and parameters fail
+    loudly — a typo'd rules file must not silently monitor nothing."""
+    if path is None:
+        entries = [dict(e) for e in DEFAULT_RULES]
+    else:
+        with open(path, encoding="utf-8") as f:
+            entries = json.load(f)
+        if not isinstance(entries, list):
+            raise ValueError(f"{path}: expected a JSON list of rules")
+    out = []
+    for e in entries:
+        rid = e.get("rule")
+        if rid not in RULE_DEFAULTS:
+            raise ValueError(
+                f"unknown alert rule {rid!r} (known: "
+                f"{sorted(RULE_DEFAULTS)})")
+        cfg = dict(RULE_DEFAULTS[rid])
+        for k, v in e.items():
+            if k != "rule" and k not in cfg:
+                raise ValueError(f"rule {rid!r}: unknown parameter {k!r}")
+            cfg[k] = v
+        cfg["rule"] = rid
+        out.append(cfg)
+    return out
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class AlertEngine:
+    """Edge-triggered, deduped SLO evaluation over aggregator state.
+
+    ``evaluate(agg)`` computes every rule instance's predicate and
+    emits one ``alert`` record per EDGE: rising -> state "fire",
+    falling -> state "resolve" (through `ml.alert`, hard-flushed, when
+    a sink is given; always appended to `self.events`). A rule that
+    stays red across N ticks emits nothing after its fire edge — the
+    dedup the schema promises."""
+
+    def __init__(self, rules: Optional[List[Dict[str, Any]]] = None,
+                 ml=None, clock: Callable[[], float] = time.time):
+        self.rules = rules if rules is not None else load_rules(None)
+        self.ml = ml
+        self._clock = clock
+        # (rule, source) -> the fire observation (value/threshold)
+        self._firing: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # fault-rate bookkeeping: (rule idx) -> deque of (t, n_new)
+        self._fault_hist: Dict[int, collections.deque] = {}
+        self._fault_seen: Dict[int, int] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.n_fired = 0
+        self.n_resolved = 0
+
+    # ---------------- predicates --------------------------------------
+
+    def _observations(self, idx: int, cfg: Dict[str, Any],
+                      agg: LiveAggregator):
+        """Yield (source, red?, value, threshold, message) for every
+        live instance of one rule."""
+        rid = cfg["rule"]
+        if rid == "epoch-time-regression":
+            for src, hist in agg.epoch_times.items():
+                if len(hist) < max(int(cfg["min_points"]), 2):
+                    continue
+                base = hist[-int(cfg["window"]) - 1:-1]
+                med = _median(base)
+                thr = float(cfg["factor"]) * med
+                latest = hist[-1]
+                yield (src, med > 0 and latest > thr, latest, thr,
+                       f"epoch time {latest:.3f}s vs rolling median "
+                       f"{med:.3f}s")
+        elif rid == "shed-rate":
+            for src, rec in agg.latest("serving").items():
+                served = rec.get("queries") or 0
+                shed = rec.get("shed") or 0
+                total = served + shed
+                if total <= 0:
+                    yield (src, False, None, float(cfg["threshold"]),
+                           "no traffic")
+                    continue
+                rate = shed / total
+                yield (src, rate > float(cfg["threshold"]), rate,
+                       float(cfg["threshold"]),
+                       f"shed {shed}/{total} rows this window")
+        elif rid == "staleness-age":
+            latest = dict(agg.latest("epoch"))
+            latest.update(agg.latest("serving"))
+            for src, rec in latest.items():
+                age = rec.get("staleness_age")
+                if not isinstance(age, int):
+                    continue
+                yield (src, age > int(cfg["threshold"]), float(age),
+                       float(cfg["threshold"]),
+                       f"staleness age {age}")
+        elif rid == "fault-rate":
+            kind = cfg.get("kind")
+            total = (agg.fault_counts.get(kind, 0) if kind
+                     else sum(agg.fault_counts.values()))
+            hist = self._fault_hist.setdefault(
+                idx, collections.deque())
+            seen = self._fault_seen.get(idx, 0)
+            now = self._clock()
+            if total > seen:
+                hist.append((now, total - seen))
+            self._fault_seen[idx] = total
+            horizon = float(cfg["horizon_s"])
+            while hist and now - hist[0][0] > horizon:
+                hist.popleft()
+            recent = sum(n for _, n in hist)
+            yield ("*", recent >= int(cfg["threshold"]), float(recent),
+                   float(cfg["threshold"]),
+                   f"{recent} fault(s) in the last {horizon:.0f}s"
+                   + (f" (kind {kind})" if kind else ""))
+        elif rid == "silent-source":
+            horizon = float(cfg["horizon_s"])
+            for src in agg.sources():
+                age = agg.silent_for(src)
+                yield (src, age > horizon, age, horizon,
+                       f"no records for {age:.1f}s")
+
+    # ---------------- edges -------------------------------------------
+
+    def _emit(self, rid: str, state: str, severity: str, source: str,
+              value, threshold, message: str) -> Dict[str, Any]:
+        rec = {"event": "alert", "rule": rid, "state": state,
+               "severity": severity, "source": source,
+               "value": None if value is None else float(value),
+               "threshold": (None if threshold is None
+                             else float(threshold)),
+               "message": message, "time_unix": self._clock()}
+        if self.ml is not None:
+            self.ml.alert(rid, state, severity, source, value,
+                          threshold, message, time_unix=rec["time_unix"])
+        self.events.append(rec)
+        return rec
+
+    def evaluate(self, agg: LiveAggregator) -> List[Dict[str, Any]]:
+        """One tick: returns the alert records EMITTED this tick (the
+        edges only — an empty list on a steady-state tick)."""
+        emitted = []
+        for idx, cfg in enumerate(self.rules):
+            rid = cfg["rule"]
+            severity = str(cfg.get("severity", "warn"))
+            for src, red, value, thr, msg in self._observations(
+                    idx, cfg, agg):
+                key = (f"{rid}#{idx}", src)
+                was = key in self._firing
+                if red and not was:
+                    self._firing[key] = {"value": value,
+                                         "threshold": thr}
+                    self.n_fired += 1
+                    emitted.append(self._emit(
+                        rid, "fire", severity, src, value, thr, msg))
+                elif not red and was:
+                    del self._firing[key]
+                    self.n_resolved += 1
+                    emitted.append(self._emit(
+                        rid, "resolve", severity, src, value, thr,
+                        f"resolved: {msg}"))
+        return emitted
+
+    def firing(self) -> List[Dict[str, str]]:
+        """Currently-red instances, for /health and /metrics."""
+        return [{"rule": rk.split("#", 1)[0], "source": src}
+                for (rk, src) in sorted(self._firing)]
+
+
+# ---------------------------------------------------------------------------
+# /metrics + /health rendering
+# ---------------------------------------------------------------------------
+
+
+def _esc(v: Any) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _num(v: Any) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def prometheus_text(agg: LiveAggregator,
+                    engine: Optional[AlertEngine] = None,
+                    sink_stats: Optional[Dict[str, Any]] = None) -> str:
+    """The /metrics payload: aggregator state as Prometheus text
+    exposition (stdlib string building; no client library)."""
+    lines: List[str] = []
+
+    def gauge(name: str, value, labels: Optional[Dict] = None,
+              mtype: str = "gauge"):
+        v = _num(value)
+        if v is None:
+            return
+        if not any(line.startswith(f"# TYPE {name} ") for line in lines):
+            lines.append(f"# TYPE {name} {mtype}")
+        lab = ""
+        if labels:
+            lab = "{" + ",".join(
+                f'{k}="{_esc(x)}"' for k, x in sorted(labels.items())) \
+                + "}"
+        if v == int(v) and abs(v) < 1e15:
+            lines.append(f"{name}{lab} {int(v)}")
+        else:
+            lines.append(f"{name}{lab} {v}")
+
+    gauge("pipegcn_up", 1)
+    gauge("pipegcn_schema_version", agg.schema_version)
+    gauge("pipegcn_streams", len(agg.readers))
+    gauge("pipegcn_records_total", agg.n_records, mtype="counter")
+    gauge("pipegcn_invalid_records_total", agg.n_invalid,
+          mtype="counter")
+    gauge("pipegcn_malformed_lines_total",
+          sum(r.n_malformed for r in agg.readers.values()),
+          mtype="counter")
+    for src in agg.sources():
+        gauge("pipegcn_source_last_seen_age_seconds",
+              agg.silent_for(src), {"source": src})
+    for src, rec in sorted(agg.latest("epoch").items()):
+        lab = {"source": src}
+        gauge("pipegcn_epoch", rec.get("epoch"), lab)
+        gauge("pipegcn_epoch_time_seconds", rec.get("step_time_s"), lab)
+        gauge("pipegcn_loss", rec.get("loss"), lab)
+        gauge("pipegcn_grad_norm", rec.get("grad_norm"), lab)
+        gauge("pipegcn_halo_bytes", rec.get("halo_bytes"), lab)
+        unc = _num(rec.get("halo_bytes_uncompressed"))
+        hb = _num(rec.get("halo_bytes"))
+        if unc and hb:
+            gauge("pipegcn_halo_compression_ratio", unc / hb, lab)
+        gauge("pipegcn_staleness_age", rec.get("staleness_age"), lab)
+    for src, rec in sorted(agg.latest("serving").items()):
+        lab = {"source": src}
+        gauge("pipegcn_serving_qps", rec.get("qps"), lab)
+        gauge("pipegcn_serving_p50_ms", rec.get("p50_ms"), lab)
+        gauge("pipegcn_serving_p95_ms", rec.get("p95_ms"), lab)
+        gauge("pipegcn_serving_p99_ms", rec.get("p99_ms"), lab)
+        gauge("pipegcn_serving_queue_depth", rec.get("queue_depth"), lab)
+        gauge("pipegcn_serving_shed", rec.get("shed"), lab)
+        gauge("pipegcn_serving_staleness_age",
+              rec.get("staleness_age"), lab)
+        gauge("pipegcn_param_generation",
+              rec.get("param_generation"), lab)
+        gauge("pipegcn_param_staleness", rec.get("param_staleness"), lab)
+        gauge("pipegcn_topo_generation", rec.get("topo_generation"), lab)
+    for reason, rows in sorted(agg.shed_by_reason.items()):
+        gauge("pipegcn_serving_shed_rows_total", rows,
+              {"reason": reason}, mtype="counter")
+    for kind, n in sorted(agg.fault_counts.items()):
+        gauge("pipegcn_faults_total", n, {"kind": kind},
+              mtype="counter")
+    for kind, n in sorted(agg.recovery_counts.items()):
+        gauge("pipegcn_recoveries_total", n, {"kind": kind},
+              mtype="counter")
+    gauge("pipegcn_io_degraded",
+          int(agg.fault_counts.get("io-degraded", 0)
+              > agg.recovery_counts.get("io-degraded", 0)))
+    for src, rec in sorted(agg.latest("membership").items()):
+        gauge("pipegcn_membership_generation", rec.get("generation"),
+              {"source": src})
+    for src, rec in sorted(agg.latest("stream").items()):
+        gauge("pipegcn_stream_seq", rec.get("seq"), {"source": src})
+    for (src, kind), n in sorted(agg.counts.items()):
+        if kind == "span":
+            gauge("pipegcn_spans_total", n, {"source": src},
+                  mtype="counter")
+    if engine is not None:
+        for inst in engine.firing():
+            gauge("pipegcn_alert_firing", 1, inst)
+        gauge("pipegcn_alerts_fired_total", engine.n_fired,
+              mtype="counter")
+        gauge("pipegcn_alerts_resolved_total", engine.n_resolved,
+              mtype="counter")
+    if sink_stats:
+        # the monitor's OWN MetricsLogger (alerts sink) health: the
+        # PR-14 io-degraded ring made visible (MetricsLogger.stats())
+        gauge("pipegcn_monitor_sink_records", sink_stats.get("records"))
+        gauge("pipegcn_monitor_sink_ring_depth",
+              sink_stats.get("ring_depth"))
+        gauge("pipegcn_monitor_sink_dropped", sink_stats.get("dropped"))
+        gauge("pipegcn_monitor_sink_degraded",
+              int(bool(sink_stats.get("degraded"))))
+    return "\n".join(lines) + "\n"
+
+
+def health_json(agg: LiveAggregator,
+                engine: Optional[AlertEngine] = None,
+                sink_stats: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+    """The /health rollup: overall status + the aggregator snapshot.
+    status: "ok" (nothing firing) | "degraded" (warn/info alerts
+    firing) | "critical" (a page-severity alert is firing)."""
+    snap = agg.snapshot()
+    status = "ok"
+    firing: List[Dict[str, str]] = []
+    if engine is not None:
+        firing = engine.firing()
+        sevs = set()
+        for key in engine._firing:
+            rid = key[0].split("#", 1)[0]
+            for cfg in engine.rules:
+                if cfg["rule"] == rid:
+                    sevs.add(str(cfg.get("severity", "warn")))
+        if "page" in sevs:
+            status = "critical"
+        elif sevs:
+            status = "degraded"
+    out = {"status": status, "alerts_firing": firing, **snap}
+    if engine is not None:
+        out["alerts_fired"] = engine.n_fired
+        out["alerts_resolved"] = engine.n_resolved
+    if sink_stats:
+        out["monitor_sink"] = dict(sink_stats)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the exporter
+# ---------------------------------------------------------------------------
+
+
+class MonitorServer:
+    """`--serve-http`: /metrics + /health over stdlib http.server.
+
+    Handlers read aggregator state under `lock` (the monitor loop
+    polls under the same lock), so a scrape never sees a half-folded
+    record batch. Port 0 binds an ephemeral port (tests read
+    `self.port`)."""
+
+    def __init__(self, agg: LiveAggregator,
+                 engine: Optional[AlertEngine] = None,
+                 sink_stats: Optional[Callable[[], Dict]] = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 lock: Optional[threading.Lock] = None):
+        self.agg = agg
+        self.engine = engine
+        self.sink_stats = sink_stats
+        self.lock = lock or threading.Lock()
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    if self.path.split("?", 1)[0] == "/metrics":
+                        with outer.lock:
+                            body = prometheus_text(
+                                outer.agg, outer.engine,
+                                outer.sink_stats()
+                                if outer.sink_stats else None)
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path.split("?", 1)[0] == "/health":
+                        with outer.lock:
+                            body = json.dumps(health_json(
+                                outer.agg, outer.engine,
+                                outer.sink_stats()
+                                if outer.sink_stats else None),
+                                indent=2) + "\n"
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404, "try /metrics or /health")
+                        return
+                except BrokenPipeError:
+                    return
+                data = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # quiet: scrapes are chatty
+                pass
+
+        self.httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.httpd.daemon_threads = True
+        self.port = int(self.httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MonitorServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            daemon=True, name="pipegcn-monitor-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,356 @@
+"""Live telemetry aggregation (docs/OBSERVABILITY.md "Live
+monitoring").
+
+Everything PRs 1–14 built writes JSONL streams that were only readable
+post-hoc: training metrics, elastic per-(generation, member) files
+(``{stem}.g<G>.m<M>.jsonl``), the supervisor's membership ledger,
+per-replica fleet streams, the soak harness, the queued TPU window.
+This module watches them ALL while they are still being written:
+
+  discover_streams(target)   run directory / stem / file -> the
+                             generation-ordered stream list, re-globbed
+                             on every poll so files appearing mid-run
+                             (a new generation, a relaunched replica
+                             incarnation) join the tail set live
+  TailReader                 one stream's incremental reader: consumes
+                             only newline-terminated lines, so a torn
+                             final line (a writer killed mid-write —
+                             the PR-14 tolerance) is simply not yet
+                             visible; truncation rewinds
+  LiveAggregator             folds every stream's records into rolling
+                             in-memory state keyed by (source, kind) —
+                             the thing /metrics, /health, the alert
+                             engine (obs/health.py) and --follow read
+  merge_streams(paths)       one-shot deduped generation-ordered merge
+                             of finished streams — shared with the
+                             report CLI's run-directory mode
+
+Host-side and jax-free, like the MetricsLogger it watches.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .schema import validate_record
+
+# elastic per-(generation, member) metrics files (resilience/elastic.
+# _member_metrics_path): {stem}.g<G>.m<M>.jsonl
+_GEN_RE = re.compile(r"\.g(\d+)\.m(\d+)\.jsonl$")
+# fleet replica streams (cli/fleet._replica_main):
+# replica-m<rid>-i<incarnation>-metrics.jsonl
+_REPLICA_RE = re.compile(r"replica-m(\d+)-i(\d+)-metrics\.jsonl$")
+
+
+def stream_sort_key(path: str) -> Tuple[int, int, str]:
+    """Generation-ordered: whole-run streams (no .g<G>.m<M> suffix)
+    first, then per-generation files by (generation, member); replica
+    streams order by (incarnation, replica). Name breaks ties so the
+    merge is deterministic."""
+    base = os.path.basename(path)
+    m = _GEN_RE.search(base)
+    if m:
+        return (int(m.group(1)), int(m.group(2)), base)
+    m = _REPLICA_RE.search(base)
+    if m:
+        return (int(m.group(2)), int(m.group(1)), base)
+    return (-1, -1, base)
+
+
+def source_name(path: str, root: Optional[str] = None) -> str:
+    """Short stable stream key for state/labels: the path relative to
+    the watched root (or the basename), without the .jsonl suffix."""
+    if root and os.path.isdir(root):
+        try:
+            rel = os.path.relpath(path, root)
+        except ValueError:
+            rel = os.path.basename(path)
+        if not rel.startswith(".."):
+            path = rel
+        else:
+            path = os.path.basename(path)
+    else:
+        path = os.path.basename(path)
+    return path[:-6] if path.endswith(".jsonl") else path
+
+
+def discover_streams(target: str) -> List[str]:
+    """Every metrics JSONL stream a target names, generation-ordered.
+
+    `target` may be a run DIRECTORY (all ``*.jsonl`` under it,
+    recursively — per-generation files, membership ledger, replica
+    streams, window.jsonl as they appear), a single FILE, or a metrics
+    STEM (``foo`` or ``foo.jsonl`` matching ``foo.jsonl`` +
+    ``foo.g*.m*.jsonl`` + a membership ledger beside it)."""
+    target = os.fspath(target)
+    if os.path.isdir(target):
+        paths = glob.glob(os.path.join(target, "**", "*.jsonl"),
+                          recursive=True)
+    elif os.path.isfile(target) and not _stem_siblings(target):
+        paths = [target]
+    else:
+        stem = target[:-6] if target.endswith(".jsonl") else target
+        paths = []
+        if os.path.isfile(stem + ".jsonl"):
+            paths.append(stem + ".jsonl")
+        paths += _stem_siblings(stem + ".jsonl")
+        if paths:
+            # the elastic supervisor's ledger lives in its coord dir
+            # next to the run: pick up membership.jsonl one level
+            # around the stem (only for stems that matched something —
+            # a typo'd path must not adopt an unrelated ledger)
+            d = os.path.dirname(os.path.abspath(stem)) or "."
+            paths += glob.glob(os.path.join(d, "membership.jsonl"))
+            paths += glob.glob(os.path.join(d, "*", "membership.jsonl"))
+    return sorted(set(paths), key=stream_sort_key)
+
+
+def _stem_siblings(path: str) -> List[str]:
+    """Per-generation files belonging to a base metrics path."""
+    if not path.endswith(".jsonl"):
+        return []
+    return glob.glob(glob.escape(path[:-6]) + ".g*.m*.jsonl")
+
+
+class TailReader:
+    """Incremental reader of one JSONL stream.
+
+    Only newline-terminated lines are consumed: a torn final line (the
+    writer died mid-write, or we raced its flush) stays unread until
+    its newline lands — the live-follow version of the PR-14 torn-line
+    tolerance. A malformed line that IS newline-terminated is counted
+    (`n_malformed`) and skipped, never fatal. A shrink of the file
+    (rotation/truncation) rewinds to offset 0."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.n_records = 0
+        self.n_malformed = 0
+
+    def poll(self, final: bool = False) -> List[Dict[str, Any]]:
+        """New complete records since the last poll. With
+        ``final=True`` (one-shot reads of finished files) a parseable
+        unterminated tail is included too."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.offset:  # truncated/rotated underneath us
+            self.offset = 0
+        if size == self.offset:
+            return []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                buf = f.read(size - self.offset)
+        except OSError:
+            return []
+        end = buf.rfind(b"\n")
+        if end < 0:
+            if not final:
+                return []  # only a torn tail so far
+            chunk = buf
+            self.offset += len(buf)
+        else:
+            chunk = buf if final else buf[:end + 1]
+            self.offset += len(buf) if final else end + 1
+        recs = []
+        for raw in chunk.split(b"\n"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                recs.append(json.loads(raw.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                self.n_malformed += 1
+        self.n_records += len(recs)
+        return recs
+
+
+def read_stream(path: str) -> List[Dict[str, Any]]:
+    """Tolerant one-shot read: complete lines plus a parseable tail;
+    malformed lines are skipped (contrast read_metrics, which raises —
+    the strict contract for single finished files)."""
+    return TailReader(path).poll(final=True)
+
+
+def merge_streams(paths) -> List[Dict[str, Any]]:
+    """Deduped, generation-ordered merge of whole streams (the report
+    CLI's run-directory/stem mode shares this with the aggregator).
+    Order: streams by :func:`stream_sort_key`, records in file order
+    within each. Dedup is by exact record content — the same record
+    reachable through two discovered paths (symlinked dirs, a ledger
+    copied into the run dir) folds to one."""
+    out: List[Dict[str, Any]] = []
+    seen = set()
+    for p in sorted(set(os.fspath(p) for p in paths),
+                    key=stream_sort_key):
+        for rec in read_stream(p):
+            key = json.dumps(rec, sort_keys=True)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(rec)
+    return out
+
+
+class LiveAggregator:
+    """Rolling state over every stream of a live run.
+
+    ``poll()`` re-discovers streams, tail-reads each, schema-validates
+    every record (invalid ones are counted, kept out of state, never
+    fatal) and folds them into:
+
+      state[(source, kind)]   latest record of that kind per stream
+      counts[(source, kind)]  how many arrived
+      fault_counts[kind] / recovery_counts[kind]   run-wide
+      shed_by_reason[reason]  run-wide shed row totals
+      last_seen[source]       clock time a record last ARRIVED — the
+                              silent-source alert's input
+      epoch_times[source]     recent step_time_s history (regression
+                              rule input, bounded window)
+
+    The clock is injectable so alert-horizon tests run on a fake."""
+
+    HISTORY = 64  # epoch-time history per source (regression window)
+
+    def __init__(self, target: str, validate: bool = True,
+                 clock=time.time):
+        self.target = os.fspath(target)
+        self._validate = validate
+        self._clock = clock
+        self.readers: Dict[str, TailReader] = {}
+        self.state: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.counts: Dict[Tuple[str, str], int] = {}
+        self.fault_counts: Dict[str, int] = {}
+        self.recovery_counts: Dict[str, int] = {}
+        self.shed_by_reason: Dict[str, int] = {}
+        self.last_seen: Dict[str, float] = {}
+        self.epoch_times: Dict[str, List[float]] = {}
+        self.n_records = 0
+        self.n_invalid = 0
+        self.schema_version: Optional[int] = None
+
+    # ---------------- ingestion ---------------------------------------
+
+    def poll(self) -> int:
+        """One aggregation step; returns how many records arrived."""
+        n = 0
+        root = self.target if os.path.isdir(self.target) else None
+        for path in discover_streams(self.target):
+            r = self.readers.get(path)
+            if r is None:
+                r = self.readers[path] = TailReader(path)
+            src = source_name(path, root)
+            for rec in r.poll():
+                self._fold(src, rec)
+                n += 1
+        return n
+
+    def _fold(self, source: str, rec: Dict[str, Any]) -> None:
+        self.n_records += 1
+        self.last_seen[source] = self._clock()
+        if self._validate:
+            try:
+                validate_record(rec)
+            except ValueError:
+                self.n_invalid += 1
+                return
+        kind = rec.get("event")
+        if not isinstance(kind, str):
+            self.n_invalid += 1
+            return
+        key = (source, kind)
+        self.state[key] = rec
+        self.counts[key] = self.counts.get(key, 0) + 1
+        if kind == "run":
+            sv = rec.get("schema_version")
+            if isinstance(sv, int):
+                self.schema_version = sv
+        elif kind == "epoch":
+            hist = self.epoch_times.setdefault(source, [])
+            st = rec.get("step_time_s")
+            if isinstance(st, (int, float)):
+                hist.append(float(st))
+                del hist[:-self.HISTORY]
+        elif kind == "fault":
+            k = str(rec.get("kind"))
+            self.fault_counts[k] = self.fault_counts.get(k, 0) + 1
+        elif kind == "recovery":
+            k = str(rec.get("kind"))
+            self.recovery_counts[k] = self.recovery_counts.get(k, 0) + 1
+        elif kind == "serving":
+            by = rec.get("shed_by_reason")
+            if isinstance(by, dict):
+                for reason, rows in by.items():
+                    if isinstance(rows, int):
+                        self.shed_by_reason[reason] = (
+                            self.shed_by_reason.get(reason, 0) + rows)
+
+    # ---------------- views -------------------------------------------
+
+    def sources(self) -> List[str]:
+        return sorted(self.last_seen)
+
+    def latest(self, kind: str) -> Dict[str, Dict[str, Any]]:
+        """{source: latest record} for one record kind."""
+        return {s: r for (s, k), r in self.state.items() if k == kind}
+
+    def silent_for(self, source: str) -> float:
+        """Seconds since `source` last produced a record."""
+        return max(self._clock() - self.last_seen.get(source, 0.0), 0.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-dict rollup for /health and --follow: per-source ages
+        and per-kind latest highlights."""
+        now = self._clock()
+        per_source = {}
+        for src in self.sources():
+            kinds = {k: self.counts[(s, k)]
+                     for (s, k) in self.counts if s == src}
+            per_source[src] = {
+                "age_s": round(now - self.last_seen[src], 3),
+                "records": sum(kinds.values()),
+                "kinds": kinds,
+            }
+        epochs = self.latest("epoch")
+        serving = self.latest("serving")
+        membership = self.latest("membership")
+        snap: Dict[str, Any] = {
+            "target": self.target,
+            "n_streams": len(self.readers),
+            "n_records": self.n_records,
+            "n_invalid": self.n_invalid,
+            "n_malformed": sum(r.n_malformed
+                               for r in self.readers.values()),
+            "schema_version": self.schema_version,
+            "sources": per_source,
+            "fault_counts": dict(self.fault_counts),
+            "recovery_counts": dict(self.recovery_counts),
+            "shed_by_reason": dict(self.shed_by_reason),
+        }
+        if epochs:
+            snap["train"] = {
+                s: {k: r.get(k) for k in
+                    ("epoch", "step_time_s", "loss", "grad_norm",
+                     "halo_bytes", "staleness_age")}
+                for s, r in epochs.items()}
+        if serving:
+            snap["serving"] = {
+                s: {k: r.get(k) for k in
+                    ("qps", "p50_ms", "p95_ms", "p99_ms", "queue_depth",
+                     "shed", "staleness_age", "param_generation",
+                     "param_staleness")}
+                for s, r in serving.items()}
+        if membership:
+            snap["membership"] = {
+                s: {"generation": r.get("generation"),
+                    "trigger": r.get("trigger")}
+                for s, r in membership.items()}
+        return snap

@@ -95,6 +95,7 @@ class MetricsLogger:
             maxlen=_RING_CAPACITY)
         self._degraded = False
         self._dropped = 0
+        self._n_records = 0
 
     # ---------------- degradation policy ------------------------------
 
@@ -156,6 +157,7 @@ class MetricsLogger:
         if self._validate:
             validate_record(rec)
         line = json.dumps(rec) + "\n"
+        self._n_records += 1
         if self._degraded and not self._try_recover():
             self._enter_degraded(OSError("sink still degraded"), line)
             return rec
@@ -492,10 +494,63 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def alert(self, rule: str, state: str, severity: str, source: str,
+              value: Optional[float], threshold: Optional[float],
+              message: str, **extra) -> Dict[str, Any]:
+        """One SLO alert edge (obs/health.py rule engine): state "fire"
+        when the rule's predicate first holds, "resolve" when it first
+        stops. Hard-flushed — an alert often describes a run that is
+        about to get worse, and the operator trail must survive the
+        monitor dying with it."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "alert",
+            "rule": str(rule),
+            "state": str(state),
+            "severity": str(severity),
+            "source": str(source),
+            "value": None if value is None else float(value),
+            "threshold": None if threshold is None else float(threshold),
+            "message": str(message),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
+    def span(self, trace_id: str, span_id: str, op: str, t_start: float,
+             dur_ms: float, status: str = "ok", **extra) -> Dict[str, Any]:
+        """One sampled serving-path span (docs/SERVING.md tracing):
+        queue/dispatch/shed on the driver, rpc/replica/engine across
+        the fleet hop. NOT hard-flushed — spans are high-volume and
+        advisory; the flush-per-write default already lands them."""
+        return self.write({
+            "event": "span",
+            "trace_id": str(trace_id),
+            "span_id": str(span_id),
+            "op": str(op),
+            "t_start": float(t_start),
+            "dur_ms": float(dur_ms),
+            "status": str(status),
+            **extra,
+        })
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""
         return self.write({"event": event, **fields})
+
+    def stats(self) -> Dict[str, Any]:
+        """Sink health counters for the live exporter (docs/
+        OBSERVABILITY.md "Live monitoring"): records accepted since
+        open, the PR-14 io-degraded state, the ring-buffer depth, and
+        how many buffered records the ring has had to drop. Cheap and
+        side-effect free — safe to poll from a monitor thread."""
+        return {
+            "records": self._n_records,
+            "degraded": self._degraded,
+            "ring_depth": len(self._ring),
+            "dropped": self._dropped,
+        }
 
     # ---------------- lifecycle ---------------------------------------
 

@@ -17,10 +17,11 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 9  # v9: soak record kind (chaos-soak episode
-#                         verdicts, resilience/soak.py) + the
-#                         io-degraded fault/recovery kind
-#                         (docs/RESILIENCE.md "Storage faults")
+SCHEMA_VERSION = 10  # v10: alert record kind (live SLO rule engine,
+#                          obs/health.py — edge-triggered fire/resolve
+#                          pairs) + span record kind (sampled per-query
+#                          serving traces, docs/OBSERVABILITY.md
+#                          "Live monitoring")
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -292,6 +293,52 @@ SOAK_FIELDS: Dict[str, str] = {
     "verdict": "string",           # green | red
 }
 
+# one record per SLO alert EDGE (obs/health.py rule engine, emitted by
+# cli.monitor): state flips to "fire" when a rule's predicate first
+# holds and to "resolve" when it first stops holding — the engine
+# dedupes, so a firing rule writes exactly one record per edge no
+# matter how many evaluation ticks it stays red. rule names the
+# built-in predicate (epoch-time-regression | shed-rate |
+# staleness-age | fault-rate | silent-source); source is the stream
+# key the rule evaluated ("*" for run-wide rules); value/threshold are
+# the observed number and the rule bound at the edge (null when the
+# edge is a resolve with no fresh observation, e.g. a silent source).
+ALERT_FIELDS: Dict[str, str] = {
+    "event": "string",             # "alert"
+    "rule": "string",              # rule id (see above)
+    "state": "string",             # fire | resolve
+    "severity": "string",          # info | warn | page
+    "source": "string",            # stream key evaluated ("*" run-wide)
+    "value": "number?",            # observed value at the edge
+    "threshold": "number?",        # rule bound at the edge
+    "message": "string",           # human-readable one-liner
+}
+
+# one record per sampled serving-path span (serve/*, docs/SERVING.md):
+# a trace id minted at submit time (--trace-sample-rate) rides the
+# ticket through the micro-batcher and — on the fleet path — the RPC
+# to the replica and the engine's chunked execution; every hop lands
+# one span. op:
+#   queue     submit -> batch dispatch (driver)
+#   dispatch  batch dispatch -> result complete (driver)
+#   shed      submit -> explicit shed (terminal; extras: reason)
+#   rpc       router dispatch RPC round-trip (driver; extras: replica)
+#   replica   replica-side request handling (replica process)
+#   engine    compiled-engine chunk execution (whichever process runs it)
+# Exactly one TERMINAL span (dispatch | shed) exists per sampled
+# submit — tests/test_monitor.py pins the conservation. t_start is
+# unix seconds (cross-process alignable); cli.timeline stitches spans
+# sharing a trace_id into Perfetto flow events.
+SPAN_FIELDS: Dict[str, str] = {
+    "event": "string",             # "span"
+    "trace_id": "string",          # minted at submit, shared by all hops
+    "span_id": "string",           # unique per span record
+    "op": "string",                # queue|dispatch|shed|rpc|replica|engine
+    "t_start": "number",           # unix seconds at span start
+    "dur_ms": "number",            # span duration, milliseconds
+    "status": "string",            # ok | shed | error
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -310,6 +357,8 @@ _BY_EVENT = {
     "fleet": FLEET_FIELDS,
     "stream": STREAM_FIELDS,
     "soak": SOAK_FIELDS,
+    "alert": ALERT_FIELDS,
+    "span": SPAN_FIELDS,
 }
 
 _JSON_TYPES = {

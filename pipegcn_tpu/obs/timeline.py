@@ -19,7 +19,16 @@ Perfetto (ui.perfetto.dev) or chrome://tracing:
     checkpoint -> resume sequence reads as a single picture;
   - ``profile`` records (obs/profiler.py) contribute per-phase span
     estimates inside their capture window;
-  - ``staleness`` records ride a counter track (max relative drift).
+  - ``staleness`` records ride a counter track (max relative drift);
+  - ``serving`` windows ride counter tracks (qps / p50 / queue depth /
+    shed), and fleet / membership / stream / soak / alert records are
+    instant events on an "events" track — all aligned on their
+    ``time_unix`` stamps;
+  - ``span`` records (the --trace-sample-rate serving path,
+    docs/SERVING.md) become ``X`` slices on a "spans" track, and every
+    trace id shared across streams is stitched into a Perfetto *flow*
+    (``s``/``t``/``f`` events) so one query reads as an arrow chain
+    router -> replica -> engine across processes.
 
 Chrome-trace JSON contract kept deliberately strict (the timeline test
 pins it): object with "traceEvents" (list) + "displayTimeUnit"; every
@@ -30,7 +39,17 @@ numeric dur >= 0; events are emitted sorted by ts.
 from __future__ import annotations
 
 import json
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# wall-clock-stamped record kinds rendered beyond the training tracks
+_WALL_KINDS = ("serving", "fleet", "membership", "stream", "soak",
+               "alert")
+
+
+def _scalar_args(r: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in r.items() if k != "event"
+            and isinstance(v, (int, float, str, bool))}
 
 
 def _rank_of(records: Sequence[Dict[str, Any]], fallback: int) -> int:
@@ -73,6 +92,7 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
     # pass 1: per-rank epoch start maps; establish the global alignment
     per_rank = []
     any_unstamped = False
+    wall_min: Optional[float] = None
     for order, (rank, records) in enumerate(rank_records):
         records = list(records)
         epochs = [r for r in records if r.get("event") == "epoch"
@@ -80,6 +100,12 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
         starts, stamped = _epoch_starts(epochs)
         any_unstamped |= not stamped
         per_rank.append((order, rank, records, epochs, starts, stamped))
+        for r in records:
+            t = (r.get("t_start") if r.get("event") == "span"
+                 else r.get("time_unix")
+                 if r.get("event") in _WALL_KINDS else None)
+            if isinstance(t, (int, float)):
+                wall_min = t if wall_min is None else min(wall_min, t)
 
     if any_unstamped:
         # lockstep alignment: every rank's epoch e starts at the max of
@@ -100,12 +126,25 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
         per_rank = [(o, rk, recs, eps, {e: shared[e] for e in st}, False)
                     for o, rk, recs, eps, st, _ in per_rank]
         t0 = 0.0
+        # the shared lockstep axis is synthetic; wall-stamped kinds
+        # (serving/fleet/span/...) get their own zero so a mixed file
+        # still renders with small timestamps on both axes
+        wall_ref = wall_min if wall_min is not None else 0.0
     else:
         t0 = min((min(st.values()) for _, _, _, _, st, _ in per_rank
                   if st), default=0.0)
+        if wall_min is not None:
+            # wall-stamped kinds may precede the first epoch dispatch
+            t0 = min(t0, wall_min)
+        wall_ref = t0
 
     def us(t: float) -> float:
         return round(max(t - t0, 0.0) * 1e6, 3)
+
+    def wus(t: float) -> float:
+        return round(max(t - wall_ref, 0.0) * 1e6, 3)
+
+    span_sites: Dict[str, List[Tuple[float, int, int]]] = {}
 
     for order, rank, records, epochs, starts, stamped in per_rank:
         pid = rank if rank >= 0 else order
@@ -155,6 +194,12 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
                           + float(last.get("step_time_s", 0.0)))
             return 0.0
 
+        extra_tids: set = set()
+
+        def _wall_ts(r: Dict[str, Any]) -> float:
+            t = r.get("time_unix")
+            return wus(float(t)) if isinstance(t, (int, float)) else 0.0
+
         for r in records:
             ev = r.get("event")
             if ev in ("fault", "recovery"):
@@ -177,6 +222,53 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
                         "ts": _epoch_ts(r.get("epoch"), end=True),
                         "name": "staleness_rel_drift",
                         "args": {"max_rel_drift": float(md)}})
+            elif ev == "serving":
+                ts = _wall_ts(r)
+                for key in ("qps", "p50_ms", "p99_ms", "queue_depth",
+                            "shed"):
+                    v = r.get(key)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        extra_tids.add(3)
+                        events.append({
+                            "ph": "C", "pid": pid, "tid": 3, "ts": ts,
+                            "name": f"serving_{key}",
+                            "args": {key: float(v)}})
+            elif ev in ("fleet", "membership", "stream", "soak",
+                        "alert"):
+                if ev == "fleet":
+                    name = f"fleet:{r.get('kind', '?')}"
+                elif ev == "membership":
+                    name = f"membership:g{r.get('generation', '?')}" \
+                           f" ({r.get('trigger', '?')})"
+                elif ev == "stream":
+                    name = f"stream:seq{r.get('seq', '?')}"
+                elif ev == "soak":
+                    name = f"soak:ep{r.get('episode', '?')}:" \
+                           f"{r.get('verdict', '?')}"
+                else:
+                    name = f"alert:{r.get('state', '?')}:" \
+                           f"{r.get('rule', '?')}"
+                extra_tids.add(4)
+                events.append({
+                    "ph": "i", "pid": pid, "tid": 4, "ts": _wall_ts(r),
+                    "s": "t", "name": name, "args": _scalar_args(r)})
+            elif ev == "span":
+                tid_ = r.get("trace_id")
+                t_start = r.get("t_start")
+                dur_ms = r.get("dur_ms")
+                if not (isinstance(tid_, str)
+                        and isinstance(t_start, (int, float))
+                        and isinstance(dur_ms, (int, float))):
+                    continue
+                ts = wus(float(t_start))
+                extra_tids.add(5)
+                events.append({
+                    "ph": "X", "pid": pid, "tid": 5, "ts": ts,
+                    "dur": round(max(float(dur_ms), 0.0) * 1e3, 3),
+                    "name": str(r.get("op", "span")),
+                    "args": _scalar_args(r)})
+                span_sites.setdefault(tid_, []).append((ts, pid, 5))
             elif ev == "profile":
                 a = r.get("epoch_start")
                 b = r.get("epoch_end")
@@ -198,6 +290,32 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
                                    "name": name,
                                    "args": {"device_s": sec}})
                     cursor += dur
+
+        for tid, tname in ((3, "serving"), (4, "events"), (5, "spans")):
+            if tid in extra_tids:
+                meta.append({"ph": "M", "pid": pid, "tid": tid,
+                             "name": "thread_name",
+                             "args": {"name": tname}})
+                meta.append({"ph": "M", "pid": pid, "tid": tid,
+                             "name": "thread_sort_index",
+                             "args": {"sort_index": tid}})
+
+    # flow stitching: every trace id seen in >1 span slice becomes a
+    # Perfetto flow (s -> t... -> f) binding the slices it rode —
+    # submit -> rpc -> replica -> engine reads as one arrow chain
+    for trace_id, sites in span_sites.items():
+        if len(sites) < 2:
+            continue
+        sites.sort()
+        fid = zlib.crc32(trace_id.encode("utf-8"))
+        for i, (ts, pid, tid) in enumerate(sites):
+            ph = "s" if i == 0 else ("f" if i == len(sites) - 1
+                                     else "t")
+            fe = {"ph": ph, "pid": pid, "tid": tid, "ts": ts,
+                  "cat": "query", "name": "query", "id": fid}
+            if ph == "f":
+                fe["bp"] = "e"
+            events.append(fe)
 
     events.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0),
                                e.get("tid", 0)))

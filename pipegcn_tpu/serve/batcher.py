@@ -59,9 +59,10 @@ class Ticket:
     serving capacity) instead of being silently dropped."""
 
     __slots__ = ("ids", "t_submit", "result", "latency_s", "done",
-                 "shed", "shed_reason")
+                 "shed", "shed_reason", "trace_id", "t_dispatch")
 
-    def __init__(self, ids: np.ndarray, t_submit: float):
+    def __init__(self, ids: np.ndarray, t_submit: float,
+                 trace_id: Optional[str] = None):
         self.ids = ids
         self.t_submit = t_submit
         self.result: Optional[np.ndarray] = None
@@ -69,6 +70,9 @@ class Ticket:
         self.done = False
         self.shed = False
         self.shed_reason: Optional[str] = None
+        # sampled tracing (serve/tracing.py): None = unsampled
+        self.trace_id = trace_id
+        self.t_dispatch: Optional[float] = None
 
 
 class MicroBatcher:
@@ -97,7 +101,8 @@ class MicroBatcher:
                  observer: Optional[Callable] = None,
                  max_queue: Optional[int] = None,
                  ticket_deadline_ms: Optional[float] = None,
-                 on_shed: Optional[Callable] = None):
+                 on_shed: Optional[Callable] = None,
+                 on_span: Optional[Callable] = None):
         self._run = run
         self.ladder = bucket_ladder(ladder_min, max_batch)
         self.max_batch = self.ladder[-1]
@@ -108,6 +113,11 @@ class MicroBatcher:
         self._clock = clock
         self._observer = observer
         self._on_shed = on_shed
+        # on_span(trace_id, op, t0, t1, status, **extra) — sampled
+        # tracing sink (SpanWriter.emit); None = tracing off. Spans
+        # fire only for tickets carrying a trace_id, so the default
+        # path never pays more than a None check per ticket.
+        self._on_span = on_span
         self._pending: List[Ticket] = []
         self.n_flushed_batches = 0
         self.n_shed_tickets = 0
@@ -128,15 +138,21 @@ class MicroBatcher:
         self.n_shed_rows += t.ids.size
         if self._on_shed is not None:
             self._on_shed(t, reason)
+        if self._on_span is not None and t.trace_id is not None:
+            # terminal span: a sampled submit ends in exactly one of
+            # shed | dispatch (tests/test_monitor.py conservation pin)
+            self._on_span(t.trace_id, "shed", t.t_submit, self._clock(),
+                          "shed", reason=reason, rows=int(t.ids.size))
         return t
 
-    def submit(self, node_ids) -> Ticket:
+    def submit(self, node_ids,
+               trace_id: Optional[str] = None) -> Ticket:
         ids = np.atleast_1d(np.asarray(node_ids, np.int64))
         if ids.size > self.max_batch:
             raise ValueError(
                 f"a single query of {ids.size} ids exceeds max_batch "
                 f"{self.max_batch}; split it")
-        t = Ticket(ids, self._clock())
+        t = Ticket(ids, self._clock(), trace_id=trace_id)
         self.n_submitted_rows += ids.size
         if self.max_queue is not None \
                 and self.queue_depth + ids.size > self.max_queue:
@@ -196,6 +212,7 @@ class MicroBatcher:
         while self._pending and rows + self._pending[0].ids.size \
                 <= self.max_batch:
             t = self._pending.pop(0)
+            t.t_dispatch = now
             take.append(t)
             rows += t.ids.size
         if not take:  # single oversized ticket is rejected at submit
@@ -219,6 +236,13 @@ class MicroBatcher:
             t.latency_s = t_done - t.t_submit
             t.done = True
             lats.extend([t.latency_s] * t.ids.size)
+            if self._on_span is not None and t.trace_id is not None:
+                td = t.t_dispatch if t.t_dispatch is not None \
+                    else t.t_submit
+                self._on_span(t.trace_id, "queue", t.t_submit, td, "ok",
+                              rows=int(t.ids.size))
+                self._on_span(t.trace_id, "dispatch", td, t_done, "ok",
+                              rows=int(t.ids.size))
         self.n_flushed_batches += 1
         self.n_served_rows += rows
         if self._observer is not None:
@@ -240,7 +264,17 @@ class MicroBatcher:
             if batch is None:
                 return n
             take, ids = batch
-            self.complete_batch(take, self._run(ids))
+            traced = self._on_span is not None \
+                and any(t.trace_id is not None for t in take)
+            t_run0 = self._clock() if traced else 0.0
+            out = self._run(ids)
+            if traced:
+                t_run1 = self._clock()
+                for t in take:
+                    if t.trace_id is not None:
+                        self._on_span(t.trace_id, "engine", t_run0,
+                                      t_run1, "ok", rows=int(ids.size))
+            self.complete_batch(take, out)
             n += 1
 
     def drain(self) -> int:
@@ -271,6 +305,7 @@ class ServingStats:
         self.misses = 0
         self.max_staleness = 0
         self.n_shed = 0
+        self.shed_by_reason: dict = {}
 
     # fed by MicroBatcher's observer hook
     def note_batch(self, bucket: int, n_valid: int,
@@ -291,6 +326,9 @@ class ServingStats:
     # fed by MicroBatcher's on_shed hook (ticket, reason)
     def note_shed(self, ticket, reason: str = "") -> None:
         self.n_shed += int(ticket.ids.size)
+        key = reason or "unknown"
+        self.shed_by_reason[key] = (self.shed_by_reason.get(key, 0)
+                                    + int(ticket.ids.size))
 
     # fed by the checkpoint watcher / engine after a (non-)swap
     def note_params(self, generation: int, staleness: int = 0) -> None:
@@ -318,6 +356,10 @@ class ServingStats:
             "shed": int(self.n_shed),
             "param_generation": int(self.param_generation),
             "param_staleness": int(self.param_staleness),
+            # uncontracted extra: rides into the serving record so the
+            # live exporter can break pipegcn_serving_shed_total out by
+            # reason (queue-full | deadline | fleet-down | ...)
+            "shed_by_reason": dict(self.shed_by_reason),
         }
         if reset:
             self.reset()

@@ -59,6 +59,7 @@ import numpy as np
 from .batcher import MicroBatcher, ServingStats
 from .loadgen import OpenLoopGenerator
 from .router import FleetUnavailable, Router
+from .tracing import SpanWriter, TraceSampler
 
 # ---------------------------------------------------------------------------
 # wire protocol
@@ -192,8 +193,12 @@ class ReplicaServer:
         op = msg.get("op")
         if op == "query":
             ids = np.asarray(msg["ids"], np.int64)
+            trace = msg.get("trace") or ()
+            t_handle0 = time.time() if trace else 0.0
             with self._lock:
+                t_eng0 = time.time() if trace else 0.0
                 out = self.engine.query(ids, stats=self.stats)
+                t_eng1 = time.time() if trace else 0.0
                 meta = {
                     "hit": bool(self.engine.fully_fresh),
                     "staleness_age": int(self.engine.staleness_age),
@@ -202,6 +207,9 @@ class ReplicaServer:
                     "incarnation": self.incarnation,
                 }
             self.n_queries += int(ids.size)
+            if trace:
+                self._emit_spans(trace, ids.size, t_handle0,
+                                 t_eng0, t_eng1)
             return {"ok": True, "logits": _encode_f32(out), "meta": meta}
         if op == "health":
             with self._lock:
@@ -217,6 +225,31 @@ class ReplicaServer:
             self._stop.set()
             return {"ok": True, "stopping": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _emit_spans(self, trace, n_rows: int, t_handle0: float,
+                    t_eng0: float, t_eng1: float) -> None:
+        """Server-side spans for a traced batch: `replica` (request
+        handling incl. lock wait) + `engine` (the compiled chunked
+        execution alone), one pair per riding trace id, landed in this
+        replica's own metrics stream. Timestamps are unix seconds so
+        cli.timeline can stitch them to the driver's spans."""
+        if self.ml is None:
+            return
+        from .tracing import SpanWriter
+
+        if not hasattr(self, "_span_writer"):
+            self._span_writer = SpanWriter(
+                self.ml, clock=time.time,
+                source=f"replica-m{self.replica_id}")
+        t_now = time.time()
+        for tid in trace:
+            self._span_writer.emit(
+                tid, "replica", t_handle0, t_now, "ok",
+                replica=self.replica_id, rows=int(n_rows),
+                incarnation=self.incarnation)
+            self._span_writer.emit(
+                tid, "engine", t_eng0, t_eng1, "ok",
+                replica=self.replica_id, rows=int(n_rows))
 
     # ---------------- background threads ------------------------------
 
@@ -397,9 +430,14 @@ class TcpReplicaClient:
                 pass
             self._sock = None
 
-    def query(self, ids: np.ndarray):
-        resp = self._rpc({"op": "query",
-                          "ids": np.asarray(ids, np.int64).tolist()})
+    def query(self, ids: np.ndarray, trace=None):
+        msg = {"op": "query",
+               "ids": np.asarray(ids, np.int64).tolist()}
+        if trace:
+            # sampled trace ids riding this batch: the replica emits
+            # its server-side spans for each (serve/tracing.py)
+            msg["trace"] = list(trace)
+        resp = self._rpc(msg)
         return _decode_f32(resp["logits"]), resp.get("meta", {})
 
     def health(self) -> dict:
@@ -733,6 +771,7 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
                    ticket_deadline_ms: Optional[float] = None,
                    seed: int = 0, ml=None,
                    fault_plan=None,
+                   trace_sample_rate: float = 0.0,
                    poll_every_s: float = 0.1,
                    stop: Optional[Callable[[], bool]] = None,
                    clock: Callable[[], float] = time.monotonic,
@@ -761,13 +800,21 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
             all_lat.extend(lats)
             fills.append(n_valid / bucket)
 
+    # sampled per-query tracing (serve/tracing.py): the trace id is
+    # minted at submit and rides the ticket through queue/dispatch
+    # spans here, an `rpc` span around the router round-trip, and the
+    # replica's own replica/engine spans on the far side of the wire
+    sampler = TraceSampler(trace_sample_rate, seed=seed, tag="fleet")
+    spans = SpanWriter(ml if trace_sample_rate > 0 else None,
+                       clock=clock, source="driver")
+
     batcher = MicroBatcher(
         run=lambda ids: (_ for _ in ()).throw(
             RuntimeError("fleet loop dispatches via the router")),
         max_batch=max_batch, max_delay_ms=max_delay_ms,
         ladder_min=ladder_min, clock=clock, observer=observer,
         max_queue=max_queue, ticket_deadline_ms=ticket_deadline_ms,
-        on_shed=stats.note_shed)
+        on_shed=stats.note_shed, on_span=spans.emit)
 
     work: "_queue.Queue" = _queue.Queue()
     n_fleet_shed = 0
@@ -781,8 +828,17 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
                 work.task_done()
                 return
             take, ids = item
+            traced = [t.trace_id for t in take
+                      if t.trace_id is not None]
             try:
-                res, rid = router.dispatch(ids)
+                t_rpc0 = clock()
+                res, rid = router.dispatch(ids, trace=traced or None)
+                if traced:
+                    t_rpc1 = clock()
+                    for tid in traced:
+                        spans.emit(tid, "rpc", t_rpc0, t_rpc1, "ok",
+                                   replica=int(rid),
+                                   rows=int(ids.size))
                 out, meta = (res if isinstance(res, tuple)
                              else (res, {}))
                 batcher.complete_batch(take, np.asarray(out))
@@ -881,7 +937,7 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
             sleep(min(target - now, 0.0005))
         if stopped:
             break
-        batcher.submit(q)
+        batcher.submit(q, trace_id=sampler.sample())
         now = clock()
         maybe_dispatch(now)
         tick(now)
@@ -929,6 +985,8 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
         "param_generation": int(stats.param_generation),
         "param_staleness": int(stats.param_staleness),
         "kills": kills,
+        "n_traced": int(sampler.n_sampled),
+        "n_spans": int(spans.n_spans),
         "drained": batcher.queue_depth == 0,
         "conserved": bool(conserved),
         "stopped_early": bool(stopped),

@@ -17,6 +17,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from .batcher import ServingStats
+from .tracing import SpanWriter, TraceSampler
 
 
 class OpenLoopGenerator:
@@ -48,6 +49,7 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
                      ml=None,
                      max_queue: Optional[int] = None,
                      ticket_deadline_ms: Optional[float] = None,
+                     trace_sample_rate: float = 0.0,
                      stop: Optional[Callable[[], bool]] = None,
                      clock: Callable[[], float] = time.monotonic,
                      sleep: Callable[[float], None] = time.sleep) -> dict:
@@ -86,6 +88,13 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
                                   max_queue=max_queue,
                                   ticket_deadline_ms=ticket_deadline_ms)
     batcher._observer = observer
+    # sampled per-query tracing (serve/tracing.py): off at rate 0; all
+    # host-side, so the compiled-program population is untouched (the
+    # trace_counts() pin in tests/test_serve.py holds at rate 1.0)
+    sampler = TraceSampler(trace_sample_rate, seed=seed, tag="serve")
+    spans = SpanWriter(ml if trace_sample_rate > 0 else None,
+                       clock=clock, source="serve")
+    batcher._on_span = spans.emit
     gen = OpenLoopGenerator(engine.num_global_nodes, qps, duration_s,
                             ids_per_query=ids_per_query, seed=seed)
     churn = np.random.default_rng(seed + 1)
@@ -150,7 +159,7 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
             sleep(min(target - now, 0.0005))
         if stopped:
             break
-        batcher.submit(q)
+        batcher.submit(q, trace_id=sampler.sample())
         now = clock()
         batcher.pump(now)
         tick(now)
@@ -178,6 +187,8 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
         "drained": batcher.queue_depth == 0,
         "stopped_early": bool(stopped),
         "n_shed": int(total_shed),
+        "n_traced": int(sampler.n_sampled),
+        "n_spans": int(spans.n_spans),
         "n_submitted": int(batcher.n_submitted_rows),
         "n_served": int(batcher.n_served_rows),
         # zero tickets silently lost: submitted == served + shed once

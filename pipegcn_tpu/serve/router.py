@@ -170,8 +170,13 @@ class Router:
 
     # ---------------- dispatch ----------------------------------------
 
-    def dispatch(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
+    def dispatch(self, ids: np.ndarray,
+                 trace=None) -> Tuple[np.ndarray, int]:
         """Send one batch; returns (logits, replica id that served it).
+        `trace` (optional) is a list of sampled trace ids riding this
+        batch; it is forwarded to the client only when set, so fake
+        clients with a bare ``query(ids)`` signature keep working at
+        the default sample rate 0.
 
         On a replica error: mark it down, back off exponentially, and
         retry against survivors until `retry_timeout_s` elapses (the
@@ -201,7 +206,10 @@ class Router:
             with self._lock:
                 self._inflight[rid] += int(ids.size)
             try:
-                out = self._clients[rid].query(ids)
+                if trace:
+                    out = self._clients[rid].query(ids, trace=trace)
+                else:
+                    out = self._clients[rid].query(ids)
             except Exception as exc:  # noqa: BLE001 — any client error
                 last_err = f"{type(exc).__name__}: {exc}"
                 excluded.add(rid)

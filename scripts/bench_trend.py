@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Bench trend report over the repo's BENCH_r*.json / MULTICHIP_*.json
+measurement series (pipegcn_tpu/obs/trend.py).
+
+    python scripts/bench_trend.py [--root DIR] [--tol 0.05] \
+        [--json] [--strict]
+
+Prints the per-lever delta table with best-known-headline regression
+flags; --json emits the verdict dict instead; --strict exits 3 when
+the verdict regressed (for window automation / CI lanes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pipegcn_tpu.obs.trend import format_trend, load_series, trend
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="bench trend over BENCH_r*.json / MULTICHIP_*.json")
+    p.add_argument("--root", default=None,
+                   help="directory holding the artifacts "
+                        "(default: the repo root)")
+    p.add_argument("--tol", type=float, default=0.05,
+                   help="fractional regression tolerance vs best-known")
+    p.add_argument("--json", action="store_true",
+                   help="emit the verdict dict as JSON")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 3 when the verdict regressed")
+    args = p.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    t = trend(load_series(root), tol=args.tol)
+    if args.json:
+        print(json.dumps(t, indent=2, sort_keys=True))
+    else:
+        print(format_trend(t))
+    return 3 if (args.strict and t["regressed"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

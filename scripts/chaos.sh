@@ -91,3 +91,15 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     -p no:cacheprovider "$@"
 timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     python scripts/soak.py --seed 0 --episodes 2 --out-dir results/soak
+
+# Monitor lane (docs/OBSERVABILITY.md "Live monitoring"): the live
+# telemetry plane — metrics-stream discovery + tail-follow torn-line
+# tolerance, edge-triggered SLO alert fire/dedupe/resolve under a
+# fake clock, span lifecycle conservation + Perfetto flow stitching,
+# the /metrics scrape-parity drill against a real HTTP server, and
+# bench trend regression flags — tier-1-safe but run standalone so a
+# telemetry regression fails the chaos lane even when someone trims
+# the tier-1 selection.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m live \
+    -p no:cacheprovider "$@"

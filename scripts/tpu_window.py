@@ -36,6 +36,12 @@ sys.path.insert(0, REPO)
 
 LOG_DIR = os.path.join(REPO, "results", "tpu_window")
 
+# heartbeat cadence while a queue entry runs — each beat lands a
+# free-form record in results/tpu_window/window.jsonl so a live
+# monitor (python -m pipegcn_tpu.cli.monitor results/tpu_window) can
+# tell "step grinding, log growing" from "step hung" mid-window
+HEARTBEAT_S = 30.0
+
 # the bench-artifact the Reddit-shape probes all assume (built by
 # scripts/build_bench_artifact.py or any prior bench run)
 _BENCH_PART = "partitions/bench-reddit-1-c2-s1024"
@@ -244,6 +250,77 @@ def _skip_record(name: str, missing: list) -> None:
               file=sys.stderr, flush=True)
 
 
+def _window_logger():
+    """MetricsLogger on results/tpu_window/window.jsonl, or None when
+    the obs package can't import — the queue must run regardless."""
+    try:
+        from pipegcn_tpu.obs import MetricsLogger
+
+        os.makedirs(LOG_DIR, exist_ok=True)
+        return MetricsLogger(os.path.join(LOG_DIR, "window.jsonl"))
+    except Exception as exc:  # noqa: BLE001
+        print(f"# window.jsonl logger unavailable: {exc!r}",
+              file=sys.stderr, flush=True)
+        return None
+
+
+def _run_step(name, argv, tmo, log, ml) -> str:
+    """One queue entry under Popen with periodic heartbeats into
+    window.jsonl (step name, elapsed, log growth) so the live monitor
+    can see the window progressing; returns the status string."""
+    t0 = time.time()
+    with open(log, "w") as f:
+        proc = subprocess.Popen(argv, cwd=REPO, stdout=f,
+                                stderr=subprocess.STDOUT)
+    next_beat = t0 + HEARTBEAT_S
+    while True:
+        rc = proc.poll()
+        now = time.time()
+        if rc is not None:
+            return f"rc={rc}"
+        if now - t0 > tmo:
+            proc.kill()
+            proc.wait()
+            return "timeout"
+        if ml is not None and now >= next_beat:
+            next_beat = now + HEARTBEAT_S
+            try:
+                log_bytes = os.path.getsize(log)
+            except OSError:
+                log_bytes = 0
+            ml.event("heartbeat", step=name,
+                     elapsed_s=round(now - t0, 1),
+                     log_bytes=log_bytes, time_unix=now)
+            ml.hard_flush()
+        time.sleep(min(1.0, max(0.0, next_beat - now)))
+
+
+def publish_trend() -> None:
+    """Fold the round's artifacts into the bench trend verdict
+    (obs/trend.py): results/tpu_window/trend.json + a window.jsonl
+    record, so a regression vs the best-known headline is flagged the
+    moment the window that caused it closes."""
+    try:
+        from pipegcn_tpu.obs.trend import format_trend, load_series, \
+            trend
+
+        t = trend(load_series(REPO))
+        os.makedirs(LOG_DIR, exist_ok=True)
+        with open(os.path.join(LOG_DIR, "trend.json"), "w") as f:
+            json.dump(t, f, indent=2, sort_keys=True)
+        ml = _window_logger()
+        if ml is not None:
+            ml.event("trend", regressed=t["regressed"],
+                     flags=t["flags"], n_rounds=t["n_rounds"],
+                     time_unix=time.time())
+            ml.hard_flush()
+            ml.close()
+        print(format_trend(t), flush=True)
+    except Exception as exc:  # noqa: BLE001 — advisory, never fatal
+        print(f"# trend publish failed: {exc!r}", file=sys.stderr,
+              flush=True)
+
+
 def run_queue(skip: set) -> None:
     os.makedirs(LOG_DIR, exist_ok=True)
     # preflight the WHOLE queue at window open (artifacts do not
@@ -252,31 +329,42 @@ def run_queue(skip: set) -> None:
     for name, miss in skipped.items():
         if name not in skip:
             _skip_record(name, miss)
-    for name, argv, tmo, _req in QUEUE:
-        if name in skip:
-            continue
-        if name in skipped:
-            continue  # skipped loudly above; not marked done
-        if not probe():
-            print(f"# tunnel died before {name}; stopping queue",
-                  flush=True)
-            return
-        log = os.path.join(LOG_DIR, f"{name}.log")
-        t0 = time.time()
-        print(f"# {name}: starting (timeout {tmo}s)", flush=True)
-        try:
-            with open(log, "w") as f:
-                r = subprocess.run(argv, cwd=REPO, stdout=f,
-                                   stderr=subprocess.STDOUT, timeout=tmo)
-            status = f"rc={r.returncode}"
-            if r.returncode == 0:
+    ml = _window_logger()
+    try:
+        for name, argv, tmo, _req in QUEUE:
+            if name in skip:
+                continue
+            if name in skipped:
+                continue  # skipped loudly above; not marked done
+            if not probe():
+                print(f"# tunnel died before {name}; stopping queue",
+                      flush=True)
+                return
+            log = os.path.join(LOG_DIR, f"{name}.log")
+            t0 = time.time()
+            print(f"# {name}: starting (timeout {tmo}s)", flush=True)
+            if ml is not None:
+                ml.event("step_start", step=name, timeout_s=tmo,
+                         time_unix=t0)
+                ml.hard_flush()
+            status = _run_step(name, argv, tmo, log, ml)
+            if status == "rc=0":
                 skip.add(name)
-        except subprocess.TimeoutExpired:
-            status = "timeout"
-        print(f"# {name}: {status} ({time.time() - t0:.0f}s) -> {log}",
-              flush=True)
-        with open(os.path.join(LOG_DIR, "status.json"), "w") as f:
-            json.dump({"done": sorted(skip), "ts": time.time()}, f)
+            print(f"# {name}: {status} ({time.time() - t0:.0f}s) "
+                  f"-> {log}", flush=True)
+            if ml is not None:
+                ml.event("step_done", step=name, status=status,
+                         elapsed_s=round(time.time() - t0, 1),
+                         time_unix=time.time())
+                ml.hard_flush()
+            with open(os.path.join(LOG_DIR, "status.json"), "w") as f:
+                json.dump({"done": sorted(skip), "ts": time.time()}, f)
+    finally:
+        # verdict even on a mid-queue tunnel death: completed steps
+        # already refreshed BENCH artifacts worth trending
+        if ml is not None:
+            ml.close()
+        publish_trend()
 
 
 def main() -> None:

@@ -174,6 +174,37 @@ def test_schema_v9_drift_guard():
         assert obs_schema.SCHEMA_VERSION > 9
 
 
+# frozen copies of the v10 contracts (the live-monitoring PR added the
+# alert record — the SLO rule engine's edge-triggered fire/resolve log
+# — and the span record carrying the sampled serving-path traces that
+# cli.timeline stitches into Perfetto flows). Same contract as the
+# earlier guards.
+_V10_ALERT_FIELDS = {
+    "event": "string", "rule": "string", "state": "string",
+    "severity": "string", "source": "string", "value": "number?",
+    "threshold": "number?", "message": "string",
+}
+_V10_SPAN_FIELDS = {
+    "event": "string", "trace_id": "string", "span_id": "string",
+    "op": "string", "t_start": "number", "dur_ms": "number",
+    "status": "string",
+}
+
+
+def test_schema_v10_drift_guard():
+    if obs_schema.SCHEMA_VERSION == 10:
+        for name, tag in _V10_ALERT_FIELDS.items():
+            assert obs_schema.ALERT_FIELDS.get(name) == tag, (
+                f"schema field alert.{name} removed or retyped "
+                f"without bumping SCHEMA_VERSION")
+        for name, tag in _V10_SPAN_FIELDS.items():
+            assert obs_schema.SPAN_FIELDS.get(name) == tag, (
+                f"schema field span.{name} removed or retyped "
+                f"without bumping SCHEMA_VERSION")
+    else:
+        assert obs_schema.SCHEMA_VERSION > 10
+
+
 def test_validate_record():
     validate_record({"event": "epoch", "epoch": 0, "step_time_s": 0.1,
                      "loss": 1.0, "grad_norm": 0.5, "halo_bytes": 128,
@@ -742,3 +773,36 @@ def test_sequential_runner_emits_epoch_records(tmp_path):
         assert r["grad_norm"] > 0 and r["halo_bytes"] > 0
     assert epochs[0]["staleness_age"] == 0
     assert epochs[1]["staleness_age"] == 1
+
+
+def test_alert_and_span_records_roundtrip(tmp_path):
+    """MetricsLogger.alert (hard-flushed) and .span write v10 records
+    that validate and read back; stats() exposes the sink's record
+    count and io-degradation state for the monitor exporter."""
+    p = tmp_path / "a.jsonl"
+    with MetricsLogger(p) as ml:
+        ml.alert(rule="fault-rate", state="fire", severity="page",
+                 source="*", value=3.0, threshold=1.0,
+                 message="3 fault(s) in the last 60s")
+        ml.alert(rule="fault-rate", state="resolve", severity="page",
+                 source="*", value=None, threshold=None,
+                 message="resolved")
+        ml.span(trace_id="q1-serve", span_id="s1", op="queue",
+                t_start=1234.5, dur_ms=2.25, status="ok", rows=4)
+        st = ml.stats()
+        assert st["records"] == 3
+        assert st["degraded"] is False
+        assert st["dropped"] == 0
+    recs = read_metrics(p)
+    assert [r["event"] for r in recs] == ["alert", "alert", "span"]
+    for r in recs:
+        validate_record(r)
+    assert recs[0]["state"] == "fire"
+    assert recs[1]["value"] is None
+    assert recs[2]["trace_id"] == "q1-serve"
+    # contract violations are loud
+    with pytest.raises(ValueError):
+        validate_record(dict(recs[2], dur_ms="fast"))
+    with pytest.raises(ValueError):
+        validate_record({k: v for k, v in recs[0].items()
+                         if k != "message"})

@@ -258,6 +258,47 @@ def test_zero_recompiles_after_warmup(served):
         "steady-state queries recompiled a serving program")
 
 
+def test_tracing_adds_zero_recompiles_and_conserves_spans(
+        served, tmp_path):
+    """--trace-sample-rate 1.0 through the full serving loop: every
+    query mints a trace id, spans land in the metrics stream with one
+    terminal (dispatch|shed) each, and NO serving program retraces —
+    the tracing is host-side clock arithmetic only. At rate 0 the
+    sampler mints nothing."""
+    from pipegcn_tpu.obs.metrics import MetricsLogger, read_metrics
+    from pipegcn_tpu.obs.schema import validate_record
+    from pipegcn_tpu.serve.tracing import TraceSampler
+
+    _, _, eng = served
+    eng.warmup()
+    c0 = dict(trace_counts())
+    mpath = tmp_path / "traced.jsonl"
+    with MetricsLogger(mpath) as ml:
+        ml.run_header(config={}, device={}, mesh={})
+        summary = run_serving_loop(
+            eng, duration_s=0.8, qps=60.0, max_delay_ms=2.0,
+            report_every_s=0.4, refresh_every_s=0.0,
+            update_every_s=0.0, seed=0, ml=ml,
+            trace_sample_rate=1.0)
+    assert dict(trace_counts()) == c0, (
+        "tracing recompiled a serving program")
+    assert summary["n_traced"] == summary["n_queries"] > 0
+    assert summary["n_spans"] > 0
+    spans = [r for r in read_metrics(mpath) if r.get("event") == "span"]
+    assert len(spans) == summary["n_spans"]
+    by_trace = {}
+    for s in spans:
+        validate_record(s)
+        assert s["dur_ms"] >= 0 and s["t_start"] > 0
+        by_trace.setdefault(s["trace_id"], []).append(s["op"])
+    assert len(by_trace) == summary["n_traced"]
+    for tid, ops in by_trace.items():
+        term = [op for op in ops if op in ("dispatch", "shed")]
+        assert len(term) == 1, (tid, ops)
+    # rate 0 is the default and mints nothing
+    assert TraceSampler(0.0).sample() is None
+
+
 def test_query_rejects_out_of_range(served):
     _, _, eng = served
     with pytest.raises(ValueError, match="out of range"):
