@@ -1,0 +1,89 @@
+"""Postmortem CLI (docs/OBSERVABILITY.md "Postmortem & flight
+recorder").
+
+    python -m pipegcn_tpu.cli.debug explain <run-dir> [--json] \
+        [--out metrics.jsonl]
+
+Collects everything a dead run left behind — black-box flight-recorder
+dumps (``blackbox-r<k>.json``), every metrics JSONL stream, child log
+tails, checkpoint metadata, environment fingerprint — and runs the
+evidence-citing rule engine (obs/postmortem.py) over it. Prints a
+confidence-ranked verdict with remediation and a last-minutes
+timeline; `--json` emits the contracted ``diagnosis`` record instead.
+`--out` additionally appends that record to a metrics JSONL sink (the
+supervisor and scripts/tpu_window.py use the library entry point
+directly).
+
+Exit code: 0 when a diagnosis was reached, 4 when the verdict is
+``unknown`` (nothing matched — collect more and retry), 1 on usage /
+IO errors."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+EXIT_UNKNOWN = 4
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m pipegcn_tpu.cli.debug",
+        description="Automated postmortem: diagnose why a run died "
+                    "from the artifacts it left behind")
+    sub = p.add_subparsers(dest="command", required=True)
+    ex = sub.add_parser(
+        "explain", help="diagnose a run directory and print the "
+                        "verdict with evidence")
+    ex.add_argument("run_dir",
+                    help="run directory (checkpoint/coordination/"
+                         "metrics dir — anything holding the run's "
+                         "artifacts)")
+    ex.add_argument("--json", action="store_true",
+                    help="emit the contracted diagnosis record as "
+                         "JSON instead of the human report")
+    ex.add_argument("--out", default=None, metavar="METRICS.JSONL",
+                    help="also append the diagnosis record to this "
+                         "metrics JSONL sink")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..obs import postmortem
+
+    if not os.path.isdir(args.run_dir):
+        print(f"pipegcn-debug: not a directory: {args.run_dir}",
+              file=sys.stderr)
+        return 1
+    verdict = postmortem.diagnose_run(args.run_dir)
+
+    if args.out:
+        from ..obs.metrics import MetricsLogger
+
+        ml = MetricsLogger(args.out)
+        try:
+            ml.diagnosis(
+                verdict=verdict["verdict"],
+                confidence=verdict["confidence"],
+                evidence=verdict["evidence"],
+                remediation=verdict["remediation"],
+                deterministic=verdict["deterministic"],
+                run_dir=verdict.get("run_dir", ""),
+            )
+        finally:
+            ml.close()
+
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        print(postmortem.render(verdict), end="")
+    return EXIT_UNKNOWN if verdict["verdict"] == "unknown" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -368,6 +368,18 @@ def run(args) -> dict:
         log=print)
     coord.start()
 
+    # ---- flight recorder (obs/flight.py): on by default, breadcrumbs
+    # from here on dump to blackbox-r<k>.json in the coordination dir
+    # on fault / unhandled exception / preemption / watchdog trip, and
+    # on demand via SIGQUIT (kill -QUIT <pid>) ----
+    from ..obs import flight as flightrec
+
+    flightrec.configure(rank=jax.process_index(), dump_dir=coord_dir)
+    flightrec.install_signal_dump()
+    flightrec.crumb("run-start", dataset=args.dataset,
+                    n_partitions=args.n_partitions,
+                    node_rank=args.node_rank)
+
     if streaming:
         # streaming needs the live host graph + parts the artifact path
         # discards, so it always builds in memory (with slack headroom)
@@ -613,6 +625,20 @@ def cli_entry() -> None:
         # fsynced at write time (MetricsLogger.hard_flush), so the
         # final peer-lost record is already durable here
         os._exit(EXIT_PREEMPTED)
+    except (Exception, KeyboardInterrupt) as exc:
+        # unhandled exception: leave a black box beside the run before
+        # the traceback propagates (skipped when the recorder was
+        # never pointed at a run dir — the failure predates setup).
+        # fit()'s own crash handler already dumped in-training
+        # failures; re-dumping the same path with the newest crumbs is
+        # idempotent.
+        from ..obs import flight as flightrec
+
+        if flightrec.get_recorder().dump_dir:
+            flightrec.dump_blackbox(
+                "exception",
+                error=f"{type(exc).__name__}: {exc}"[:200])
+        raise
 
 
 if __name__ == "__main__":

@@ -354,6 +354,25 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         if stops:
             out["membership_stopped"] = stops[-1]
 
+    # ---- forensics (obs/flight.py + obs/postmortem.py, v11) ----
+    boxes = [r for r in records if r.get("event") == "blackbox"]
+    if boxes:
+        out["n_blackbox_records"] = len(boxes)
+        reasons: Dict[str, int] = {}
+        for r in boxes:
+            k = str(r.get("reason"))
+            reasons[k] = reasons.get(k, 0) + 1
+        out["blackbox_reasons"] = reasons
+    diags = [r for r in records if r.get("event") == "diagnosis"]
+    if diags:
+        d = diags[-1]  # the latest postmortem verdict wins
+        out["diagnosis_verdict"] = d.get("verdict")
+        if isinstance(d.get("confidence"), (int, float)):
+            out["diagnosis_confidence"] = round(d["confidence"], 3)
+        out["diagnosis_deterministic"] = bool(d.get("deterministic"))
+        if isinstance(d.get("remediation"), str):
+            out["diagnosis_remediation"] = d["remediation"]
+
     # ---- streaming graph deltas (stream/, schema v8) ----
     stream = [r for r in records if r.get("event") == "stream"]
     if stream:
@@ -580,6 +599,21 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
             lines.append(f"  {'!! stream re-pads':<26} "
                          f"{s['stream_repads']} slack exhaustion(s) — "
                          f"recompiled; raise --stream-slack")
+    # ---- forensics (docs/OBSERVABILITY.md "Postmortem") ----
+    if s.get("n_blackbox_records"):
+        reasons = ", ".join(f"{k}x{n}" for k, n in
+                            sorted(s.get("blackbox_reasons",
+                                         {}).items()))
+        lines.append(f"  {'black-box dumps':<26} "
+                     f"{s['n_blackbox_records']} ({reasons})")
+    if s.get("diagnosis_verdict"):
+        det = (" [deterministic — do not blind-restart]"
+               if s.get("diagnosis_deterministic") else "")
+        lines.append("  {:<26} {} (confidence {}){}".format(
+            "!! postmortem verdict", s["diagnosis_verdict"],
+            s.get("diagnosis_confidence", "?"), det))
+        if s.get("diagnosis_remediation"):
+            lines.append(f"  {'':<26} {s['diagnosis_remediation']}")
     row("best val", "best_val", "{:.4f}")
     row("best epoch", "best_epoch")
     row("test acc", "test_acc", "{:.4f}")

@@ -31,6 +31,13 @@ Three pillars (docs/OBSERVABILITY.md):
   trend.py    bench trend tracking over BENCH_r*.json /
               MULTICHIP_*.json with best-known-headline regression
               flags (scripts/bench_trend.py is the CLI)
+  flight.py   black-box flight recorder: bounded breadcrumb ring,
+              atomic blackbox-r<k>.json crash dumps, faulthandler
+              all-thread stack capture, sub-watchdog stall detector
+  postmortem.py  automated root-cause diagnosis: bundles blackbox
+              dumps + stream tails + ledgers, runs the ordered
+              evidence-citing rule set to a ranked verdict
+              (cli/debug.py is the `pipegcn-debug explain` CLI)
 
 The reporting CLI lives in cli/report.py (`python -m
 pipegcn_tpu.cli.report metrics.jsonl`); the timeline CLI in
@@ -41,6 +48,13 @@ print lines and the result txt files; this subsystem is the
 machine-readable record every perf claim reports through.
 """
 
+from .flight import (
+    FlightRecorder,
+    StallDetector,
+    capture_stacks,
+    dump_blackbox,
+    get_recorder,
+)
 from .format import epoch_line, reference_eval_line, reference_train_line
 from .live import (
     LiveAggregator,
@@ -58,6 +72,8 @@ from .metrics import (
 from .schema import (
     ALERT_FIELDS,
     ANATOMY_FIELDS,
+    BLACKBOX_FIELDS,
+    DIAGNOSIS_FIELDS,
     EPOCH_FIELDS,
     EVAL_FIELDS,
     FAULT_FIELDS,
@@ -85,7 +101,14 @@ __all__ = [
     "STALENESS_FIELDS",
     "ALERT_FIELDS",
     "SPAN_FIELDS",
+    "BLACKBOX_FIELDS",
+    "DIAGNOSIS_FIELDS",
     "validate_record",
+    "FlightRecorder",
+    "StallDetector",
+    "capture_stacks",
+    "dump_blackbox",
+    "get_recorder",
     "LiveAggregator",
     "discover_streams",
     "merge_streams",

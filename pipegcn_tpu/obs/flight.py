@@ -1,0 +1,407 @@
+"""Black-box flight recorder: in-process breadcrumbs + crash dumps.
+
+A bounded ring of host-side breadcrumbs (dispatch-boundary enter/exit,
+collective phase enter/exit, checkpoint/ledger IO, RPC dispatch,
+fallback/sentinel/scaler events, last harvested metrics) that costs one
+amortized O(1) deque append per event and touches NOTHING inside traced
+programs — `trace_counts()` is pinned unchanged by
+tests/test_postmortem.py. When the process dies (fault, unhandled
+exception, preemption, watchdog trip) or is asked via signal, the ring
+is dumped atomically to ``blackbox-r<k>.json`` through the same
+`write_text_atomic` / `FAULTY_IO` seams every other durable writer
+uses, so chaos runs exercise the dump path too.
+
+Three cooperating pieces:
+
+  FlightRecorder  the ring itself + the dump; a process-wide singleton
+                  (`get_recorder()` / `configure()`), on by default
+                  (`PIPEGCN_FLIGHT=0` disables)
+  capture_stacks  `faulthandler`-based all-thread stack capture,
+                  annotated with the last-entered breadcrumb — the
+                  watchdog deadline and SIGQUIT paths use it so a rank
+                  blocked in a dead collective dies naming the wedged
+                  phase/epoch instead of dying mute
+  StallDetector   a daemon thread that watches breadcrumb progress and
+                  dumps (once per stall episode, with stacks) when the
+                  loop goes quiet for longer than its threshold WITHOUT
+                  killing the process — the sub-watchdog forensics the
+                  ``hang@E[:rN]:<ms>`` fault exercises
+
+The postmortem engine (obs/postmortem.py, `pipegcn-debug explain`)
+collects these dumps together with the metrics streams into a
+root-cause verdict. Dump records validate as the schema-v11
+``blackbox`` kind (obs/schema.py).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 512
+
+# dump reasons (free-form extras may refine them)
+REASONS = ("watchdog", "exception", "preemption", "signal", "stall",
+           "fault", "manual")
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+class FlightRecorder:
+    """Bounded breadcrumb ring + atomic black-box dump.
+
+    Thread-safe; append is O(1) on a ``deque(maxlen=capacity)`` so the
+    steady-state cost is a lock acquire + dict build per breadcrumb —
+    never a disk write, never a device op.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, rank: int = 0,
+                 dump_dir: Optional[str] = None,
+                 enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("PIPEGCN_FLIGHT", "1") != "0"
+        self.capacity = int(capacity)
+        self.rank = int(rank)
+        self.dump_dir = dump_dir
+        self.enabled = bool(enabled)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._open: List[Dict[str, Any]] = []   # enter/exit span stack
+        self._last: Optional[Dict[str, Any]] = None
+        self._progress_t = time.monotonic()
+        self.dumps: List[str] = []              # paths written this process
+        self._dump_failures = 0
+
+    # ---- recording ----
+
+    def crumb(self, kind: str, _progress: bool = True,
+              **fields) -> Optional[Dict[str, Any]]:
+        """Append one breadcrumb. Returns the record (None when the
+        recorder is disabled). ``_progress=False`` records without
+        resetting the stall clock — for the detector's own bookkeeping
+        crumbs, which must not look like forward progress."""
+        if not self.enabled:
+            return None
+        rec = {"kind": str(kind), "t": time.time()}
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            self._last = rec
+            if _progress:
+                self._progress_t = time.monotonic()
+        return rec
+
+    def enter(self, span: str, **fields) -> Optional[Dict[str, Any]]:
+        """Breadcrumb ``<span>-enter`` + push onto the open-span stack
+        (the stack is what annotates a hang: the innermost entry names
+        the phase the process never exited)."""
+        rec = self.crumb(span + "-enter", **fields)
+        if rec is not None:
+            with self._lock:
+                self._open.append(rec)
+        return rec
+
+    def exit(self, span: str, **fields) -> Optional[Dict[str, Any]]:
+        """Breadcrumb ``<span>-exit`` + pop the matching open span."""
+        rec = self.crumb(span + "-exit", **fields)
+        if rec is not None:
+            with self._lock:
+                for i in range(len(self._open) - 1, -1, -1):
+                    if self._open[i]["kind"] == span + "-enter":
+                        del self._open[i]
+                        break
+        return rec
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        """``with rec.span("collective", phase=...):`` enter/exit pair
+        that survives exceptions (the exit crumb records them)."""
+        self.enter(name, **fields)
+        try:
+            yield
+        except BaseException as exc:
+            self.exit(name, error=f"{type(exc).__name__}: {exc}"[:200])
+            raise
+        else:
+            self.exit(name)
+
+    # ---- inspection ----
+
+    def crumbs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def last_crumb(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._last) if self._last is not None else None
+
+    def open_spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._open]
+
+    def annotation(self) -> Dict[str, Any]:
+        """Compact hang context: the innermost open span (or the last
+        crumb when nothing is open) — phase, epoch, ring distance, peer
+        rank, whatever the instrumentation attached."""
+        with self._lock:
+            src = self._open[-1] if self._open else self._last
+            return dict(src) if src is not None else {}
+
+    def seconds_since_progress(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._progress_t
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "capacity": self.capacity,
+                "ring_depth": len(self._ring),
+                "n_crumbs_total": self._seq,
+                "dumps": len(self.dumps),
+                "dump_failures": self._dump_failures,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._open.clear()
+            self._last = None
+
+    # ---- dumping ----
+
+    def dump_path(self, directory: Optional[str] = None) -> str:
+        d = directory or self.dump_dir or "."
+        return os.path.join(d, f"blackbox-r{self.rank}.json")
+
+    def dump(self, reason: str, directory: Optional[str] = None,
+             stacks: Optional[str] = None,
+             **extra) -> Optional[str]:
+        """Write ``blackbox-r<k>.json`` atomically; returns the path,
+        or None when the write failed (the failure NEVER propagates —
+        a dump must not mask the fault it documents). ``stacks`` is a
+        pre-captured all-thread stack text (see :func:`capture_stacks`);
+        pass ``stacks=capture_stacks(self)`` on hang paths."""
+        if not self.enabled:
+            return None
+        payload: Dict[str, Any] = {
+            "event": "blackbox",
+            "schema_version": _schema_version(),
+            "rank": self.rank,
+            "reason": str(reason),
+            "time_unix": time.time(),
+            "pid": os.getpid(),
+            "crumbs": self.crumbs(),
+            "last_crumb": self.last_crumb(),
+            "open_spans": self.open_spans(),
+            "annotation": self.annotation(),
+            "stacks": stacks,
+            "n_crumbs_total": self._seq,
+        }
+        for k, v in extra.items():
+            payload.setdefault(k, _jsonable(v))
+        path = self.dump_path(directory)
+        try:
+            from ..resilience.storage import write_text_atomic
+
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            write_text_atomic(path, json.dumps(payload) + "\n",
+                              fsync=True)
+        except BaseException:  # noqa: BLE001 — never mask the fault
+            self._dump_failures += 1
+            return None
+        self.dumps.append(path)
+        return path
+
+
+def _schema_version() -> int:
+    try:
+        from .schema import SCHEMA_VERSION
+        return SCHEMA_VERSION
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+def capture_stacks(recorder: Optional[FlightRecorder] = None) -> str:
+    """All-thread stack text via ``faulthandler.dump_traceback``
+    (C-level: it works even while other threads hold locks or sit in
+    blocked native calls), annotated with the recorder's last-entered
+    breadcrumb so a wedged collective names its phase/epoch."""
+    header = ""
+    if recorder is not None:
+        ann = recorder.annotation()
+        if ann:
+            ctx = ", ".join(f"{k}={ann[k]}" for k in sorted(ann)
+                            if k not in ("t", "seq"))
+            header = f"# last breadcrumb: {ctx}\n"
+    fd, tmp = tempfile.mkstemp(prefix="pipegcn-stacks-", suffix=".txt")
+    try:
+        faulthandler.dump_traceback(file=fd, all_threads=True)
+        os.lseek(fd, 0, os.SEEK_SET)
+        chunks = []
+        while True:
+            b = os.read(fd, 65536)
+            if not b:
+                break
+            chunks.append(b)
+        text = b"".join(chunks).decode("utf-8", "replace")
+    finally:
+        os.close(fd)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return header + text
+
+
+class StallDetector:
+    """Daemon thread: when no breadcrumb lands for ``threshold_s``,
+    capture all-thread stacks and dump (reason="stall") ONCE per stall
+    episode — the process keeps running, so a sub-watchdog stall (the
+    ``hang@E:<ms>`` fault) leaves forensics without dying. A fresh
+    breadcrumb re-arms the detector."""
+
+    def __init__(self, recorder: FlightRecorder, threshold_s: float,
+                 poll_s: Optional[float] = None,
+                 directory: Optional[str] = None):
+        self.recorder = recorder
+        self.threshold_s = float(threshold_s)
+        self.poll_s = float(poll_s) if poll_s else max(
+            0.05, self.threshold_s / 4.0)
+        self.directory = directory
+        self.stalls = 0
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StallDetector":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="pipegcn-stall-detector",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            age = self.recorder.seconds_since_progress()
+            if age >= self.threshold_s:
+                if not self._fired:
+                    self._fired = True
+                    self.stalls += 1
+                    try:
+                        stacks = capture_stacks(self.recorder)
+                    except Exception:  # noqa: BLE001
+                        stacks = None
+                    self.recorder.crumb("stall-detected",
+                                        _progress=False,
+                                        stall_age_s=round(age, 3))
+                    self.recorder.dump("stall", directory=self.directory,
+                                       stacks=stacks,
+                                       stall_age_s=round(age, 3))
+            else:
+                self._fired = False
+
+
+# ---- process-wide singleton ----
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    """The process-wide recorder (created on first use, on by
+    default)."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder()
+        return _RECORDER
+
+
+def configure(rank: Optional[int] = None,
+              dump_dir: Optional[str] = None,
+              capacity: Optional[int] = None,
+              enabled: Optional[bool] = None) -> FlightRecorder:
+    """(Re)configure the singleton in place — instrumentation sites
+    hold references through :func:`get_recorder`, so identity must
+    survive configuration. A capacity change re-bounds the ring,
+    keeping the newest crumbs."""
+    rec = get_recorder()
+    with rec._lock:
+        if rank is not None:
+            rec.rank = int(rank)
+        if dump_dir is not None:
+            rec.dump_dir = dump_dir
+        if enabled is not None:
+            rec.enabled = bool(enabled)
+        if capacity is not None and int(capacity) != rec.capacity:
+            rec.capacity = int(capacity)
+            rec._ring = deque(rec._ring, maxlen=rec.capacity)
+    return rec
+
+
+def crumb(kind: str, **fields) -> Optional[Dict[str, Any]]:
+    return get_recorder().crumb(kind, **fields)
+
+
+def install_signal_dump(signum: int = signal.SIGQUIT) -> bool:
+    """On-demand dump: ``kill -QUIT <pid>`` writes the black box (with
+    stacks) and the process keeps running. Returns False when the
+    handler could not be installed (non-main thread — e.g. under a
+    test runner's worker — or an unsupported platform); callers treat
+    that as a soft miss."""
+    def _handler(_sig, _frm):
+        rec = get_recorder()
+        try:
+            stacks = capture_stacks(rec)
+        except Exception:  # noqa: BLE001
+            stacks = None
+        rec.crumb("signal-dump", signum=int(_sig))
+        rec.dump("signal", stacks=stacks, signum=int(_sig))
+
+    try:
+        signal.signal(signum, _handler)
+        return True
+    except (ValueError, OSError, AttributeError):
+        return False
+
+
+def dump_blackbox(reason: str, directory: Optional[str] = None,
+                  with_stacks: bool = False, **extra) -> Optional[str]:
+    """Module-level convenience used by the crash paths (coord hard
+    deadline, unhandled CLI exception, preemption): dump the singleton,
+    optionally with all-thread stacks. Never raises."""
+    rec = get_recorder()
+    stacks = None
+    if with_stacks:
+        try:
+            stacks = capture_stacks(rec)
+        except Exception:  # noqa: BLE001
+            stacks = None
+    return rec.dump(reason, directory=directory, stacks=stacks, **extra)

@@ -325,6 +325,15 @@ def prometheus_text(agg: LiveAggregator,
     gauge("pipegcn_io_degraded",
           int(agg.fault_counts.get("io-degraded", 0)
               > agg.recovery_counts.get("io-degraded", 0)))
+    # black-box dump files present under the watched run dir (obs/
+    # flight.py); a gauge, not a counter — dumps can be cleaned up
+    gauge("pipegcn_blackbox_dumps_total",
+          getattr(agg, "n_blackbox_dumps", 0))
+    for src, rec in sorted(agg.latest("diagnosis").items()):
+        gauge("pipegcn_diagnosis_confidence", rec.get("confidence"),
+              {"source": src, "verdict": str(rec.get("verdict")),
+               "deterministic": str(bool(rec.get(
+                   "deterministic"))).lower()})
     for src, rec in sorted(agg.latest("membership").items()):
         gauge("pipegcn_membership_generation", rec.get("generation"),
               {"source": src})

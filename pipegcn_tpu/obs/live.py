@@ -236,6 +236,11 @@ class LiveAggregator:
         self.n_records = 0
         self.n_invalid = 0
         self.schema_version: Optional[int] = None
+        # black-box flight-recorder dumps (obs/flight.py) are JSON
+        # FILES, not stream records — counted by glob each poll so the
+        # exporter's pipegcn_blackbox_dumps_total moves the moment a
+        # rank dumps, even if no metrics stream mirrors it
+        self.n_blackbox_dumps = 0
 
     # ---------------- ingestion ---------------------------------------
 
@@ -251,6 +256,10 @@ class LiveAggregator:
             for rec in r.poll():
                 self._fold(src, rec)
                 n += 1
+        if root is not None:
+            self.n_blackbox_dumps = len(glob.glob(
+                os.path.join(root, "**", "blackbox-r*.json"),
+                recursive=True))
         return n
 
     def _fold(self, source: str, rec: Dict[str, Any]) -> None:
@@ -322,6 +331,7 @@ class LiveAggregator:
         epochs = self.latest("epoch")
         serving = self.latest("serving")
         membership = self.latest("membership")
+        diagnosis = self.latest("diagnosis")
         snap: Dict[str, Any] = {
             "target": self.target,
             "n_streams": len(self.readers),
@@ -330,11 +340,20 @@ class LiveAggregator:
             "n_malformed": sum(r.n_malformed
                                for r in self.readers.values()),
             "schema_version": self.schema_version,
+            "n_blackbox_dumps": self.n_blackbox_dumps,
             "sources": per_source,
             "fault_counts": dict(self.fault_counts),
             "recovery_counts": dict(self.recovery_counts),
             "shed_by_reason": dict(self.shed_by_reason),
         }
+        if diagnosis:
+            # the latest postmortem verdict per stream (obs/
+            # postmortem.py) — what `monitor --once` surfaces
+            snap["diagnosis"] = {
+                s: {"verdict": r.get("verdict"),
+                    "confidence": r.get("confidence"),
+                    "deterministic": r.get("deterministic")}
+                for s, r in diagnosis.items()}
         if epochs:
             snap["train"] = {
                 s: {k: r.get(k) for k in

@@ -534,6 +534,53 @@ class MetricsLogger:
             **extra,
         })
 
+    def blackbox(self, rank: int, reason: str,
+                 crumbs: Sequence[Dict[str, Any]],
+                 last_crumb: Optional[Dict[str, Any]],
+                 open_spans: Sequence[Dict[str, Any]],
+                 stacks: Optional[str] = None,
+                 **extra) -> Dict[str, Any]:
+        """One flight-recorder dump mirrored into the metrics stream
+        (obs/flight.py writes the authoritative blackbox-r<k>.json
+        itself; this record makes the dump discoverable through the
+        same stream tail every other consumer follows). Hard-flushed —
+        by definition the process is dying or wedged when one of these
+        is written."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "blackbox",
+            "rank": int(rank),
+            "reason": str(reason),
+            "crumbs": list(crumbs),
+            "last_crumb": (None if last_crumb is None
+                           else dict(last_crumb)),
+            "open_spans": list(open_spans),
+            "stacks": None if stacks is None else str(stacks),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
+    def diagnosis(self, verdict: str, confidence: float,
+                  evidence: Sequence[str], remediation: str,
+                  deterministic: bool, **extra) -> Dict[str, Any]:
+        """One postmortem verdict (obs/postmortem.py): the rule
+        engine's confidence-ranked root cause with its citing
+        evidence. Hard-flushed — the supervisor's fail-fast decision
+        rides on this record and must never be lost to a crash."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "diagnosis",
+            "verdict": str(verdict),
+            "confidence": float(confidence),
+            "evidence": [str(e) for e in evidence],
+            "remediation": str(remediation),
+            "deterministic": bool(deterministic),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""

@@ -17,11 +17,11 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 10  # v10: alert record kind (live SLO rule engine,
-#                          obs/health.py — edge-triggered fire/resolve
-#                          pairs) + span record kind (sampled per-query
-#                          serving traces, docs/OBSERVABILITY.md
-#                          "Live monitoring")
+SCHEMA_VERSION = 11  # v11: blackbox record kind (flight-recorder crash
+#                          dumps, obs/flight.py) + diagnosis record kind
+#                          (postmortem verdicts, obs/postmortem.py +
+#                          pipegcn-debug) — docs/OBSERVABILITY.md
+#                          "Postmortem & flight recorder"
 
 # one run header per file/run: what produced the numbers
 RUN_FIELDS: Dict[str, str] = {
@@ -339,6 +339,47 @@ SPAN_FIELDS: Dict[str, str] = {
     "status": "string",            # ok | shed | error
 }
 
+# one record per black-box flight-recorder dump (obs/flight.py): the
+# breadcrumb ring a dying (or stalled, or signalled) process left
+# behind, written atomically to blackbox-r<k>.json — and mirrored into
+# the metrics stream when a sink is attached. reason: watchdog |
+# exception | preemption | signal | stall | fault | manual. crumbs is
+# the bounded ring (newest last); last_crumb/open_spans annotate what
+# the process was doing (phase, epoch, ring distance, peer rank);
+# stacks is faulthandler's all-thread capture (null when the dump path
+# had no stack capture, e.g. a clean-exception dump). Extras:
+# time_unix, pid, n_crumbs_total, annotation.
+BLACKBOX_FIELDS: Dict[str, str] = {
+    "event": "string",             # "blackbox"
+    "rank": "integer",             # process that wrote the dump
+    "reason": "string",            # see above
+    "crumbs": "array",             # the breadcrumb ring, newest last
+    "last_crumb": "object?",       # newest breadcrumb (null: empty ring)
+    "open_spans": "array",         # enter'd-but-never-exit'd spans
+    "stacks": "string?",           # all-thread stack text (hang paths)
+}
+
+# one record per postmortem verdict (obs/postmortem.py rule engine,
+# written by pipegcn-debug / the elastic supervisor / tpu_window's
+# failed-step auto-explain): the confidence-ranked root cause of a run.
+# verdict names the failure class (wedged-collective | oom |
+# fallback-exhausted | corrupt-artifact | config-error | desync |
+# storage-fault | recompile-storm | divergence | preemption |
+# clean-exit | unknown); evidence is the citing strings (file: record)
+# the rule matched on; deterministic says whether a supervisor should
+# fail fast (True: relaunching reproduces the failure) or keep its
+# restart/backoff policy. Extras: run_dir, candidates (the full ranked
+# list), timeline, generation/member (supervisor path), step
+# (tpu_window path).
+DIAGNOSIS_FIELDS: Dict[str, str] = {
+    "event": "string",             # "diagnosis"
+    "verdict": "string",           # failure class (see above)
+    "confidence": "number",        # rule confidence in [0, 1]
+    "evidence": "array",           # citing strings, most telling first
+    "remediation": "string",       # operator hint one-liner
+    "deterministic": "boolean",    # fail fast vs restart-and-hope
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -359,6 +400,8 @@ _BY_EVENT = {
     "soak": SOAK_FIELDS,
     "alert": ALERT_FIELDS,
     "span": SPAN_FIELDS,
+    "blackbox": BLACKBOX_FIELDS,
+    "diagnosis": DIAGNOSIS_FIELDS,
 }
 
 _JSON_TYPES = {

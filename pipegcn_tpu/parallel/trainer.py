@@ -28,6 +28,7 @@ smoothing of stale features/grads (--feat-corr/--grad-corr, momentum
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -39,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..graph.csr import Graph
 from ..models.sage import ModelConfig, forward, init_norm_state, init_params
+from ..obs import flight as flightrec
 from ..obs.format import epoch_line, reference_eval_line, reference_train_line
 from ..obs.metrics import device_info, memory_snapshot, mesh_info
 from ..obs.trace import PhaseTimer, named_phase
@@ -1870,10 +1872,25 @@ class Trainer:
                 # reach it at the same epoch, so fused blocks must not
                 # straddle the cadence boundary
                 periods.append(coord.cfg.desync_every)
+        # ---- flight recorder (obs/flight.py): host-side breadcrumbs,
+        # on by default, zero effect on traced programs. The stall
+        # detector is the opt-in sub-watchdog forensics thread
+        # (PIPEGCN_STALL_S seconds of breadcrumb silence -> stack dump
+        # WITHOUT dying); the hang@E:<ms> fault exercises it ----
+        frec = flightrec.get_recorder()
+        frec.crumb("fit-start", epoch=start_epoch, n_epochs=n_epochs)
+        stall_det = None
+        try:
+            stall_s = float(os.environ.get("PIPEGCN_STALL_S", "0") or 0)
+        except ValueError:
+            stall_s = 0.0
+        if stall_s > 0 and frec.enabled:
+            stall_det = flightrec.StallDetector(frec, stall_s).start()
         try:
             while epoch < n_epochs:
                 # ---- boundary faults / preemption: the one point where
                 # the donated state is consistent and labeled ----
+                frec.crumb("boundary", epoch=epoch)
                 if coord is not None:
                     coord.note_progress(epoch)
                     # a dead peer can never complete a collective:
@@ -2006,10 +2023,27 @@ class Trainer:
                     # kernel fallback ladder (resilience/numerics.py)
                     log_fn(f"fault-injected kernel crash at epoch {epoch}")
                     self._inject_kernel_crash = True
-                if fault_plan is not None and fault_plan.due("hang", epoch):
+                hang_ms = (fault_plan.due_arg("hang", epoch)
+                           if fault_plan is not None else None)
+                if hang_ms:
+                    # bounded sub-watchdog stall (hang@E[:rN]:<ms>):
+                    # heartbeats keep flowing and the loop RESUMES, so
+                    # only the flight recorder's stall detector — never
+                    # the peers' PeerLost path — sees it
+                    log_fn(f"fault-injected {hang_ms} ms stall at "
+                           f"epoch {epoch}")
+                    frec.crumb("stall-injected", epoch=epoch,
+                               stall_ms=hang_ms)
+                    time.sleep(hang_ms / 1000.0)
+                    frec.crumb("stall-resumed", epoch=epoch)
+                elif hang_ms is not None:
                     # simulate a wedged process: heartbeats stop too, so
-                    # the PEERS' watchdogs — not this rank — must act
+                    # the PEERS' watchdogs — not this rank — must act.
+                    # The open collective span is what the black-box
+                    # dump's stack annotation names as the wedged phase
                     log_fn(f"fault-injected hang at epoch {epoch}")
+                    frec.enter("collective", phase="fault-hang",
+                               epoch=epoch)
                     if coord is not None:
                         coord.suspend_heartbeat()
                     time.sleep(3600)
@@ -2064,6 +2098,8 @@ class Trainer:
                                 if agreed.preempt_rank >= 0 else
                                 "peer preemption (multiple ranks)")
                 if preempt_reason is not None:
+                    frec.crumb("preempt", epoch=epoch,
+                               reason=str(preempt_reason)[:120])
                     log_fn(f"preemption requested ({preempt_reason}); "
                            f"checkpointing at epoch boundary {epoch}")
                     if metrics is not None:
@@ -2122,6 +2158,10 @@ class Trainer:
                     old_halo = jax.tree_util.tree_map(
                         jnp.copy, self.state["comm"]["halo"])
                 timer.clear()
+                # dispatch span left OPEN across the step: if the
+                # program wedges inside (a dead collective), the crash
+                # dump's annotation names this epoch and phase
+                frec.enter("dispatch", epoch=epoch, chunk=chunk)
                 # annotate=True: the host span shows up in --profile-dir
                 # traces next to the named device phases
                 with timer.phase("step", annotate=True):
@@ -2133,6 +2173,7 @@ class Trainer:
                             self.train_epochs(epoch, chunk))
                         loss = float(blk_losses[-1])
                     jax.block_until_ready(self.state["params"])
+                frec.exit("dispatch", epoch=epoch)
                 dur = timer.durations()["step"] / chunk
                 stop_profile = profiling and (
                     epoch + chunk >= prof_window[1]
@@ -2162,6 +2203,9 @@ class Trainer:
                 for fb in self.fallbacks:
                     if not fb.get("emitted"):
                         fb["emitted"] = True
+                        frec.crumb("fallback", epoch=epoch,
+                                   from_impl=fb["from_impl"],
+                                   to_impl=fb["to_impl"])
                         log_fn(f"kernel fallback: {fb['from_impl']} -> "
                                f"{fb['to_impl']} ({fb['reason'][:120]})")
                         if metrics is not None:
@@ -2177,6 +2221,8 @@ class Trainer:
                 # the sentinel check
                 gn = np.atleast_1d(np.asarray(
                     self._last_metrics["grad_norm"], np.float64))
+                frec.crumb("metrics-harvest", epoch=epoch + chunk - 1,
+                           loss=float(loss), step_time_s=round(dur, 4))
                 # ---- injected metric faults (host-side only: the
                 # compiled device program is what production runs) ----
                 if fault_plan is not None:
@@ -2208,6 +2254,8 @@ class Trainer:
                             log_fn(f"fault-injected loss-scale overflow "
                                    f"at epoch {j}")
                     for ev in self.loss_scaler.update(epoch, ovf):
+                        frec.crumb("loss-scale", event=ev["kind"],
+                                   epoch=ev["epoch"])
                         if ev["kind"] == "overflow":
                             log_fn(
                                 f"loss-scale overflow at epoch "
@@ -2368,6 +2416,8 @@ class Trainer:
                     scfg = (sentinel.cfg if sentinel is not None
                             else SentinelConfig())
                     retries += 1
+                    frec.crumb("sentinel-trip", epoch=epoch,
+                               reason=str(reason)[:120], retry=retries)
                     rollback_to, good_state = last_good
                     new_lr = (self.tcfg.lr * scfg.lr_backoff
                               if scfg.lr_backoff < 1.0 else self.tcfg.lr)
@@ -2477,6 +2527,7 @@ class Trainer:
                     # processes); only process 0 writes (reference
                     # semantics, and N-1 fewer multi-GB writes to the
                     # shared filesystem)
+                    frec.enter("checkpoint-io", epoch=epoch + 1)
                     host = self.host_state()
                     if jax.process_index() == 0:
                         try:
@@ -2539,6 +2590,7 @@ class Trainer:
                                     metrics.fault(kind="injected",
                                                   epoch=epoch + 1,
                                                   reason="corrupt-ckpt")
+                    frec.exit("checkpoint-io", epoch=epoch + 1)
                 epoch += 1
 
         except BaseException as exc:
@@ -2568,6 +2620,30 @@ class Trainer:
                            f"reporting PeerLost instead of a crash")
                     converted = PeerLost(*lost)
             eff = converted if converted is not None else exc
+            # black-box dump BEFORE the checkpoint attempts: the
+            # forensics must survive even when the save path itself is
+            # what's wedged. Directory preference: the configured dump
+            # dir (cli/main points it at the coordination dir), else
+            # the checkpoint dir, else beside the metrics stream; a
+            # bare fit() with none of those skips the dump rather than
+            # littering the working directory.
+            try:
+                done_e = int(getattr(self, "last_epoch", start_epoch))
+                frec.crumb("crash", epoch=done_e,
+                           error=f"{type(eff).__name__}: {eff}"[:200])
+                bb_dir = frec.dump_dir or checkpoint_dir or (
+                    os.path.dirname(os.fspath(metrics.path)) or "."
+                    if metrics is not None
+                    and getattr(metrics, "path", None) else None)
+                if bb_dir:
+                    flightrec.dump_blackbox(
+                        "preemption" if isinstance(eff, Preempted)
+                        else "fault" if isinstance(eff, PeerLost)
+                        else "exception",
+                        directory=bb_dir, epoch=done_e,
+                        error=f"{type(eff).__name__}: {eff}"[:200])
+            except Exception:  # noqa: BLE001 — never mask the fault
+                pass
             if metrics is not None and isinstance(eff, PeerLost):
                 try:
                     metrics.fault(kind="peer-lost",
@@ -2621,6 +2697,9 @@ class Trainer:
             for kind in list(io_armed):
                 FAULTY_IO.disarm(kind)
             io_armed.clear()
+            if stall_det is not None:
+                stall_det.stop()
+            frec.crumb("fit-end", epoch=epoch)
 
         if pending is not None:
             # harvest the final in-flight evaluation

@@ -524,6 +524,25 @@ class Coordinator:
                              f"{self._ckpt_dir} (epoch {ep})")
                 except Exception as exc:  # noqa: BLE001
                     self.log(f"emergency checkpoint failed: {exc!r}")
+            # black-box dump with all-thread stacks BEFORE _hard_exit:
+            # the main thread is wedged in a dead collective right now,
+            # so this capture is exactly the forensics the postmortem
+            # engine (obs/postmortem.py) needs to name the wedged
+            # phase/epoch. Runs on the monitor thread — faulthandler
+            # is C-level and needs no cooperation from the wedged one.
+            try:
+                from ..obs import flight as _flight
+
+                rec = _flight.get_recorder()
+                rec.crumb("watchdog-trip", peer_rank=int(peer),
+                          silent_s=float(age),
+                          epoch=self._progress_epoch)
+                _flight.dump_blackbox(
+                    "watchdog", directory=(rec.dump_dir or self.cfg.dir),
+                    with_stacks=True, peer_rank=int(peer),
+                    silent_s=float(age), epoch=self._progress_epoch)
+            except Exception:  # noqa: BLE001 — exit anyway
+                pass
         finally:
             # _hard_exit skips atexit AND io teardown: fsync every
             # buffered metrics record (the fault record above explains

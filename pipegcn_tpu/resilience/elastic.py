@@ -33,6 +33,15 @@ exponential backoff between relaunches, a hard ``--max-restarts`` cap,
 and a restart-storm circuit breaker (too many restarts inside a
 sliding window). Both stop paths leave the last resumable checkpoint
 untouched and exit 75 so an outer scheduler can still resume later.
+
+Every member death is additionally DIAGNOSED (obs/postmortem.py runs
+over the coord dir's black-box dumps, rank logs and metrics streams);
+the verdict rides the next ledger generation and the membership
+metrics record. Deterministic verdict classes — corrupt-artifact,
+config-error, fallback-exhausted, failures a relaunch reproduces —
+get ONE gated retry and then stop the supervisor hard (rc 1, not 75)
+instead of burning ``--max-restarts`` (docs/RESILIENCE.md "Fail fast
+vs restart").
 """
 
 from __future__ import annotations
@@ -210,7 +219,8 @@ class MembershipLedger:
 
     def append(self, *, generation: int, members: Sequence[int],
                assignment: Assignment, trigger: str,
-               restart_latency_s: Optional[float] = None) -> Dict:
+               restart_latency_s: Optional[float] = None,
+               diagnosis: Optional[Dict] = None) -> Dict:
         latest = self.latest_generation()
         if generation <= latest:
             raise ValueError(
@@ -225,6 +235,11 @@ class MembershipLedger:
         }
         if restart_latency_s is not None:
             payload["restart_latency_s"] = float(restart_latency_s)
+        if diagnosis is not None:
+            # the postmortem verdict that explains why this generation
+            # exists (obs/postmortem.py) — slim form, evidence lives in
+            # the metrics stream's diagnosis record
+            payload["diagnosis"] = dict(diagnosis)
         rec = {"crc32": _crc_of(payload), "payload": payload}
         path = self.path_for(generation)
         # temp+rename through the storage-fault seams: a torn or failed
@@ -481,6 +496,14 @@ class ElasticSupervisor:
         # from ledger.latest(), never from progress that was only
         # acked in memory
         self._ledger_pending: List[Dict] = []
+        # postmortem fail-fast state: per deterministic verdict class,
+        # how many member deaths diagnosed as it. One gated retry is
+        # allowed (the diagnosis could be wrong); a recurrence stops
+        # the supervisor instead of burning --max-restarts on a
+        # failure that reproduces every launch (docs/RESILIENCE.md
+        # "Fail fast vs restart")
+        self._det_seen: Dict[str, int] = {}
+        self._pending_diag: Optional[Dict] = None
         # rejoin@G entries in the fault plan are the supervisor's to
         # honor (inert in the trainer): member rank rejoins at gen G
         self._rejoin_schedule: List[Tuple[int, Optional[int]]] = []
@@ -692,12 +715,50 @@ class ElasticSupervisor:
                       f"generations appended")
         return True
 
+    def _diagnose_death(self, generation: int,
+                        victim: int) -> Optional[Dict]:
+        """Run the postmortem rule engine over the coordination dir
+        (black-box dumps, rank logs, the membership metrics stream all
+        live there) after a member death. Returns the verdict dict, or
+        None when diagnosis itself failed — forensics must never take
+        the supervisor down."""
+        try:
+            from ..obs.postmortem import diagnose_run
+
+            v = diagnose_run(self.coord_dir)
+        except Exception as exc:  # noqa: BLE001
+            self._log(f"postmortem for member {victim} failed: {exc!r}")
+            return None
+        self._log(f"postmortem for member {victim}: {v['verdict']} "
+                  f"(confidence {v['confidence']:.2f}"
+                  + (", deterministic" if v["deterministic"] else "")
+                  + ")")
+        try:
+            self._metrics_logger().diagnosis(
+                verdict=v["verdict"], confidence=v["confidence"],
+                evidence=list(v["evidence"])[:6],
+                remediation=v["remediation"],
+                deterministic=v["deterministic"],
+                generation=generation, victim=victim)
+        except OSError:
+            pass  # a degraded metrics sink must not block the verdict
+        return v
+
+    @staticmethod
+    def _diag_slim(v: Dict) -> Dict:
+        return {"verdict": v["verdict"],
+                "confidence": v["confidence"],
+                "deterministic": v["deterministic"]}
+
     def _record(self, generation: int, members: List[int],
                 assignment: Assignment, trigger: str,
-                latency: Optional[float]) -> None:
+                latency: Optional[float],
+                diagnosis: Optional[Dict] = None) -> None:
         kw = dict(generation=generation, members=list(members),
                   assignment=assignment, trigger=trigger,
-                  restart_latency_s=latency)
+                  restart_latency_s=latency,
+                  diagnosis=(self._diag_slim(diagnosis)
+                             if diagnosis else None))
         appended = False
         if self._flush_ledger_pending():
             try:
@@ -715,10 +776,12 @@ class ElasticSupervisor:
                     component="membership-ledger")
         if not appended:
             self._ledger_pending.append(kw)
+        extra = ({"diagnosis": diagnosis["verdict"]}
+                 if diagnosis else {})
         self._metrics_logger().membership(
             generation=generation, assignment=assignment.as_json(),
             trigger=trigger, restart_latency_s=latency,
-            n_members=len(members))
+            n_members=len(members), **extra)
 
     # -- main loop ---------------------------------------------------------
 
@@ -748,7 +811,9 @@ class ElasticSupervisor:
 
         while True:
             assignment = plan_assignment(self.n_parts, members)
-            self._record(generation, members, assignment, trigger, latency)
+            self._record(generation, members, assignment, trigger, latency,
+                         diagnosis=self._pending_diag)
+            self._pending_diag = None
             t_launch = time.monotonic()
             self._launch_generation(generation, assignment)
             victim, death_t = self._watch_generation()
@@ -765,6 +830,40 @@ class ElasticSupervisor:
                 return EXIT_PREEMPTED
             members, trigger = self._next_members(members, victim,
                                                   generation)
+            if victim is not None:
+                self._pending_diag = self._diagnose_death(generation,
+                                                          victim)
+            diag = self._pending_diag
+            if diag is not None and diag.get("deterministic"):
+                v = diag["verdict"]
+                self._det_seen[v] = self._det_seen.get(v, 0) + 1
+                if self._det_seen[v] >= 2:
+                    # the gated retry died the same way: relaunching
+                    # reproduces this — stop HARD (rc 1, not 75; a
+                    # blind outer-scheduler resume would loop too)
+                    self._log(
+                        f"stopping: deterministic failure "
+                        f"'{v}' recurred after its one gated retry — "
+                        f"{diag['remediation']}")
+                    try:
+                        self.ledger.append(
+                            generation=generation + 1,
+                            members=list(members),
+                            assignment=assignment,
+                            trigger=f"deterministic:{v}",
+                            diagnosis=self._diag_slim(diag))
+                    except (OSError, ValueError) as exc:
+                        self._log(f"final ledger append failed: {exc}")
+                    self._metrics_logger().membership(
+                        generation=generation + 1,
+                        assignment=assignment.as_json(),
+                        trigger=f"deterministic:{v}",
+                        restart_latency_s=None,
+                        n_members=len(members), diagnosis=v)
+                    return 1
+                self._log(f"postmortem verdict '{v}' is deterministic: "
+                          f"allowing ONE gated retry, then failing "
+                          f"fast")
             self.policy.note_stable(ran_s)
             decision = self.policy.decide()
             if decision.action == "stop":

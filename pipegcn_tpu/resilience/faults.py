@@ -20,7 +20,12 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 detector (docs/RESILIENCE.md multi-host section)
   hang          the rank freezes at that epoch boundary (heartbeats
                 stop too, like a truly wedged process) — exercises the
-                PEERS' heartbeat watchdog / PeerLost path
+                PEERS' heartbeat watchdog / PeerLost path.
+                ``hang@E[:rN]:<ms>`` instead stalls the rank for <ms>
+                milliseconds and RESUMES (heartbeats keep flowing): a
+                sub-watchdog stall that exercises the flight
+                recorder's stall detector (obs/flight.py) without
+                tripping PeerLost
   overflow      that epoch's harvested loss-scale overflow flag reads 1
                 (what a saturated-activation backward reports) —
                 exercises the loss-scale backoff / step-skip accounting
@@ -111,8 +116,13 @@ _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
                    "kill", "replica-kill", "graph-delta") + IO_KINDS
 
 # the optional third group is 'r<N>' (rank), 'm<K>' (member), or a bare
-# number — the per-kind argument (only slow-fs takes one: milliseconds)
-_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm]?)(\d+))?$")
+# number — the per-kind argument (slow-fs / hang: milliseconds). A
+# rank/member qualifier may additionally be FOLLOWED by a bare arg
+# (``hang@6:r1:250``), the fourth group.
+_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm]?)(\d+))?(?::(\d+))?$")
+
+# kinds whose entries may carry a bare numeric argument
+_ARG_KINDS = ("slow-fs", "hang")
 
 
 @dataclasses.dataclass
@@ -156,12 +166,18 @@ class FaultPlan:
             elif m.group(3) == "m":
                 emember = int(m.group(4))
             elif m.group(3) == "" and m.group(4) is not None:
-                if kind != "slow-fs":
-                    raise ValueError(
-                        f"bad fault-plan entry {raw!r}: a bare "
-                        f"numeric qualifier (kind@E:<N>) is only "
-                        f"valid for slow-fs (milliseconds)")
                 earg = int(m.group(4))
+            if m.group(5) is not None:
+                if earg is not None:
+                    raise ValueError(
+                        f"bad fault-plan entry {raw!r}: at most one "
+                        f"bare numeric argument (kind@E[:rN]:<N>)")
+                earg = int(m.group(5))
+            if earg is not None and kind not in _ARG_KINDS:
+                raise ValueError(
+                    f"bad fault-plan entry {raw!r}: a bare numeric "
+                    f"argument (kind@E[:rN]:<N>) is only valid for "
+                    f"{' / '.join(_ARG_KINDS)} (milliseconds)")
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r}; known: "
@@ -239,7 +255,8 @@ class FaultPlan:
     def due_arg(self, kind: str, epoch: int) -> Optional[int]:
         """Like :meth:`due`, but returns the entry's per-kind argument
         (0 when none was given) instead of True — for kinds that carry
-        one, currently ``slow-fs@E:<ms>``. None when nothing is due."""
+        one (``slow-fs@E:<ms>``, ``hang@E[:rN]:<ms>``). None when
+        nothing is due."""
         for e in self._entries:
             if not e.consumed and e.kind == kind and e.epoch <= epoch \
                     and self._mine(e):

@@ -24,6 +24,15 @@ structural invariants over the artifacts left behind:
               tickets lost (serve/fleet.py)
   resume      the final clean ``--resume`` exits 0 and reaches
               n_epochs
+  diagnosis   the automated postmortem (obs/postmortem.py) over the
+              episode dir reaches the RIGHT verdict: ``clean-exit``
+              when the first five invariants are green (every injected
+              fault was recovered and the resume completed), or a
+              class consistent with the injected schedule when they
+              are red — every red episode must yield an explained
+              black-box bundle, not just a pile of artifacts. The run
+              summary reports ``diagnosis_accuracy`` (matched
+              fraction across episodes).
 
 Schedule composition rules (all deterministic per episode seed):
 
@@ -215,6 +224,62 @@ def check_metrics(paths: Sequence[str], n_epochs: int) -> Dict:
                 **({"missing": missing} if missing else {}))
 
 
+# injected fault kind -> postmortem verdict classes that correctly
+# explain it (obs/postmortem.py). Several kinds legitimately map to
+# more than one class: a SIGKILL'd member leaves either a generic
+# crash picture or (when a peer's watchdog dumped first) a
+# wedged-collective one.
+_KIND_TO_CLASS: Dict[str, Tuple[str, ...]] = {
+    "corrupt-ckpt": ("corrupt-artifact",),
+    "nan-loss": ("divergence",),
+    "kernel-crash": ("fallback-exhausted", "crash"),
+    "hang": ("wedged-collective",),
+    "desync": ("desync",),
+    "enospc": ("storage-fault",),
+    "torn-write": ("storage-fault", "corrupt-artifact"),
+    "ro-dir": ("storage-fault",),
+    "slow-fs": ("storage-fault",),
+    "kill": ("crash", "wedged-collective", "preemption"),
+    "sigterm": ("preemption", "crash"),
+    "crash": ("crash", "preemption"),
+}
+
+
+def expected_classes(schedule: Sequence[str]) -> List[str]:
+    """Postmortem verdicts that would correctly explain a red episode
+    running `schedule` (sorted; never empty — an unscheduled death is
+    still a crash)."""
+    out: set = set()
+    for entry in schedule:
+        out.update(_KIND_TO_CLASS.get(entry.split("@", 1)[0], ()))
+    return sorted(out) if out else ["crash"]
+
+
+def check_diagnosis(ep_dir: str, pre_verdict: str,
+                    schedule: Sequence[str]) -> Dict:
+    """Invariant #6: the automated postmortem over the episode dir
+    reaches the right verdict — ``clean-exit`` on a green episode
+    (dumps from recovered faults must NOT outrank the completed
+    resume), a schedule-consistent class on a red one."""
+    try:
+        from ..obs.postmortem import diagnose_run
+
+        diag = diagnose_run(ep_dir)
+    except Exception as exc:  # noqa: BLE001
+        return _inv(False, error=f"postmortem failed: {exc!r}")
+    expected = (["clean-exit"] if pre_verdict == "green"
+                else expected_classes(schedule))
+    ok = diag["verdict"] in expected
+    return _inv(ok, verdict=diag["verdict"],
+                confidence=round(float(diag["confidence"]), 3),
+                deterministic=diag["deterministic"],
+                expected=expected,
+                **({} if ok else
+                   {"error": f"verdict {diag['verdict']!r} not in "
+                             f"{expected}",
+                    "evidence": list(diag["evidence"])[:4]}))
+
+
 def check_tickets(fleet_summary: Optional[Dict]) -> Dict:
     """Zero accepted tickets lost in the serving drill (skipped —
     vacuously green — when the episode did not serve)."""
@@ -390,6 +455,13 @@ def run_episode(cfg: SoakConfig, episode: int,
                        **({} if res_rc == 0
                           else {"tail": res_tail[-500:]})),
     }
+    # invariant #6 rides on the other five's verdict (green episodes
+    # must diagnose clean-exit, red ones a schedule-consistent class)
+    # and must run BEFORE the green-episode dir cleanup below
+    pre_verdict = ("green" if all(v["ok"] for v in invariants.values())
+                   else "red")
+    invariants["diagnosis"] = check_diagnosis(ep_dir, pre_verdict,
+                                              schedule)
     verdict = ("green" if all(v["ok"] for v in invariants.values())
                else "red")
     for name, v in invariants.items():
@@ -434,12 +506,20 @@ def run_soak(cfg: SoakConfig,
     verdict = ("green" if records and
                all(r["verdict"] == "green" for r in records)
                else "red")
+    # fraction of episodes whose automated postmortem matched the
+    # expected class (invariant #6) — the headline forensics number
+    diag_ok = [bool(r["invariants"].get("diagnosis", {}).get("ok"))
+               for r in records]
     summary = {"seed": cfg.seed, "episodes": records,
-               "n_episodes": len(records), "verdict": verdict}
+               "n_episodes": len(records), "verdict": verdict,
+               "diagnosis_accuracy": (round(sum(diag_ok)
+                                            / len(diag_ok), 4)
+                                      if diag_ok else None)}
     out = os.path.join(cfg.out_dir, f"soak-seed{cfg.seed}.json")
     from .storage import write_text_atomic
 
     write_text_atomic(out, json.dumps(summary, indent=1), fsync=False)
     log(f"soak seed {cfg.seed}: {len(records)} episode(s), "
-        f"verdict {verdict} -> {out}")
+        f"verdict {verdict}, diagnosis accuracy "
+        f"{summary['diagnosis_accuracy']} -> {out}")
     return summary

@@ -404,6 +404,15 @@ class TcpReplicaClient:
         return self._sock
 
     def _rpc(self, msg: dict) -> dict:
+        # flight-recorder span (obs/flight.py): an RPC against a dead
+        # or wedged replica is exactly the kind of silent block the
+        # black-box annotation must name (replica, op, endpoint)
+        from ..obs import flight as _flight
+
+        frec = _flight.get_recorder()
+        frec.enter("rpc", replica=self.replica_id,
+                   op=str(msg.get("op", "?")),
+                   endpoint=f"{self.host}:{self.port}")
         with self._lock:
             try:
                 s = self._ensure()
@@ -411,9 +420,12 @@ class TcpReplicaClient:
                 resp = _recv_msg(s)
             except (OSError, ValueError, ConnectionError) as exc:
                 self._drop()
+                frec.exit("rpc", replica=self.replica_id,
+                          error=f"{type(exc).__name__}: {exc}"[:120])
                 raise ReplicaError(
                     f"replica {self.replica_id} at "
                     f"{self.host}:{self.port}: {exc}") from exc
+        frec.exit("rpc", replica=self.replica_id)
         if not resp.get("ok"):
             raise ReplicaError(
                 f"replica {self.replica_id} error: "

@@ -92,6 +92,21 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
 timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     python scripts/soak.py --seed 0 --episodes 2 --out-dir results/soak
 
+# Forensics lane (docs/OBSERVABILITY.md "Postmortem & flight
+# recorder"): breadcrumb ring semantics + bounded memory, the
+# dump-on-hard-exit subprocess drill (a blackbox-r<k>.json must
+# survive os._exit(75)), rule-engine verdicts per failure class on
+# synthetic bundles, the explain CLI on a real CPU-mesh run, the
+# supervisor fail-fast gate, and the two-process hang drill
+# (hang@E:rN wedges one rank; the survivor's watchdog trips; BOTH
+# ranks must leave black-box dumps and `pipegcn-debug explain` must
+# return wedged-collective). The drill is marked faults+slow and so
+# also rides the broad faults lane; re-run the marker standalone so a
+# forensics regression is named even when the broad lane is trimmed.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m forensics \
+    -p no:cacheprovider "$@"
+
 # Monitor lane (docs/OBSERVABILITY.md "Live monitoring"): the live
 # telemetry plane — metrics-stream discovery + tail-follow torn-line
 # tolerance, edge-triggered SLO alert fire/dedupe/resolve under a

@@ -7,8 +7,12 @@ Each episode composes a deterministic fault schedule (terminal kills /
 sigterms / crashes on checkpoint boundaries, in-process faults, the
 storage kinds enospc / torn-write / ro-dir / slow-fs, a streaming
 delta) from the episode seed, runs an elastic-supervised trainer and a
-final clean --resume, and checks the five invariants documented in
-resilience/soak.py. Same seed -> same schedules -> same verdict.
+final clean --resume, and checks the six invariants documented in
+resilience/soak.py — the sixth runs the automated postmortem
+(obs/postmortem.py) over every episode and demands the right verdict
+(clean-exit on green, a schedule-consistent class on red); the summary
+reports the matched fraction as ``diagnosis_accuracy``. Same seed ->
+same schedules -> same verdict.
 
 The storage-fault acceptance proof (epoch 5 lands AFTER seed-0
 episode 0's kill@4, so the armed window spans the epoch-6 checkpoint

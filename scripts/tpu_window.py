@@ -295,6 +295,34 @@ def _run_step(name, argv, tmo, log, ml) -> str:
         time.sleep(min(1.0, max(0.0, next_beat - now)))
 
 
+def _explain_failure(name: str, status: str, ml) -> None:
+    """Auto-postmortem for a failed queue step: run the rule engine
+    (pipegcn_tpu.obs.postmortem) over the window log dir — the step's
+    log tail, window.jsonl and any black-box dumps the step's
+    subprocesses left under results/ — and land the contracted
+    `diagnosis` record in window.jsonl so the round review starts from
+    a verdict, not a raw log."""
+    try:
+        from pipegcn_tpu.obs.postmortem import diagnose_run
+
+        v = diagnose_run(LOG_DIR)
+        print(f"# {name}: postmortem -> {v['verdict']} "
+              f"(confidence {v['confidence']:.2f}): "
+              f"{v['remediation']}", file=sys.stderr, flush=True)
+        if ml is not None:
+            ml.diagnosis(verdict=v["verdict"],
+                         confidence=v["confidence"],
+                         evidence=list(v["evidence"])[:6],
+                         remediation=v["remediation"],
+                         deterministic=v["deterministic"],
+                         step=name, status=status,
+                         time_unix=time.time())
+            ml.hard_flush()
+    except Exception as exc:  # noqa: BLE001 — advisory, never fatal
+        print(f"# {name}: postmortem failed: {exc!r}", file=sys.stderr,
+              flush=True)
+
+
 def publish_trend() -> None:
     """Fold the round's artifacts into the bench trend verdict
     (obs/trend.py): results/tpu_window/trend.json + a window.jsonl
@@ -357,6 +385,8 @@ def run_queue(skip: set) -> None:
                          elapsed_s=round(time.time() - t0, 1),
                          time_unix=time.time())
                 ml.hard_flush()
+            if status != "rc=0":
+                _explain_failure(name, status, ml)
             with open(os.path.join(LOG_DIR, "status.json"), "w") as f:
                 json.dump({"done": sorted(skip), "ts": time.time()}, f)
     finally:

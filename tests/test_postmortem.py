@@ -1,0 +1,890 @@
+"""Forensics suite: flight recorder, postmortem engine, fail-fast gate.
+
+Covers the black-box plane end to end (docs/OBSERVABILITY.md
+"Postmortem & flight recorder"):
+
+  ring          bounded breadcrumb memory, span stack/annotation,
+                env kill-switch, singleton identity under configure()
+  dump          schema-v11 ``blackbox`` validation, atomic path,
+                survival across a REAL ``os._exit(75)`` (subprocess
+                drill through the coordinator's hard-deadline path)
+  stalls        StallDetector fires once per episode and re-arms
+  rules         one synthetic bundle per verdict class; ranking,
+                deterministic tagging and clean-exit-beats-recovered
+                are all pinned
+  CLI           ``pipegcn-debug explain`` exit codes (0 / 4 / 1),
+                --json, --out sink
+  supervisor    deterministic verdicts stop after ONE gated retry
+                (rc 1, ledger trigger ``deterministic:<class>``);
+                transient verdicts keep the restart policy
+  grammar       ``hang@E[:rN][:<ms>]`` parse/round-trip/rejection
+  surfaces      LiveAggregator dump counting, /metrics gauge, report
+                summary keys, soak invariant #6 helpers
+  drill         (faults+slow) two-process ``hang@6:r1``: the wedged
+                rank AND the survivor both leave black-box dumps and
+                the explain CLI returns wedged-collective
+
+Everything except the subprocess drills is tier-1-safe;
+scripts/chaos.sh runs the ``forensics`` marker standalone.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pipegcn_tpu.obs import flight, read_metrics, validate_record
+from pipegcn_tpu.obs import postmortem
+from pipegcn_tpu.obs.flight import FlightRecorder, StallDetector
+from pipegcn_tpu.obs.live import LiveAggregator
+from pipegcn_tpu.obs.health import prometheus_text
+from pipegcn_tpu.obs.metrics import MetricsLogger
+from pipegcn_tpu.cli import debug as debug_cli
+from pipegcn_tpu.cli.report import summarize_run
+from pipegcn_tpu.resilience.faults import FaultPlan
+from pipegcn_tpu.resilience.soak import check_diagnosis, expected_classes
+
+pytestmark = pytest.mark.forensics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------- breadcrumb ring --------------------------------------
+
+
+def test_ring_bounded_and_evicts_oldest():
+    rec = FlightRecorder(capacity=8, rank=3, enabled=True)
+    for i in range(20):
+        rec.crumb("boundary", epoch=i)
+    crumbs = rec.crumbs()
+    assert len(crumbs) == 8  # bounded: the ring never grows past cap
+    assert [c["epoch"] for c in crumbs] == list(range(12, 20))
+    assert crumbs[-1] is not None and rec.last_crumb()["epoch"] == 19
+    st = rec.stats()
+    assert st["ring_depth"] == 8 and st["n_crumbs_total"] == 20
+    assert st["enabled"] is True and st["dumps"] == 0
+
+
+def test_env_kill_switch_disables_everything(monkeypatch, tmp_path):
+    monkeypatch.setenv("PIPEGCN_FLIGHT", "0")
+    rec = FlightRecorder(capacity=8)
+    assert rec.enabled is False
+    assert rec.crumb("boundary", epoch=1) is None
+    assert rec.enter("collective", phase="x") is None
+    assert rec.dump("manual", directory=str(tmp_path)) is None
+    assert rec.crumbs() == [] and rec.open_spans() == []
+    assert not os.listdir(tmp_path)
+
+
+def test_span_stack_and_annotation():
+    rec = FlightRecorder(capacity=32, enabled=True)
+    rec.crumb("fit-start", epoch=0)
+    rec.enter("dispatch", epoch=5)
+    rec.enter("collective", phase="transition", epoch=5)
+    # annotation = innermost OPEN span: the phase a hang would name
+    ann = rec.annotation()
+    assert ann["kind"] == "collective-enter"
+    assert ann["phase"] == "transition" and ann["epoch"] == 5
+    rec.exit("collective")
+    assert rec.annotation()["kind"] == "dispatch-enter"
+    rec.exit("dispatch")
+    # nothing open -> fall back to the newest crumb
+    assert rec.annotation()["kind"] == "dispatch-exit"
+    assert rec.open_spans() == []
+    # the span context manager records the exception on the exit crumb
+    with pytest.raises(RuntimeError):
+        with rec.span("checkpoint", epoch=6):
+            raise RuntimeError("boom")
+    assert rec.open_spans() == []
+    last = rec.last_crumb()
+    assert last["kind"] == "checkpoint-exit"
+    assert "RuntimeError: boom" in last["error"]
+
+
+def test_capture_stacks_names_last_breadcrumb():
+    rec = FlightRecorder(capacity=16, enabled=True)
+    rec.enter("collective", phase="fault-hang", epoch=11, peer=1)
+    text = flight.capture_stacks(rec)
+    head = text.splitlines()[0]
+    assert head.startswith("# last breadcrumb:")
+    assert "phase=fault-hang" in head and "epoch=11" in head
+    # faulthandler really captured this (the running test frame)
+    assert "test_postmortem" in text
+
+
+def test_configure_preserves_singleton_identity():
+    rec = flight.get_recorder()
+    saved = (rec.rank, rec.dump_dir, rec.capacity, rec.enabled)
+    try:
+        rec2 = flight.configure(rank=5, capacity=max(rec.capacity, 16))
+        assert rec2 is rec  # instrumentation holds references: identity
+        assert rec.rank == 5
+        rec.crumb("cfg-probe", epoch=1)
+        # a capacity change re-bounds in place, keeping newest crumbs
+        flight.configure(capacity=4)
+        assert rec.capacity == 4
+        assert any(c["kind"] == "cfg-probe" for c in rec.crumbs())
+    finally:
+        flight.configure(rank=saved[0], dump_dir=saved[1] or None,
+                         capacity=saved[2], enabled=saved[3])
+
+
+# ---------------- dumping ----------------------------------------------
+
+
+def test_dump_validates_as_blackbox_record(tmp_path):
+    rec = FlightRecorder(capacity=16, rank=3, enabled=True)
+    rec.crumb("fit-start", epoch=0)
+    rec.enter("collective", phase="transition", epoch=7)
+    path = rec.dump("watchdog", directory=str(tmp_path),
+                    stacks=flight.capture_stacks(rec), peer_rank=1)
+    assert path == str(tmp_path / "blackbox-r3.json")
+    assert rec.dumps == [path]
+    with open(path) as fh:
+        payload = json.load(fh)
+    validate_record(payload)  # schema-v11 ``blackbox`` kind
+    assert payload["event"] == "blackbox"
+    assert payload["rank"] == 3 and payload["reason"] == "watchdog"
+    assert payload["peer_rank"] == 1
+    assert payload["open_spans"][0]["phase"] == "transition"
+    assert payload["annotation"]["epoch"] == 7
+    assert "# last breadcrumb:" in payload["stacks"]
+    assert any(c["kind"] == "fit-start" for c in payload["crumbs"])
+
+
+def test_dump_failure_never_propagates(tmp_path):
+    rec = FlightRecorder(capacity=8, rank=0, enabled=True)
+    rec.crumb("x")
+    target = tmp_path / "not-a-dir"
+    target.write_text("a file where the dump dir should be")
+    assert rec.dump("fault", directory=str(target)) is None
+    assert rec.stats()["dump_failures"] == 1 and rec.dumps == []
+
+
+def test_dump_survives_hard_exit_subprocess(tmp_path):
+    """The acceptance drill in miniature: the coordinator's watchdog
+    hard-deadline path dumps the black box and then REALLY calls
+    ``os._exit(75)`` — the file must be on disk afterwards, stacks
+    annotated with the wedged phase."""
+    d = str(tmp_path)
+    script = (
+        "import os, sys\n"
+        "sys.path.insert(0, sys.argv[2])\n"
+        "from pipegcn_tpu.resilience.coord import Coordinator, CoordConfig\n"
+        "from pipegcn_tpu.obs.metrics import MetricsLogger\n"
+        "from pipegcn_tpu.obs import flight\n"
+        "d = sys.argv[1]\n"
+        "flight.configure(rank=0, dump_dir=d)\n"
+        "rec = flight.get_recorder()\n"
+        "rec.crumb('fit-start', epoch=0)\n"
+        "rec.enter('collective', phase='transition', epoch=8)\n"
+        "c = Coordinator(rank=0, n_ranks=2, cfg=CoordConfig(dir=d),\n"
+        "                metrics=MetricsLogger(os.path.join(d, 'm.jsonl')),\n"
+        "                log=lambda s: print(s), force_active=True)\n"
+        "c.note_progress(8)\n"
+        "c._on_hard_deadline(1, 12.5)\n"
+        "print('UNREACHABLE')\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "PYTHONPATH": REPO}
+    proc = subprocess.run([sys.executable, "-c", script, d, REPO],
+                          env=env, capture_output=True, text=True,
+                          timeout=180)
+    assert proc.returncode == 75, proc.stdout + proc.stderr
+    assert "UNREACHABLE" not in proc.stdout  # _exit really fired
+    box = tmp_path / "blackbox-r0.json"
+    assert box.exists(), os.listdir(d)
+    payload = json.loads(box.read_text())
+    validate_record(payload)
+    assert payload["reason"] == "watchdog" and payload["peer_rank"] == 1
+    assert "phase=transition" in payload["stacks"]
+    # the peer-lost fault record was hard-flushed before the exit
+    recs = read_metrics(tmp_path / "m.jsonl")
+    assert any(r.get("event") == "fault" and r.get("kind") == "peer-lost"
+               for r in recs)
+    # and the postmortem over the dir names the wedge from these two
+    v = postmortem.diagnose_run(d)
+    assert v["verdict"] == "wedged-collective"
+    assert v["confidence"] >= 0.9 and len(v["evidence"]) >= 3
+
+
+def test_stall_detector_fires_once_then_rearms(tmp_path):
+    rec = FlightRecorder(capacity=16, rank=0, enabled=True)
+    rec.crumb("fit-start", epoch=0)
+    det = StallDetector(rec, threshold_s=0.15, poll_s=0.03,
+                        directory=str(tmp_path)).start()
+    try:
+        deadline = time.time() + 10.0
+        while det.stalls == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert det.stalls == 1
+        time.sleep(0.4)  # still stalled: must NOT fire again
+        assert det.stalls == 1
+        rec.crumb("boundary", epoch=1)  # progress re-arms
+        deadline = time.time() + 10.0
+        while det.stalls == 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert det.stalls == 2
+    finally:
+        det.stop()
+    payload = json.loads((tmp_path / "blackbox-r0.json").read_text())
+    validate_record(payload)
+    assert payload["reason"] == "stall"
+    assert any(c["kind"] == "stall-detected" for c in payload["crumbs"])
+
+
+# ---------------- rule engine (synthetic bundles) ----------------------
+
+
+def _bundle(records=(), blackboxes=(), log_tails=None):
+    return {"run_dir": "/bundle", "collected_unix": 2_000_000.0,
+            "blackboxes": list(blackboxes), "records": list(records),
+            "log_tails": dict(log_tails or {}), "checkpoints": [],
+            "streams": [], "fingerprint": {}}
+
+
+def _box(reason, rank=0, t=1_000_000.0, **extra):
+    data = {"event": "blackbox", "rank": rank, "reason": reason,
+            "time_unix": t, "crumbs": [], "last_crumb": None,
+            "open_spans": [], "stacks": None, **extra}
+    return {"path": f"blackbox-r{rank}.json", "data": data}
+
+
+def test_verdict_wedged_collective():
+    b = _bundle(
+        records=[{"event": "fault", "kind": "peer-lost", "epoch": 8,
+                  "peer_rank": 1, "hard_deadline": True,
+                  "time_unix": 1_000_000.0}],
+        blackboxes=[_box("watchdog",
+                         annotation={"phase": "transition", "epoch": 8},
+                         stacks="# last breadcrumb: phase=transition",
+                         open_spans=[{"kind": "collective-enter",
+                                      "phase": "transition",
+                                      "epoch": 8}])])
+    v = postmortem.diagnose(b)
+    assert v["verdict"] == "wedged-collective"
+    assert v["confidence"] == pytest.approx(0.9)
+    assert v["deterministic"] is False
+    assert len(v["evidence"]) >= 3  # dump + stacks + fault + open span
+    assert any("peer-lost" in e for e in v["evidence"])
+    assert any("never exited" in e for e in v["evidence"])
+    validate_record(v)  # schema-v11 ``diagnosis`` kind
+
+
+def test_verdict_oom():
+    b = _bundle(log_tails={"rank-g0-m1.log":
+                           "E0807 RESOURCE_EXHAUSTED: Out of memory "
+                           "allocating 2.1G\n"})
+    v = postmortem.diagnose(b)
+    assert v["verdict"] == "oom" and v["deterministic"] is False
+    assert any("RESOURCE_EXHAUSTED" in e for e in v["evidence"])
+
+
+def test_verdict_fallback_exhausted_is_deterministic():
+    b = _bundle(
+        records=[{"event": "fallback", "from_impl": "block",
+                  "to_impl": "xla", "epoch": 4,
+                  "time_unix": 1_000_000.0}],
+        log_tails={"rank.log": "KernelFallbackError: every rung of the "
+                               "kernel fallback ladder failed\n"})
+    v = postmortem.diagnose(b)
+    assert v["verdict"] == "fallback-exhausted"
+    assert v["deterministic"] is True
+    assert any("fallback record" in e for e in v["evidence"])
+
+
+def test_verdict_corrupt_artifact_is_deterministic():
+    b = _bundle(log_tails={"sup.log": "CheckpointCorrupt: digest "
+                                      "mismatch for params/w0\n"})
+    v = postmortem.diagnose(b)
+    assert v["verdict"] == "corrupt-artifact"
+    assert v["deterministic"] is True
+
+
+def test_verdict_config_error_beats_generic_crash():
+    # reason="exception" also matches the crash rule (0.65): the
+    # config rule (0.8) must win the ranking
+    b = _bundle(blackboxes=[_box("exception",
+                                 error="ValueError: --n-partitions "
+                                       "must divide the mesh")])
+    v = postmortem.diagnose(b)
+    assert v["verdict"] == "config-error" and v["deterministic"] is True
+    cands = {c["verdict"] for c in v["candidates"]}
+    assert "crash" in cands  # considered, outranked
+
+
+def test_verdict_desync_and_storage_fault():
+    v = postmortem.diagnose(_bundle(
+        records=[{"event": "fault", "kind": "desync", "epoch": 6,
+                  "source_rank": 1, "time_unix": 1_000_000.0}]))
+    assert v["verdict"] == "desync"
+    assert v["confidence"] == pytest.approx(0.8)
+    v = postmortem.diagnose(_bundle(
+        records=[{"event": "fault", "kind": "io-degraded", "epoch": 5,
+                  "component": "checkpoint",
+                  "time_unix": 1_000_000.0}]))
+    assert v["verdict"] == "storage-fault"
+    assert v["confidence"] == pytest.approx(0.8)
+    assert v["deterministic"] is False
+
+
+def test_verdict_divergence_when_retries_exhausted():
+    b = _bundle(
+        records=[{"event": "fault", "kind": "divergence", "epoch": 9,
+                  "retry": 3, "reason": "nan-loss",
+                  "time_unix": 1_000_000.0}],
+        log_tails={"rank.log": "DivergenceError: retries were "
+                               "exhausted\n"})
+    v = postmortem.diagnose(b)
+    assert v["verdict"] == "divergence"
+    assert v["confidence"] == pytest.approx(0.85)
+
+
+def test_verdict_preemption_and_crash():
+    v = postmortem.diagnose(_bundle(blackboxes=[_box("preemption",
+                                                     epoch=12)]))
+    assert v["verdict"] == "preemption"
+    v = postmortem.diagnose(_bundle(
+        blackboxes=[_box("exception", error="RuntimeError: boom")],
+        log_tails={"r.log": "Traceback (most recent call last):\n"
+                            "RuntimeError: boom\n"}))
+    assert v["verdict"] == "crash" and v["deterministic"] is False
+
+
+def test_verdict_recompile_storm_needs_three_citations():
+    repad = [{"event": "stream", "seq": i, "repadded": True,
+              "epoch": 2 + i, "time_unix": 1_000_000.0 + i}
+             for i in range(3)]
+    assert postmortem.diagnose(
+        _bundle(records=repad))["verdict"] == "recompile-storm"
+    # two citations are not enough: stays unknown
+    assert postmortem.diagnose(
+        _bundle(records=repad[:2]))["verdict"] == "unknown"
+
+
+def test_clean_exit_beats_recovered_faults_but_not_later_dumps():
+    recovered = [
+        {"event": "fault", "kind": "divergence", "epoch": 5,
+         "time_unix": 1_000_000.0},
+        {"event": "recovery", "kind": "divergence", "epoch": 5,
+         "time_unix": 1_000_100.0},
+        {"event": "summary", "time_unix": 1_000_500.0},
+    ]
+    v = postmortem.diagnose(_bundle(records=recovered))
+    assert v["verdict"] == "clean-exit"
+    assert v["confidence"] == pytest.approx(0.9)
+    assert any("recovered" in e for e in v["evidence"])
+    # a dump NEWER than the last summary means something died after:
+    # clean-exit must stand down
+    v = postmortem.diagnose(_bundle(
+        records=recovered,
+        blackboxes=[_box("watchdog", t=1_000_900.0,
+                         stacks="# last breadcrumb: phase=transition")]))
+    assert v["verdict"] == "wedged-collective"
+    # ... but a trailing STALL dump is non-terminal by design (the
+    # detector captures stacks and the run keeps going): a completed
+    # run with one must still diagnose clean-exit
+    v = postmortem.diagnose(_bundle(
+        records=recovered,
+        blackboxes=[_box("stall", t=1_000_900.0)]))
+    assert v["verdict"] == "clean-exit"
+
+
+def test_unknown_on_empty_bundle_and_timeline_renders():
+    v = postmortem.diagnose(_bundle())
+    assert v["verdict"] == "unknown" and v["confidence"] == 0.0
+    assert v["deterministic"] is False and v["evidence"]
+    # timeline merges records and crumbs, newest-relative
+    b = _bundle(
+        records=[{"event": "epoch", "epoch": 3, "loss": 0.5,
+                  "time_unix": 1_000_000.0}],
+        blackboxes=[_box("watchdog", t=1_000_010.0,
+                         crumbs=[{"kind": "boundary", "epoch": 3,
+                                  "t": 1_000_005.0, "seq": 1}])])
+    v = postmortem.diagnose(b)
+    tl = v["timeline"]
+    assert any("epoch 3" in ln for ln in tl)
+    assert any("crumb boundary" in ln for ln in tl)
+    assert "BLACKBOX DUMP r0" in tl[-1]
+    text = postmortem.render(v)
+    assert "verdict:" in text and "last-minutes timeline:" in text
+
+
+def test_deterministic_classes_are_exactly_the_contract():
+    assert postmortem.DETERMINISTIC_CLASSES == (
+        "corrupt-artifact", "config-error", "fallback-exhausted")
+    for cls in postmortem.DETERMINISTIC_CLASSES:
+        assert any(name == cls for name, _ in postmortem._RULES)
+
+
+def test_broken_rule_cannot_kill_diagnosis(monkeypatch):
+    def _explode(b):
+        raise RuntimeError("rule bug")
+    monkeypatch.setattr(postmortem, "_RULES",
+                        [("exploder", _explode)]
+                        + list(postmortem._RULES))
+    b = _bundle(log_tails={"r.log": "RESOURCE_EXHAUSTED\n"})
+    v = postmortem.diagnose(b)
+    assert v["verdict"] == "oom"  # the healthy rules still ran
+
+
+def test_collect_bundle_tolerates_corrupt_artifacts(tmp_path):
+    (tmp_path / "blackbox-r0.json").write_text("{not json")
+    (tmp_path / "rank.log").write_text("x" * 10_000 + "\nlast line\n")
+    ml = MetricsLogger(str(tmp_path / "metrics.jsonl"))
+    ml.summary(4, 0.1, 0.5)
+    ml.close()
+    b = postmortem.collect_bundle(str(tmp_path))
+    assert b["blackboxes"][0].get("error")  # tolerated, not raised
+    assert len(b["log_tails"]["rank.log"]) <= 4001  # tail-bounded
+    assert b["log_tails"]["rank.log"].endswith("last line\n")
+    assert any(r.get("event") == "summary" for r in b["records"])
+    assert b["fingerprint"].get("schema_version")
+    assert postmortem.diagnose(b)["verdict"] == "clean-exit"
+
+
+# ---------------- explain CLI ------------------------------------------
+
+
+def test_explain_cli_diagnosed_and_unknown_exit_codes(tmp_path, capsys):
+    run = tmp_path / "run"
+    run.mkdir()
+    ml = MetricsLogger(str(run / "metrics.jsonl"))
+    ml.summary(4, 0.1, 0.7)
+    ml.close()
+    assert debug_cli.main(["explain", str(run)]) == 0
+    out = capsys.readouterr().out
+    assert "verdict: clean-exit" in out and "confidence 0.90" in out
+    # --json emits the contracted record
+    assert debug_cli.main(["explain", str(run), "--json"]) == 0
+    v = json.loads(capsys.readouterr().out)
+    validate_record(v)
+    assert v["verdict"] == "clean-exit"
+    # --out appends a schema-valid diagnosis record to a metrics sink
+    sink = tmp_path / "diag.jsonl"
+    assert debug_cli.main(["explain", str(run), "--json",
+                           "--out", str(sink)]) == 0
+    capsys.readouterr()
+    recs = read_metrics(sink)
+    assert recs and recs[-1]["event"] == "diagnosis"
+    validate_record(recs[-1])
+    # nothing to go on -> exit 4 (EXIT_UNKNOWN)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert debug_cli.main(["explain", str(empty)]) == debug_cli.EXIT_UNKNOWN
+    capsys.readouterr()
+    # not a directory -> usage error 1
+    assert debug_cli.main(["explain", str(tmp_path / "nope")]) == 1
+
+
+def test_debug_is_a_console_script():
+    with open(os.path.join(REPO, "pyproject.toml")) as fh:
+        text = fh.read()
+    assert 'pipegcn-debug = "pipegcn_tpu.cli.debug:main"' in text
+
+
+# ---------------- schema v11 drift pin ---------------------------------
+
+
+def test_schema_v11_blackbox_and_diagnosis_pin():
+    from pipegcn_tpu.obs import schema
+    if schema.SCHEMA_VERSION == 11:
+        assert set(schema.BLACKBOX_FIELDS) == {
+            "event", "rank", "reason", "crumbs", "last_crumb",
+            "open_spans", "stacks"}
+        assert set(schema.DIAGNOSIS_FIELDS) == {
+            "event", "verdict", "confidence", "evidence",
+            "remediation", "deterministic"}
+    else:
+        # growing the schema is fine; silently shrinking v11 is not
+        assert schema.SCHEMA_VERSION > 11
+    assert "blackbox" in schema._BY_EVENT
+    assert "diagnosis" in schema._BY_EVENT
+
+
+# ---------------- supervisor fail-fast gate ----------------------------
+
+
+class _FakeHandle:
+    def __init__(self, rc):
+        self.returncode = None
+        self._rc = rc
+
+    def poll(self):
+        self.returncode = self._rc
+        return self._rc
+
+    def send_signal(self, sig):
+        pass
+
+
+class _FakeFleet:
+    def __init__(self, rcs):
+        self.rcs = list(rcs)
+        self.launches = []
+
+    def popen(self, cmd, env, log_path):
+        self.launches.append(list(cmd))
+        return _FakeHandle(self.rcs.pop(0))
+
+
+def _sup(tmp_path, fleet, diagnose, max_restarts=5, monkeypatch=None):
+    from pipegcn_tpu.resilience.elastic import (ElasticConfig,
+                                                ElasticSupervisor)
+    argv = [
+        "--dataset", "synthetic:300:6:8:3",
+        "--n-partitions", "2", "--parts-per-node", "2",
+        "--n-epochs", "6", "--no-eval", "--fix-seed",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    cfg = ElasticConfig(max_restarts=max_restarts, backoff_base_s=0.0,
+                        backoff_max_s=0.0, poll_s=0.01,
+                        storm_threshold=1000)
+    sup = ElasticSupervisor(argv, cfg, popen=fleet.popen,
+                            log=lambda s: None)
+    monkeypatch.setattr(type(sup), "_diagnose_death",
+                        lambda self, gen, victim: diagnose(gen, victim))
+    return sup
+
+
+def test_supervisor_fails_fast_after_one_gated_retry(tmp_path,
+                                                     monkeypatch):
+    """A deterministic verdict gets exactly ONE relaunch; when the
+    retry dies the same way the supervisor stops HARD (rc 1, not 75)
+    with the verdict in the ledger — no burning --max-restarts."""
+    from pipegcn_tpu.resilience.elastic import MembershipLedger
+    fleet = _FakeFleet([-9] * 10)
+    seen = []
+
+    def diagnose(gen, victim):
+        seen.append((gen, victim))
+        return {"verdict": "config-error", "confidence": 0.8,
+                "deterministic": True, "evidence": ["e1"],
+                "remediation": "fix the flag"}
+
+    sup = _sup(tmp_path, fleet, diagnose, monkeypatch=monkeypatch)
+    assert sup.run() == 1
+    # gen 0 + the single gated retry (gen 1): two launches, not six
+    assert len(fleet.launches) == 2
+    assert seen == [(0, 0), (1, 0)]
+    led = MembershipLedger(sup.coord_dir)
+    final = led.latest()
+    assert final["trigger"] == "deterministic:config-error"
+    assert final["diagnosis"]["verdict"] == "config-error"
+    assert final["diagnosis"]["deterministic"] is True
+    # the retry generation's own record carries the diagnosis too
+    assert led.read(1)["diagnosis"]["verdict"] == "config-error"
+    recs = [r for r in read_metrics(
+        os.path.join(sup.coord_dir, "membership.jsonl"))
+        if r.get("event") == "membership"]
+    assert recs[-1]["trigger"] == "deterministic:config-error"
+    assert recs[-1]["diagnosis"] == "config-error"
+    for r in recs:
+        validate_record(r)
+
+
+def test_supervisor_transient_verdict_keeps_restart_policy(tmp_path,
+                                                           monkeypatch):
+    from pipegcn_tpu.resilience import EXIT_PREEMPTED
+    fleet = _FakeFleet([-9] * 10)
+
+    def diagnose(gen, victim):
+        return {"verdict": "crash", "confidence": 0.65,
+                "deterministic": False, "evidence": [],
+                "remediation": "read the cited error"}
+
+    sup = _sup(tmp_path, fleet, diagnose, max_restarts=2,
+               monkeypatch=monkeypatch)
+    assert sup.run() == EXIT_PREEMPTED
+    assert len(fleet.launches) == 3  # gens 0..2: the policy governed
+
+
+def test_supervisor_diagnosis_failure_is_not_fatal(tmp_path,
+                                                   monkeypatch):
+    from pipegcn_tpu.resilience import EXIT_PREEMPTED
+    fleet = _FakeFleet([-9] * 10)
+    sup = _sup(tmp_path, fleet, lambda g, v: None, max_restarts=1,
+               monkeypatch=monkeypatch)
+    assert sup.run() == EXIT_PREEMPTED  # policy path, no crash
+
+
+# ---------------- fault grammar: hang@E[:rN][:<ms>] --------------------
+
+
+def test_hang_grammar_parses_and_round_trips():
+    plan = FaultPlan.parse("hang@6:r1:250", rank=1)
+    assert plan.remaining() == ["hang@6:r1:250"]
+    assert plan.due_arg("hang", 6) == 250  # bounded stall, ms
+    assert plan.due_arg("hang", 6) is None  # single-shot
+    # unqualified ms arg
+    assert FaultPlan.parse("hang@3:250").due_arg("hang", 3) == 250
+    # no arg -> 0: the full wedge
+    assert FaultPlan.parse("hang@6:r1", rank=1).due_arg("hang", 6) == 0
+    # wrong rank never fires
+    assert FaultPlan.parse("hang@6:r1", rank=0).due_arg("hang", 9) is None
+    # slow-fs keeps its ms grammar
+    assert FaultPlan.parse("slow-fs@3:500").due_arg("slow-fs", 3) == 500
+
+
+def test_hang_grammar_rejections():
+    with pytest.raises(ValueError, match="only valid for"):
+        FaultPlan.parse("nan-loss@5:250")  # arg on a non-arg kind
+    with pytest.raises(ValueError, match="at most one"):
+        FaultPlan.parse("hang@6:250:9")
+    with pytest.raises(ValueError, match="bad fault-plan entry"):
+        FaultPlan.parse("hang@6:r1:250:9")
+
+
+# ---------------- observability surfaces -------------------------------
+
+
+def test_aggregator_counts_dumps_and_exports_gauge(tmp_path):
+    rec = FlightRecorder(capacity=8, rank=0, enabled=True)
+    rec.crumb("x")
+    rec.dump("stall", directory=str(tmp_path))
+    sub = tmp_path / "coord"
+    sub.mkdir()
+    rec2 = FlightRecorder(capacity=8, rank=1, enabled=True)
+    rec2.crumb("y")
+    rec2.dump("watchdog", directory=str(sub))
+    ml = MetricsLogger(str(tmp_path / "metrics.jsonl"))
+    ml.diagnosis(verdict="wedged-collective", confidence=0.9,
+                 evidence=["e"], remediation="r", deterministic=False)
+    ml.close()
+    agg = LiveAggregator(str(tmp_path))
+    agg.poll()
+    assert agg.n_blackbox_dumps == 2  # recursive: subdirs count too
+    snap = agg.snapshot()
+    assert snap["n_blackbox_dumps"] == 2
+    src = next(iter(snap["diagnosis"]))
+    assert snap["diagnosis"][src]["verdict"] == "wedged-collective"
+    text = prometheus_text(agg)
+    assert "pipegcn_blackbox_dumps_total 2" in text
+    assert ('pipegcn_diagnosis_confidence{deterministic="false",'
+            'source="metrics",verdict="wedged-collective"} 0.9') in text
+
+
+def test_report_surfaces_diagnosis(tmp_path):
+    import io
+    buf = io.StringIO()
+    ml = MetricsLogger(buf)
+    ml.diagnosis(verdict="storage-fault", confidence=0.8,
+                 evidence=["fault record: io-degraded at epoch 5"],
+                 remediation="free space, then --resume",
+                 deterministic=False)
+    ml.close()
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    recs.append({"event": "blackbox", "rank": 0, "reason": "stall",
+                 "crumbs": [], "last_crumb": None, "open_spans": [],
+                 "stacks": None})
+    s = summarize_run(recs)
+    assert s["diagnosis_verdict"] == "storage-fault"
+    assert s["diagnosis_confidence"] == pytest.approx(0.8)
+    assert s["diagnosis_deterministic"] is False
+    assert s["diagnosis_remediation"] == "free space, then --resume"
+    assert s["n_blackbox_records"] == 1
+    assert s["blackbox_reasons"] == {"stall": 1}
+
+
+def test_soak_expected_classes_and_check_diagnosis(tmp_path):
+    assert expected_classes(["hang@6:r1", "enospc@5"]) == [
+        "storage-fault", "wedged-collective"]
+    assert expected_classes(["corrupt-ckpt@4"]) == ["corrupt-artifact"]
+    assert expected_classes([]) == ["crash"]
+    assert expected_classes(["made-up@1"]) == ["crash"]
+    # green episode: a summary record must diagnose clean-exit
+    ml = MetricsLogger(str(tmp_path / "metrics.jsonl"))
+    ml.summary(6, 0.1, 0.6)
+    ml.close()
+    inv = check_diagnosis(str(tmp_path), "green", ["nan-loss@5"])
+    assert inv["ok"] is True and inv["verdict"] == "clean-exit"
+    # red episode whose artifacts say corrupt-artifact, as scheduled
+    red = tmp_path / "red"
+    red.mkdir()
+    (red / "rank.log").write_text(
+        "CheckpointCorrupt: digest mismatch for params/w0\n")
+    inv = check_diagnosis(str(red), "red", ["corrupt-ckpt@4"])
+    assert inv["ok"] is True and inv["verdict"] == "corrupt-artifact"
+    assert inv["deterministic"] is True
+    # mismatch is reported, not raised
+    inv = check_diagnosis(str(red), "red", ["sigterm@8"])
+    assert inv["ok"] is False and "not in" in inv["error"]
+
+
+def test_recorder_is_host_side_only():
+    """Steady-state cost pin: recording crumbs/spans and dumping must
+    not trigger a single trace — the serving engine's compile counters
+    are the canary."""
+    from pipegcn_tpu.serve.engine import trace_counts
+    c0 = dict(trace_counts())
+    rec = FlightRecorder(capacity=64, enabled=True)
+    for i in range(200):
+        with rec.span("dispatch", epoch=i):
+            rec.crumb("boundary", epoch=i)
+    flight.capture_stacks(rec)
+    assert dict(trace_counts()) == c0
+
+
+# ---------------- the two-process hang drill (faults + slow) -----------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_rank(rank, port, tmp_path, extra, n_epochs, env_extra=None):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO,
+        "PYTHONUNBUFFERED": "1",
+        **(env_extra or {}),
+    }
+    cmd = [
+        sys.executable, os.path.join(REPO, "main.py"),
+        "--dataset", "synthetic:400:6:8:3",
+        "--n-partitions", "2", "--parts-per-node", "1",
+        "--node-rank", str(rank),
+        "--master-addr", "127.0.0.1", "--port", str(port),
+        "--n-epochs", str(n_epochs), "--n-hidden", "16",
+        "--dropout", "0.0", "--log-every", "1000",
+        "--fix-seed", "--seed", "7", "--no-eval",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--model-dir", str(tmp_path / f"model{rank}"),
+        "--results-dir", str(tmp_path / f"results{rank}"),
+        "--metrics-out", str(tmp_path / f"metrics{rank}.jsonl"),
+    ] + extra
+    return subprocess.Popen(cmd, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _communicate(proc, timeout):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        out = (out or "") + "\n<<TIMED OUT>>"
+    return out
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_two_process_hang_drill_leaves_dumps_and_diagnoses(tmp_path):
+    """Acceptance: ``hang@6:r1`` wedges rank 1 inside a fake collective
+    (heartbeats suspended). Rank 1's stall detector (PIPEGCN_STALL_S)
+    dumps stacks naming the wedged phase WHILE STILL WEDGED; the
+    survivor's watchdog then converts its own dead collective into
+    exit 75 + a watchdog dump. (When the leader exits, the wedged
+    rank's jax runtime hard-aborts within milliseconds — the stall
+    dump is already durable by then, which is exactly why the
+    sub-watchdog path exists.) BOTH ranks leave
+    ``blackbox-r<k>.json`` and ``pipegcn-debug explain`` over the run
+    dir returns ``wedged-collective`` citing >= 3 artifacts."""
+    from pipegcn_tpu.resilience import EXIT_PREEMPTED
+    port = _free_port()
+    wd_timeout = 6.0
+    coord = tmp_path / "coord"
+    flags = ["--checkpoint-dir", str(tmp_path / "ck"),
+             "--checkpoint-every", "2000",
+             "--watchdog-timeout", str(wd_timeout),
+             "--watchdog-dir", str(coord),
+             "--sentinel-snapshot-every", "10",
+             "--fault-plan", "hang@6:r1"]
+    procs = [_spawn_rank(r, port, tmp_path, flags, n_epochs=200000,
+                         env_extra={"PIPEGCN_STALL_S": "2"})
+             for r in (0, 1)]
+    try:
+        out0 = _communicate(procs[0], timeout=wd_timeout * 10 + 120)
+        out1 = _communicate(procs[1], timeout=wd_timeout * 10 + 120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert "fault-injected hang at epoch 6" in out1, out1[-3000:]
+    assert procs[0].returncode == EXIT_PREEMPTED, \
+        f"rank 0 exited {procs[0].returncode}:\n{out0[-3000:]}"
+    # the wedged rank dies abnormally (jax hard-abort once the leader
+    # is gone) — the point is that its forensics are already on disk
+    assert procs[1].returncode != 0, out1[-3000:]
+    # BOTH ranks left a black box
+    for r in (0, 1):
+        box = coord / f"blackbox-r{r}.json"
+        assert box.exists(), \
+            f"missing {box}; coord dir: {os.listdir(coord)}"
+        payload = json.loads(box.read_text())
+        validate_record(payload)
+        assert payload["stacks"]
+    # the survivor's dump is the watchdog trip
+    p0 = json.loads((coord / "blackbox-r0.json").read_text())
+    assert p0["reason"] == "watchdog"
+    # the wedged rank's stall dump names the hung phase and epoch
+    p1 = json.loads((coord / "blackbox-r1.json").read_text())
+    assert p1["reason"] == "stall"
+    assert any(sp.get("kind") == "collective-enter"
+               and sp.get("phase") == "fault-hang"
+               and sp.get("epoch") == 6
+               for sp in p1["open_spans"]), p1["open_spans"]
+    assert "phase=fault-hang" in p1["stacks"]
+    # the explain CLI reaches the verdict with >= 3 evidence citations
+    proc = subprocess.run(
+        [sys.executable, "-m", "pipegcn_tpu.cli.debug", "explain",
+         str(tmp_path), "--json"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    v = json.loads(proc.stdout)
+    assert v["verdict"] == "wedged-collective"
+    assert v["confidence"] >= 0.9
+    assert len(v["evidence"]) >= 3
+    assert v["deterministic"] is False  # restartable, not fail-fast
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_single_process_bounded_stall_dumps_without_dying(tmp_path):
+    """``hang@2:300`` (ms-bounded) + PIPEGCN_STALL_S: the stall
+    detector leaves a reason="stall" dump while the run completes
+    rc=0 — sub-watchdog forensics, no death."""
+    coord = tmp_path / "coord"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PYTHONPATH": REPO,
+        "PIPEGCN_STALL_S": "0.15",
+    }
+    cmd = [
+        sys.executable, os.path.join(REPO, "main.py"),
+        "--dataset", "synthetic:120:4:8:3",
+        "--n-partitions", "2", "--parts-per-node", "2",
+        "--n-epochs", "4", "--n-hidden", "8", "--dropout", "0.0",
+        "--fix-seed", "--seed", "7", "--no-eval",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--model-dir", str(tmp_path / "model"),
+        "--results-dir", str(tmp_path / "results"),
+        "--watchdog-dir", str(coord),
+        "--fault-plan", "hang@2:300",
+    ]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=420,
+                          capture_output=True, text=True)
+    tail = (proc.stdout + proc.stderr)[-3000:]
+    assert proc.returncode == 0, tail
+    assert "fault-injected 300 ms stall at epoch 2" in proc.stdout
+    box = coord / "blackbox-r0.json"
+    assert box.exists(), os.listdir(coord)
+    payload = json.loads(box.read_text())
+    validate_record(payload)
+    assert payload["reason"] == "stall"
+    crumbs = [c["kind"] for c in payload["crumbs"]]
+    assert "stall-injected" in crumbs
