@@ -363,6 +363,18 @@ def main():
     ap.add_argument("--serve-max-queue", type=int, default=0,
                     help="bound on queued query rows (overload sheds "
                          "tickets); 0 = unbounded")
+    ap.add_argument("--traffic", type=str, default="",
+                    help="with --serve: shaped arrival schedule "
+                         "(constant | diurnal[:period[:floor]] | "
+                         "flash-crowd[:mult[:t0[:t1]]] | trace:<path>); "
+                         "empty = constant-rate Poisson")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="with --serve: close the loop — run the fleet "
+                         "under the scale policy (spawn/retire replicas "
+                         "from window telemetry) with the graceful-"
+                         "degradation admission ladder; headline shows "
+                         "replica count tracking load (implies "
+                         "--replicas 1 when unset)")
     ap.add_argument("--stream", action="store_true",
                     help="measure streaming-graph delta ingestion "
                          "instead of training throughput: per-delta "
@@ -547,6 +559,9 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
         lane_pad=args.lane_pad,
     )
     if getattr(args, "serve", False):
+        if getattr(args, "autoscale", False) \
+                and getattr(args, "replicas", 0) == 0:
+            args.replicas = 1  # autoscale needs the fleet path
         if getattr(args, "replicas", 0) > 0:
             return _measure_fleet(args, backend, device_kind, n_parts,
                                   degraded, sg, cfg)
@@ -1494,13 +1509,32 @@ def _measure_fleet(args, backend, device_kind, n_parts, degraded, sg,
     timer.start()
 
     num_nodes = int((np.asarray(sg.global_nid) >= 0).sum())
+    # --autoscale: bounded queue + degradation ladder + scale policy;
+    # cooldown of two report windows is the ramp rate on a short bench
+    autoscaler = None
+    ladder = None
+    max_queue = args.serve_max_queue or None
+    if getattr(args, "autoscale", False):
+        from pipegcn_tpu.serve.autoscale import AutoscalePolicy
+        from pipegcn_tpu.serve.batcher import AdmissionLadder
+
+        max_queue = args.serve_max_queue or 4 * args.serve_max_batch
+        ladder = AdmissionLadder()
+        autoscaler = AutoscalePolicy(
+            min_replicas=1,
+            max_replicas=max(4, args.replicas),
+            queue_high=max_queue // 2,
+            queue_low=max(1, max_queue // 8),
+            cooldown_s=4.0)
     try:
         summary = run_fleet_loop(
             manager, router, num_nodes=num_nodes,
             duration_s=args.serve_secs, qps=args.serve_qps,
             max_batch=args.serve_max_batch,
             max_delay_ms=args.serve_max_delay_ms,
-            max_queue=args.serve_max_queue or None,
+            max_queue=max_queue,
+            traffic=args.traffic or None,
+            ladder=ladder, autoscaler=autoscaler,
             seed=0, ml=ml)
     finally:
         timer.cancel()
@@ -1554,6 +1588,18 @@ def _measure_fleet(args, backend, device_kind, n_parts, degraded, sg,
         "conserved": summary["conserved"],
         "drained": summary["drained"],
     }
+    if getattr(args, "traffic", ""):
+        result["traffic"] = summary.get("traffic")
+    if autoscaler is not None:
+        result.update({
+            "autoscale": summary.get("autoscale"),
+            "replicas_active": summary.get("replicas_active"),
+            "n_spawned": summary.get("n_spawned"),
+            "n_retired": summary.get("n_retired"),
+            "scale_events": summary.get("scale_events"),
+            "shed_by_reason": summary.get("shed_by_reason"),
+            "rung_max": summary.get("rung_max"),
+        })
     if degraded:
         result["degraded"] = True
     if ml is not None:

@@ -69,6 +69,46 @@ def build_parser():
     g.add_argument("--fleet-ready-timeout", "--fleet_ready_timeout",
                    type=float, default=180.0,
                    help="seconds to wait for a replica's readiness file")
+    a = p.add_argument_group("autoscale")
+    a.add_argument("--autoscale", action="store_true",
+                   help="close the loop: feed each window's telemetry "
+                        "(+ alert fire edges) to the scale policy "
+                        "(serve/autoscale.py) and let it spawn/retire "
+                        "replicas between --autoscale-min/max; turns "
+                        "on the graceful-degradation admission ladder")
+    a.add_argument("--autoscale-min", "--autoscale_min", type=int,
+                   default=1, help="replica floor under scale-down")
+    a.add_argument("--autoscale-max", "--autoscale_max", type=int,
+                   default=0,
+                   help="replica ceiling under scale-up "
+                        "(0 = max(4, --replicas))")
+    a.add_argument("--autoscale-queue-high", "--autoscale_queue_high",
+                   type=int, default=0,
+                   help="queue rows that count as sustained pressure "
+                        "(0 = half of --serve-max-queue, else 64)")
+    a.add_argument("--autoscale-queue-low", "--autoscale_queue_low",
+                   type=int, default=0,
+                   help="queue rows below which a window counts as "
+                        "idle (0 = an eighth of --serve-max-queue, "
+                        "else 8)")
+    a.add_argument("--autoscale-shed-high", "--autoscale_shed_high",
+                   type=float, default=0.01,
+                   help="window shed fraction that triggers an "
+                        "immediate scale-up")
+    a.add_argument("--autoscale-p99-slo", "--autoscale_p99_slo",
+                   type=float, default=0.0,
+                   help="p99 latency SLO in ms; sustained violation "
+                        "triggers scale-up (0 = no latency trigger)")
+    a.add_argument("--autoscale-cooldown", "--autoscale_cooldown",
+                   type=float, default=10.0,
+                   help="seconds between executed scale actions (the "
+                        "anti-flap brake and the ramp rate)")
+    a.add_argument("--degrade-ladder", "--degrade_ladder",
+                   action="store_true",
+                   help="graceful-degradation admission ladder without "
+                        "autoscaling: tighten the effective queue "
+                        "bound and ticket deadline as pressure rises "
+                        "(brownout before blackout)")
     return p
 
 
@@ -184,6 +224,49 @@ def _driver_main(args, argv) -> int:
     if getattr(args, "fault_plan", None):
         fault_plan = FaultPlan.parse(args.fault_plan)
 
+    # ---- the closed loop: telemetry -> policy -> fleet actuation ----
+    autoscaler = None
+    ladder = None
+    alerts_fn = None
+    if args.autoscale or args.degrade_ladder:
+        from ..serve.batcher import AdmissionLadder
+
+        ladder = AdmissionLadder()
+    if args.autoscale:
+        from ..serve.autoscale import AutoscalePolicy
+
+        max_q = args.serve_max_queue or 0
+        q_high = args.autoscale_queue_high or (max_q // 2 if max_q
+                                               else 64)
+        q_low = args.autoscale_queue_low or max(1, (max_q // 8
+                                                    if max_q else 8))
+        autoscaler = AutoscalePolicy(
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max or max(4, args.replicas),
+            queue_high=q_high, queue_low=q_low,
+            shed_high=args.autoscale_shed_high,
+            p99_slo_ms=args.autoscale_p99_slo or None,
+            cooldown_s=args.autoscale_cooldown)
+        if ml is not None:
+            # the AlertEngine leg of the loop: tail the driver's own
+            # metrics stream (plus any sibling streams in its dir) and
+            # surface fire edges as policy evidence
+            import time as _time
+
+            from ..obs.health import AlertEngine
+            from ..obs.live import LiveAggregator
+
+            _agg = LiveAggregator(
+                os.path.dirname(os.path.abspath(args.metrics_out))
+                or ".", clock=_time.time)
+            _alert_engine = AlertEngine(clock=_time.time)
+
+            def alerts_fn():
+                _agg.poll()
+                edges = _alert_engine.evaluate(_agg)
+                return [e["rule"] for e in edges
+                        if e.get("state") == "fire"]
+
     stop_flag = {"stop": False}
 
     def _on_signal(signum, frame):  # noqa: ARG001
@@ -206,6 +289,11 @@ def _driver_main(args, argv) -> int:
             seed=args.seed,
             ml=ml,
             fault_plan=fault_plan,
+            traffic=args.traffic or None,
+            update_fraction=args.update_fraction,
+            ladder=ladder,
+            autoscaler=autoscaler,
+            alerts_fn=alerts_fn,
             trace_sample_rate=args.trace_sample_rate,
             stop=lambda: stop_flag["stop"],
         )

@@ -69,6 +69,17 @@ def build_parser():
                    "--serve_ticket_deadline_ms", type=float, default=0.0,
                    help="shed tickets that waited past this deadline "
                         "at flush time. 0 = no deadline")
+    g.add_argument("--traffic", type=str, default="",
+                   help="shaped open-loop arrival schedule "
+                        "(serve/loadgen.RateShape): constant | "
+                        "diurnal[:period[:floor]] | "
+                        "flash-crowd[:mult[:t0[:t1]]] | trace:<path>. "
+                        "Empty = legacy constant-rate Poisson")
+    g.add_argument("--update-fraction", "--update_fraction",
+                   type=float, default=0.0,
+                   help="fraction of arrivals that are feature UPDATES "
+                        "instead of queries (mixed workload; seeded "
+                        "per arrival). 0 = query-only")
     g.add_argument("--trace-sample-rate", "--trace_sample_rate",
                    type=float, default=0.0,
                    help="fraction of submitted queries that mint a "
@@ -242,6 +253,8 @@ def main(argv=None) -> int:
             update_rows=args.serve_update_rows,
             seed=args.seed,
             ml=ml,
+            traffic=args.traffic or None,
+            update_fraction=args.update_fraction,
             max_queue=args.serve_max_queue or None,
             ticket_deadline_ms=args.serve_ticket_deadline_ms or None,
             trace_sample_rate=args.trace_sample_rate,

@@ -313,6 +313,20 @@ def prometheus_text(agg: LiveAggregator,
               rec.get("param_generation"), lab)
         gauge("pipegcn_param_staleness", rec.get("param_staleness"), lab)
         gauge("pipegcn_topo_generation", rec.get("topo_generation"), lab)
+        # fleet-path extras (run_fleet_loop): replica count + per-
+        # replica in-flight queue depth + degradation rung, so a
+        # /metrics scrape shows the autoscale control loop acting
+        gauge("pipegcn_replica_count", rec.get("replicas_up"), lab)
+        gauge("pipegcn_degradation_rung", rec.get("rung"), lab)
+        rqd = rec.get("replica_queue_depth")
+        if isinstance(rqd, dict):
+            for rep, depth in sorted(rqd.items()):
+                gauge("pipegcn_replica_queue_depth", depth,
+                      {"source": src, "replica": str(rep)})
+    for action, n in sorted(getattr(agg, "autoscale_counts",
+                                    {}).items()):
+        gauge("pipegcn_autoscale_decisions_total", n,
+              {"direction": action}, mtype="counter")
     for reason, rows in sorted(agg.shed_by_reason.items()):
         gauge("pipegcn_serving_shed_rows_total", rows,
               {"reason": reason}, mtype="counter")

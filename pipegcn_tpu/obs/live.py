@@ -211,6 +211,7 @@ class LiveAggregator:
       counts[(source, kind)]  how many arrived
       fault_counts[kind] / recovery_counts[kind]   run-wide
       shed_by_reason[reason]  run-wide shed row totals
+      autoscale_counts[action]  run-wide scale-decision totals
       last_seen[source]       clock time a record last ARRIVED — the
                               silent-source alert's input
       epoch_times[source]     recent step_time_s history (regression
@@ -231,6 +232,10 @@ class LiveAggregator:
         self.fault_counts: Dict[str, int] = {}
         self.recovery_counts: Dict[str, int] = {}
         self.shed_by_reason: Dict[str, int] = {}
+        # autoscale decision counts keyed by action (scale-up |
+        # scale-down | refuse) — the exporter's
+        # pipegcn_autoscale_decisions_total{direction} input
+        self.autoscale_counts: Dict[str, int] = {}
         self.last_seen: Dict[str, float] = {}
         self.epoch_times: Dict[str, List[float]] = {}
         self.n_records = 0
@@ -294,6 +299,9 @@ class LiveAggregator:
         elif kind == "recovery":
             k = str(rec.get("kind"))
             self.recovery_counts[k] = self.recovery_counts.get(k, 0) + 1
+        elif kind == "autoscale":
+            a = str(rec.get("action"))
+            self.autoscale_counts[a] = self.autoscale_counts.get(a, 0) + 1
         elif kind == "serving":
             by = rec.get("shed_by_reason")
             if isinstance(by, dict):
@@ -345,6 +353,7 @@ class LiveAggregator:
             "fault_counts": dict(self.fault_counts),
             "recovery_counts": dict(self.recovery_counts),
             "shed_by_reason": dict(self.shed_by_reason),
+            "autoscale_counts": dict(self.autoscale_counts),
         }
         if diagnosis:
             # the latest postmortem verdict per stream (obs/

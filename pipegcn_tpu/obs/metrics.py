@@ -421,6 +421,28 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def autoscale(self, action: str, reason: str, window: int,
+                  n_replicas: int, target: int,
+                  evidence: Dict[str, Any], **extra) -> Dict[str, Any]:
+        """One autoscaler decision (serve/autoscale.py): an executed
+        scale-up/scale-down proposal or a brake refusal, with the
+        triggering telemetry snapshot as evidence. Hard-flushed — the
+        decision ledger is what the soak harness's replica-trajectory
+        invariant replays, so it must survive a crash mid-scale."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "autoscale",
+            "action": str(action),
+            "reason": str(reason),
+            "window": int(window),
+            "n_replicas": int(n_replicas),
+            "target": int(target),
+            "evidence": dict(evidence),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def stream(self, epoch: int, seq: int, edges_added: int,
                edges_deleted: int, nodes_added: int, patch_ms: float,
                tables_rebuilt: int, repadded: bool,

@@ -17,7 +17,9 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 11  # v11: blackbox record kind (flight-recorder crash
+SCHEMA_VERSION = 12  # v12: autoscale record kind (closed-loop scale
+#                      decisions with triggering evidence)
+#                 v11: blackbox record kind (flight-recorder crash
 #                          dumps, obs/flight.py) + diagnosis record kind
 #                          (postmortem verdicts, obs/postmortem.py +
 #                          pipegcn-debug) — docs/OBSERVABILITY.md
@@ -380,6 +382,29 @@ DIAGNOSIS_FIELDS: Dict[str, str] = {
     "deterministic": "boolean",    # fail fast vs restart-and-hope
 }
 
+# one record per autoscaler DECISION tick that proposed or refused a
+# scale action (serve/autoscale.py, executed by cli/fleet.py's
+# FleetManager; docs/SERVING.md "Autoscaling & overload"). Hold ticks
+# with nothing to say are NOT recorded — only scale-up | scale-down
+# (executed proposals) and refuse (a proposal the brakes vetoed:
+# cooldown | storm-brake | max-replicas | min-replicas) land, so the
+# stream is the audit ledger of every actuation and every veto.
+# evidence carries the triggering telemetry snapshot (queue_depth,
+# shed_rate, p99_ms, staleness, firing alert rules, sustain/idle tick
+# counts) so a postmortem can replay WHY from the record alone.
+AUTOSCALE_FIELDS: Dict[str, str] = {
+    "event": "string",             # "autoscale"
+    "action": "string",            # scale-up | scale-down | refuse
+    "reason": "string",            # queue-pressure | shed-rate | p99-slo
+    #                              # | alert:<rule> | idle | cooldown |
+    #                              # | storm-brake | max-replicas | ...
+    "window": "integer",           # serving report window index
+    "n_replicas": "integer",       # fleet size when the decision fired
+    "target": "integer",           # proposed fleet size (== n_replicas
+    #                              # on refuse)
+    "evidence": "object",          # triggering telemetry snapshot
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -402,6 +427,7 @@ _BY_EVENT = {
     "span": SPAN_FIELDS,
     "blackbox": BLACKBOX_FIELDS,
     "diagnosis": DIAGNOSIS_FIELDS,
+    "autoscale": AUTOSCALE_FIELDS,
 }
 
 _JSON_TYPES = {

@@ -75,6 +75,22 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
   slow-fs       ``slow-fs@E:<ms>``: every durable-write seam op sleeps
                 <ms> milliseconds over the window — a degraded shared
                 filesystem; nothing fails, progress just crawls
+  net-delay     ``net-delay@W[:mK]:<ms>``: from serving report window W
+                every RPC the driver sends replica K (default 0) is
+                delayed <ms> milliseconds at the TcpReplicaClient seam
+                for one report window — a slow peer the router must
+                absorb via its retry budget, not mark dead. Inert in
+                the trainer; the fleet driver reads it via
+                :meth:`due_member_arg`
+  net-drop      ``net-drop@W[:mK]``: the NEXT RPC to replica K raises a
+                connection error (one-shot) — a dropped packet/reset
+                the router's retry-with-backoff path must ride out
+  net-partition ``net-partition@W:<s>``: replica K (default 0) becomes
+                unreachable — every RPC errors — for <s> SECONDS, then
+                heals; the process stays alive and heartbeating the
+                whole time. Exercises router mark-down + the fleet
+                poll's health-probe reconciliation that routes the
+                healthy-again peer back in (no relaunch involved)
 
 The optional ``:rN`` qualifier targets one rank (``jax.process_index``)
 so multi-process chaos drills can kill, desynchronize, or hang a single
@@ -107,13 +123,15 @@ from .storage import IO_KINDS
 
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
          "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin",
-         "replica-kill", "graph-delta") + IO_KINDS
+         "replica-kill", "graph-delta", "net-delay", "net-drop",
+         "net-partition") + IO_KINDS
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire.
 # IO kinds arm at the boundary and disarm by the next checkpoint
 # boundary, so a resume past the arming epoch has outlived them too.
 _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
-                   "kill", "replica-kill", "graph-delta") + IO_KINDS
+                   "kill", "replica-kill", "graph-delta", "net-delay",
+                   "net-drop", "net-partition") + IO_KINDS
 
 # the optional third group is 'r<N>' (rank), 'm<K>' (member), or a bare
 # number — the per-kind argument (slow-fs / hang: milliseconds). A
@@ -122,7 +140,8 @@ _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
 _ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm]?)(\d+))?(?::(\d+))?$")
 
 # kinds whose entries may carry a bare numeric argument
-_ARG_KINDS = ("slow-fs", "hang")
+# (slow-fs / hang / net-delay: milliseconds; net-partition: seconds)
+_ARG_KINDS = ("slow-fs", "hang", "net-delay", "net-partition")
 
 
 @dataclasses.dataclass
@@ -250,6 +269,19 @@ class FaultPlan:
             if not e.consumed and e.kind == kind and e.epoch <= window:
                 e.consumed = True
                 return e.member if e.member is not None else 0
+        return None
+
+    def due_member_arg(self, kind: str, window: int):
+        """Like :meth:`due_member`, but returns ``(member, arg)`` —
+        both defaulting to 0 — for the net-fault kinds that target a
+        replica AND carry a numeric argument (``net-delay@W[:mK]:<ms>``,
+        ``net-partition@W:<s>``). Consuming; None when nothing is
+        due."""
+        for e in self._entries:
+            if not e.consumed and e.kind == kind and e.epoch <= window:
+                e.consumed = True
+                return (e.member if e.member is not None else 0,
+                        e.arg if e.arg is not None else 0)
         return None
 
     def due_arg(self, kind: str, epoch: int) -> Optional[int]:

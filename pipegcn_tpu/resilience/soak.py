@@ -22,6 +22,14 @@ structural invariants over the artifacts left behind:
   tickets     (``serve`` episodes only) the serving fleet drill's
               summary reports conserved=drained=True — zero accepted
               tickets lost (serve/fleet.py)
+  autoscale   (``autoscale`` episodes only) the closed-loop drill —
+              flash-crowd traffic over a 1-replica fleet with one
+              replica-kill and one mid-crowd net-partition — ends with
+              the replica-count trajectory matching the ledger's
+              spawn/retire records one-to-one with the ``autoscale``
+              decision records, at least one crowd-provoked scale-up,
+              and tickets conserved through the scale events
+              (serve/autoscale.py, check_autoscale)
   resume      the final clean ``--resume`` exits 0 and reaches
               n_epochs
   diagnosis   the automated postmortem (obs/postmortem.py) over the
@@ -99,6 +107,12 @@ class SoakConfig:
     force_faults: Tuple[str, ...] = ()
     # adds the serving-fleet ticket-conservation drill to each episode
     serve: bool = False
+    # adds the closed-loop autoscale drill: flash-crowd traffic over a
+    # 1-replica fleet with --autoscale, one replica-kill and one mid-
+    # crowd net-partition; invariant #7 (check_autoscale) demands the
+    # replica-count trajectory match the ledger's spawn/retire records
+    # and ticket conservation hold through the scale events
+    autoscale: bool = False
     max_restarts: int = 6
     episode_timeout_s: float = 900.0
     keep_dirs: bool = False  # keep green episode dirs for inspection
@@ -297,6 +311,76 @@ def check_tickets(fleet_summary: Optional[Dict]) -> Dict:
                 n_shed=fleet_summary.get("n_shed"))
 
 
+def check_autoscale(fleet_summary: Optional[Dict],
+                    fleet_jsonl: str,
+                    initial_replicas: int = 1) -> Dict:
+    """Invariant #7 (``autoscale`` episodes): the replica-count
+    trajectory is explained by the ledger — every ``spawn``/``retire``
+    fleet record pairs with a ``scale-up``/``scale-down`` autoscale
+    decision record, the final active count equals
+    ``initial + spawns - retires``, the flash crowd provoked at least
+    one scale-up, and ticket conservation held through the scale
+    events. Vacuously green when the episode did not run the drill."""
+    if fleet_summary is None:
+        return _inv(False, error="autoscale drill crashed (no summary)")
+    spawns = retires = ups = downs = 0
+    if os.path.exists(fleet_jsonl):
+        with open(fleet_jsonl, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                ev = rec.get("event")
+                if ev == "fleet":
+                    if rec.get("kind") == "spawn":
+                        spawns += 1
+                    elif rec.get("kind") == "retire":
+                        retires += 1
+                elif ev == "autoscale":
+                    if rec.get("action") == "scale-up":
+                        ups += 1
+                    elif rec.get("action") == "scale-down":
+                        downs += 1
+    detail = dict(spawns=spawns, retires=retires,
+                  decisions_up=ups, decisions_down=downs,
+                  n_spawned=fleet_summary.get("n_spawned"),
+                  n_retired=fleet_summary.get("n_retired"),
+                  replicas_active=fleet_summary.get("replicas_active"),
+                  conserved=fleet_summary.get("conserved"),
+                  drained=fleet_summary.get("drained"),
+                  n_submitted=fleet_summary.get("n_submitted"),
+                  n_served=fleet_summary.get("n_served"),
+                  n_shed=fleet_summary.get("n_shed"))
+    errors = []
+    if spawns != fleet_summary.get("n_spawned"):
+        errors.append(f"ledger spawns {spawns} != summary "
+                      f"{fleet_summary.get('n_spawned')}")
+    if retires != fleet_summary.get("n_retired"):
+        errors.append(f"ledger retires {retires} != summary "
+                      f"{fleet_summary.get('n_retired')}")
+    if ups != spawns:
+        errors.append(f"scale-up decisions {ups} != spawns {spawns}")
+    if downs != retires:
+        errors.append(f"scale-down decisions {downs} != retires "
+                      f"{retires}")
+    want = initial_replicas + spawns - retires
+    if fleet_summary.get("replicas_active") != want:
+        errors.append(f"replicas_active "
+                      f"{fleet_summary.get('replicas_active')} != "
+                      f"{initial_replicas} + {spawns} - {retires}")
+    if spawns < 1:
+        errors.append("flash crowd provoked no scale-up")
+    if not (fleet_summary.get("conserved") is True
+            and fleet_summary.get("drained") is True
+            and fleet_summary.get("n_submitted")
+            == fleet_summary.get("n_served", 0)
+            + fleet_summary.get("n_shed", 0)):
+        errors.append("tickets not conserved through scale events")
+    return _inv(not errors, **detail,
+                **({"error": "; ".join(errors)} if errors else {}))
+
+
 # ---------------------------------------------------------------------
 # episode driver
 # ---------------------------------------------------------------------
@@ -386,6 +470,57 @@ def _run_fleet_drill(cfg: SoakConfig, episode: int, ep_dir: str,
     return json.loads(tails[-1])
 
 
+def _run_autoscale_drill(cfg: SoakConfig, episode: int, ep_dir: str,
+                         log: Callable[[str], None]) -> Optional[Dict]:
+    """Closed-loop autoscale drill: a 1-replica fleet under a
+    flash-crowd arrival schedule with --autoscale, plus one
+    replica-kill (the lone replica, pre-crowd — queue pressure during
+    the relaunch is what provokes the scale-up) and one mid-crowd
+    net-partition. Windows are 0.5 s wide; the kill/partition windows
+    are drawn deterministically from the episode seed. Returns the
+    driver's summary dict (None on a crash, which check_autoscale
+    turns red)."""
+    rng = random.Random(episode_seed(cfg, episode) ^ 0xA5CA)
+    kill_w = rng.choice((2, 3))        # t ~ 1.0-2.0 s, before the crowd
+    part_w = rng.choice((7, 8))        # t ~ 3.5-4.5 s, mid-crowd
+    faults = (f"replica-kill@{kill_w}:m0,"
+              f"net-partition@{part_w}:m0:1")
+    cmd = [
+        sys.executable, "-m", "pipegcn_tpu.cli.fleet",
+        "--dataset", cfg.dataset, "--n-partitions", str(cfg.n_parts),
+        "--n-hidden", "8", "--fix-seed",
+        "--partition-dir", os.path.join(ep_dir, "parts-serve"),
+        "--serve-build", "--replicas", "1",
+        "--autoscale", "--autoscale-max", "3",
+        "--autoscale-cooldown", "1.5",
+        "--traffic", "flash-crowd:4:0.25:0.625",
+        "--serve-duration", "8", "--serve-qps", "30",
+        "--serve-max-batch", "32", "--serve-max-queue", "96",
+        "--serve-report-every", "0.5",
+        "--fault-plan", faults,
+        "--fleet-retry-timeout", "20",
+        "--metrics-out", os.path.join(ep_dir, "autoscale.jsonl"),
+    ]
+    log(f"  autoscale drill: kill@{kill_w} partition@{part_w}")
+    env = _episode_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PIPEGCN_PLATFORM"] = "cpu"
+    try:
+        proc = subprocess.run(cmd, env=env, cwd=_REPO,
+                              timeout=cfg.episode_timeout_s,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        log("  autoscale drill timed out")
+        return None
+    tails = [ln for ln in proc.stdout.splitlines()
+             if '"fleet": true' in ln]
+    if proc.returncode != 0 or not tails:
+        log(f"  autoscale drill rc={proc.returncode}, no summary")
+        log(f"  tail:\n{(proc.stdout + proc.stderr)[-1500:]}")
+        return None
+    return json.loads(tails[-1])
+
+
 def run_episode(cfg: SoakConfig, episode: int,
                 log: Callable[[str], None] = print) -> Dict:
     """Run one episode end-to-end and return its soak record body."""
@@ -439,6 +574,8 @@ def run_episode(cfg: SoakConfig, episode: int,
 
     fleet_summary = (_run_fleet_drill(cfg, episode, ep_dir, log)
                      if cfg.serve else None)
+    autoscale_summary = (_run_autoscale_drill(cfg, episode, ep_dir, log)
+                         if cfg.autoscale else None)
 
     ck_dir = os.path.join(ep_dir, "ck")
     coord_dir = os.path.join(ep_dir, "parts", "coord-elastic")
@@ -450,6 +587,9 @@ def run_episode(cfg: SoakConfig, episode: int,
         "metrics": check_metrics(metric_files, cfg.n_epochs),
         "tickets": (check_tickets(fleet_summary) if cfg.serve
                     else _inv(True, skipped=True)),
+        "autoscale": (check_autoscale(
+            autoscale_summary, os.path.join(ep_dir, "autoscale.jsonl"))
+            if cfg.autoscale else _inv(True, skipped=True)),
         "resume": _inv(res_rc == 0,
                        rc=res_rc,
                        **({} if res_rc == 0

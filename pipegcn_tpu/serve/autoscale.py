@@ -1,0 +1,306 @@
+"""Closed-loop autoscaling policy for the serving fleet.
+
+This is the piece that turns the PR-15 telemetry plane from a read-only
+dashboard into a control loop (docs/SERVING.md "Autoscaling &
+overload"): the fleet driver feeds each report window's telemetry —
+queue depth, shed rate, p99, staleness, and any AlertEngine fire edges
+— into :class:`AutoscalePolicy`, which answers with a
+:class:`ScaleDecision`. ``scale-up`` / ``scale-down`` decisions are
+executed by ``FleetManager.spawn_replica`` / ``retire_replica`` (serve/
+fleet.py) and remap the router's consistent-hash ring; every non-hold
+decision lands as a contracted schema-v12 ``autoscale`` record carrying
+the triggering evidence, so the soak harness can replay the replica-
+count trajectory from the ledger alone.
+
+Anti-flap brakes mirror the PR-11 ``RestartPolicy`` shape (cooldowns +
+a sliding-window storm breaker) rather than reusing the class: the
+restart policy answers "should this DEAD thing come back", while the
+scale policy answers "should a HEALTHY fleet change size" — but the
+refusal reasons (``cooldown`` / ``storm-brake``) are deliberately the
+same vocabulary so operators read one brake language across both.
+
+Everything is host-side, dependency-free, and takes an injectable
+clock, so the whole policy is drivable by fake-clock unit tests
+(tests/test_autoscale.py).
+
+The module also hosts :class:`NetFaultInjector`, the network-fault
+chaos seam: armed from fault-plan entries (``net-delay`` / ``net-drop``
+/ ``net-partition``, resilience/faults.py), its :meth:`~
+NetFaultInjector.gate` is installed as ``TcpReplicaClient.fault_gate``
+and consulted before every RPC — delaying, dropping, or erroring the
+call without touching the replica process, so the router's retry/
+timeout/backoff path is exercised against slow and partitioned peers,
+not just dead ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    """One autoscaler answer. action: ``scale-up`` | ``scale-down`` |
+    ``refuse`` | ``hold``; target is the proposed fleet size (equal to
+    the current size on refuse/hold); evidence is the telemetry
+    snapshot that justified it (logged verbatim into the `autoscale`
+    record)."""
+
+    action: str
+    target: int
+    reason: str = ""
+    evidence: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def wants_scale(self) -> bool:
+        return self.action in ("scale-up", "scale-down")
+
+
+# alert rules whose FIRE edge is overload evidence the policy treats as
+# an immediate scale-up trigger (no sustain wait — the AlertEngine's
+# own hysteresis already debounced it)
+_SCALE_UP_RULES = ("shed-rate", "staleness-age")
+
+
+class AutoscalePolicy:
+    """Threshold-with-hysteresis scale policy under anti-flap brakes.
+
+    Scale-up triggers (any, evaluated per report window):
+      - queue pressure: queue_depth > ``queue_high`` for
+        ``sustain_ticks`` consecutive windows (one hot window is a
+        blip; a sustained queue is demand outrunning capacity)
+      - shed rate: shed_rate > ``shed_high`` (already dropping work —
+        no sustain wait)
+      - p99 SLO: p99_ms > ``p99_slo_ms`` for ``sustain_ticks`` windows
+      - alert edge: a fire edge from one of the overload rules
+        (shed-rate / staleness-age) arrives from the AlertEngine
+
+    Scale-down trigger: ``idle_ticks`` consecutive windows with
+    queue_depth < ``queue_low`` AND zero shed — capacity is provably
+    idle, retire one replica.
+
+    Brakes (checked AFTER a trigger, so refusals carry the trigger's
+    evidence): ``cooldown_s`` since the last executed scale action, and
+    a storm breaker refusing when >= ``storm_threshold`` scale actions
+    landed inside ``storm_window_s``. Bounds clamp to
+    [min_replicas, max_replicas] with reasons ``min-replicas`` /
+    ``max-replicas``.
+
+    One step per call: decisions move the fleet by ONE replica — the
+    loop re-evaluates next window, so convergence is rate-limited by
+    design (the cooldown IS the ramp rate)."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 queue_high: int = 64, queue_low: int = 8,
+                 shed_high: float = 0.01,
+                 p99_slo_ms: Optional[float] = None,
+                 sustain_ticks: int = 2, idle_ticks: int = 4,
+                 cooldown_s: float = 10.0,
+                 storm_window_s: float = 60.0, storm_threshold: int = 4,
+                 clock: Callable[[], float] = time.monotonic):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f"bad replica bounds [{min_replicas}, {max_replicas}]")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = int(queue_high)
+        self.queue_low = int(queue_low)
+        self.shed_high = float(shed_high)
+        self.p99_slo_ms = None if p99_slo_ms is None else float(p99_slo_ms)
+        self.sustain_ticks = max(1, int(sustain_ticks))
+        self.idle_ticks = max(1, int(idle_ticks))
+        self.cooldown_s = float(cooldown_s)
+        self.storm_window_s = float(storm_window_s)
+        self.storm_threshold = int(storm_threshold)
+        self._clock = clock
+        # trigger hysteresis state
+        self._hot_ticks = 0    # consecutive queue-pressure windows
+        self._slo_ticks = 0    # consecutive p99-over-SLO windows
+        self._idle_ticks = 0   # consecutive provably-idle windows
+        # brake state
+        self._last_scale_t: Optional[float] = None
+        self._recent_scales: list = []  # timestamps, storm window
+        # observability
+        self.n_up = 0
+        self.n_down = 0
+        self.n_refused = 0
+
+    # ---------------- policy ------------------------------------------
+
+    def _brake(self, now: float) -> Optional[str]:
+        """Refusal reason when the anti-flap brakes veto a scale."""
+        if self._last_scale_t is not None \
+                and now - self._last_scale_t < self.cooldown_s:
+            return "cooldown"
+        self._recent_scales = [t for t in self._recent_scales
+                               if now - t >= 0 and now - t
+                               < self.storm_window_s]
+        if len(self._recent_scales) >= self.storm_threshold:
+            return "storm-brake"
+        return None
+
+    def _note_scaled(self, now: float) -> None:
+        self._last_scale_t = now
+        self._recent_scales.append(now)
+        self._hot_ticks = self._slo_ticks = self._idle_ticks = 0
+
+    def observe(self, window: int, queue_depth: int, shed_rate: float,
+                p99_ms: Optional[float], n_replicas: int,
+                alerts: Sequence[str] = ()) -> ScaleDecision:
+        """Fold one report window's telemetry; returns the decision.
+        `alerts` is the list of rule names whose FIRE edge landed this
+        window (AlertEngine.evaluate output). `shed_rate` is shed rows
+        / submitted rows over the window (0 when nothing arrived)."""
+        now = self._clock()
+        n = int(n_replicas)
+        ev: Dict[str, Any] = {
+            "window": int(window),
+            "queue_depth": int(queue_depth),
+            "shed_rate": float(shed_rate),
+            "p99_ms": None if p99_ms is None else float(p99_ms),
+            "alerts": list(alerts),
+        }
+
+        # --- trigger detection -----------------------------------------
+        self._hot_ticks = (self._hot_ticks + 1
+                           if queue_depth > self.queue_high else 0)
+        over_slo = (self.p99_slo_ms is not None and p99_ms is not None
+                    and p99_ms > self.p99_slo_ms)
+        self._slo_ticks = self._slo_ticks + 1 if over_slo else 0
+        idle = queue_depth < self.queue_low and shed_rate <= 0.0
+        self._idle_ticks = self._idle_ticks + 1 if idle else 0
+
+        up_reason = None
+        if shed_rate > self.shed_high:
+            up_reason = "shed-rate"
+        elif self._hot_ticks >= self.sustain_ticks:
+            up_reason = "queue-pressure"
+        elif self._slo_ticks >= self.sustain_ticks:
+            up_reason = "p99-slo"
+        else:
+            fired = [a for a in alerts if a in _SCALE_UP_RULES]
+            if fired:
+                up_reason = f"alert:{fired[0]}"
+        ev["sustain_ticks"] = int(self._hot_ticks)
+        ev["idle_ticks"] = int(self._idle_ticks)
+
+        # --- up path ---------------------------------------------------
+        if up_reason is not None:
+            if n >= self.max_replicas:
+                self.n_refused += 1
+                return ScaleDecision("refuse", n, "max-replicas",
+                                     {**ev, "trigger": up_reason})
+            brake = self._brake(now)
+            if brake is not None:
+                self.n_refused += 1
+                return ScaleDecision("refuse", n, brake,
+                                     {**ev, "trigger": up_reason})
+            self._note_scaled(now)
+            self.n_up += 1
+            return ScaleDecision("scale-up", n + 1, up_reason, ev)
+
+        # --- down path -------------------------------------------------
+        if self._idle_ticks >= self.idle_ticks:
+            if n <= self.min_replicas:
+                # floor is normal operation, not a refusal worth a
+                # ledger record every idle window — hold silently
+                return ScaleDecision("hold", n, "min-replicas", ev)
+            brake = self._brake(now)
+            if brake is not None:
+                self.n_refused += 1
+                return ScaleDecision("refuse", n, brake,
+                                     {**ev, "trigger": "idle"})
+            self._note_scaled(now)
+            self.n_down += 1
+            return ScaleDecision("scale-down", n - 1, "idle", ev)
+
+        return ScaleDecision("hold", n, "steady", ev)
+
+
+class NetFaultInjector:
+    """Deterministic network-fault chaos at the RPC seam.
+
+    ``gate(rid, op)`` is installed as ``TcpReplicaClient.fault_gate``
+    (serve/fleet.py) and runs at the top of every ``_rpc``:
+
+      - :meth:`delay`: every RPC to the replica sleeps ``ms`` until the
+        arming expires (``until`` on the injected clock) — a slow peer
+      - :meth:`drop`: the next ``n`` RPCs raise — packet loss / resets
+      - :meth:`partition`: every RPC raises until the arming expires —
+        an unreachable-but-alive peer that later heals
+
+    Raises plain ``ConnectionError`` (TcpReplicaClient wraps transport
+    errors into ReplicaError for the router) so the injector stays
+    import-safe from tests that never touch the fleet. Thread-safe:
+    worker threads gate concurrently. ``clock`` / ``sleep`` are
+    injectable for fake-clock tests."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._delay: Dict[int, Tuple[float, float]] = {}  # rid: (ms, until)
+        self._drop: Dict[int, int] = {}                   # rid: n left
+        self._partition: Dict[int, float] = {}            # rid: until
+        self.n_gated = 0
+
+    # ---------------- arming ------------------------------------------
+
+    def delay(self, rid: int, ms: float, duration_s: float) -> None:
+        with self._lock:
+            self._delay[int(rid)] = (float(ms),
+                                     self._clock() + float(duration_s))
+
+    def drop(self, rid: int, n: int = 1) -> None:
+        with self._lock:
+            self._drop[int(rid)] = self._drop.get(int(rid), 0) + int(n)
+
+    def partition(self, rid: int, duration_s: float) -> None:
+        with self._lock:
+            self._partition[int(rid)] = self._clock() + float(duration_s)
+
+    def partitioned(self, rid: int) -> bool:
+        """Non-consuming: is the replica inside a live partition
+        window? (The fleet poll's health-probe reconciliation asks
+        before trusting an in-process health RPC.)"""
+        with self._lock:
+            until = self._partition.get(int(rid))
+            return until is not None and self._clock() < until
+
+    # ---------------- the seam ----------------------------------------
+
+    def gate(self, rid: int, op: str) -> None:
+        """Called before every RPC to replica `rid`; sleeps or raises
+        per the armed faults. Expired arms are pruned lazily."""
+        rid = int(rid)
+        now = self._clock()
+        delay_ms = None
+        with self._lock:
+            until = self._partition.get(rid)
+            if until is not None:
+                if now < until:
+                    self.n_gated += 1
+                    raise ConnectionError(
+                        f"injected net-partition: replica {rid} "
+                        f"unreachable ({op})")
+                del self._partition[rid]
+            n = self._drop.get(rid, 0)
+            if n > 0:
+                self._drop[rid] = n - 1
+                self.n_gated += 1
+                raise ConnectionError(
+                    f"injected net-drop: replica {rid} ({op})")
+            arm = self._delay.get(rid)
+            if arm is not None:
+                ms, d_until = arm
+                if now < d_until:
+                    delay_ms = ms
+                else:
+                    del self._delay[rid]
+        if delay_ms is not None:
+            self.n_gated += 1
+            self._sleep(delay_ms / 1000.0)

@@ -52,6 +52,72 @@ def bucket_for(n: int, ladder: Sequence[int]) -> int:
     return ladder[-1]
 
 
+class AdmissionLadder:
+    """Graduated admission control: brownout before blackout
+    (docs/SERVING.md "Autoscaling & overload").
+
+    As queue pressure (queued rows / max_queue) climbs through the
+    rung thresholds, the EFFECTIVE admission bound and ticket deadline
+    tighten, so the queue brakes progressively instead of slamming
+    into the hard queue-full wall — overload sheds the newest, most
+    deferrable work first while the autoscaler's capacity catches up.
+    Each rung is ``(pressure_threshold, queue_frac, deadline_frac)``:
+    at pressure >= threshold, the admission bound is
+    ``queue_frac * max_queue`` and the deadline ``deadline_frac *
+    ticket_deadline``. Rung 0 must be ``(0.0, 1.0, 1.0)`` (no
+    tightening at rest). Sheds caused by a tightened bound (the queue
+    was below the HARD bound) carry reason ``brownout`` so per-reason
+    accounting separates graceful degradation from blackout.
+
+    Stateful only for observability: `rung` is the last observed rung
+    and `n_transitions` counts rung changes; the rung->bounds mapping
+    itself is pure, so fake-clock tests drive it directly."""
+
+    DEFAULT_RUNGS = ((0.0, 1.0, 1.0),
+                     (0.5, 0.9, 0.5),
+                     (0.75, 0.8, 0.25))
+
+    def __init__(self, rungs=DEFAULT_RUNGS):
+        rungs = tuple(tuple(map(float, r)) for r in rungs)
+        if not rungs or rungs[0][0] != 0.0:
+            raise ValueError("ladder rung 0 must start at pressure 0.0")
+        if list(rungs) != sorted(rungs):
+            raise ValueError("ladder rungs must be sorted by pressure")
+        for p, qf, df in rungs:
+            if not (0.0 <= p <= 1.0 and 0.0 < qf <= 1.0
+                    and 0.0 < df <= 1.0):
+                raise ValueError(f"bad ladder rung ({p}, {qf}, {df})")
+        self.rungs = rungs
+        self.rung = 0
+        self.n_transitions = 0
+
+    def rung_for(self, pressure: float) -> int:
+        """Highest rung whose threshold is <= pressure (pure)."""
+        r = 0
+        for i, (thr, _, _) in enumerate(self.rungs):
+            if pressure >= thr:
+                r = i
+        return r
+
+    def observe(self, queue_depth: int, max_queue: int) -> int:
+        """Fold the current pressure into `rung`; returns it."""
+        pressure = queue_depth / max(int(max_queue), 1)
+        r = self.rung_for(pressure)
+        if r != self.rung:
+            self.n_transitions += 1
+            self.rung = r
+        return r
+
+    def effective(self, max_queue: Optional[int],
+                  deadline_s: Optional[float]):
+        """(effective max_queue, effective deadline_s) at the current
+        rung; None inputs stay None (unbounded)."""
+        _, qf, df = self.rungs[self.rung]
+        eff_q = None if max_queue is None else int(max_queue * qf)
+        eff_d = None if deadline_s is None else deadline_s * df
+        return eff_q, eff_d
+
+
 class Ticket:
     """One submitted query: node ids in, logits rows out after the
     batch it rode in flushes — or ``shed=True`` when the ticket was
@@ -102,7 +168,8 @@ class MicroBatcher:
                  max_queue: Optional[int] = None,
                  ticket_deadline_ms: Optional[float] = None,
                  on_shed: Optional[Callable] = None,
-                 on_span: Optional[Callable] = None):
+                 on_span: Optional[Callable] = None,
+                 admission_ladder: Optional[AdmissionLadder] = None):
         self._run = run
         self.ladder = bucket_ladder(ladder_min, max_batch)
         self.max_batch = self.ladder[-1]
@@ -118,6 +185,10 @@ class MicroBatcher:
         # fire only for tickets carrying a trace_id, so the default
         # path never pays more than a None check per ticket.
         self._on_span = on_span
+        # graceful-degradation ladder: tightens the EFFECTIVE admission
+        # bound and deadline as pressure rises (brownout before
+        # blackout); None = legacy hard-wall-only behaviour
+        self.ladder_ctl = admission_ladder
         self._pending: List[Ticket] = []
         self.n_flushed_batches = 0
         self.n_shed_tickets = 0
@@ -154,11 +225,25 @@ class MicroBatcher:
                 f"{self.max_batch}; split it")
         t = Ticket(ids, self._clock(), trace_id=trace_id)
         self.n_submitted_rows += ids.size
-        if self.max_queue is not None \
-                and self.queue_depth + ids.size > self.max_queue:
+        depth = self.queue_depth
+        eff_queue = self.max_queue
+        if self.ladder_ctl is not None and self.max_queue is not None:
+            self.ladder_ctl.observe(depth, self.max_queue)
+            eff_queue, _ = self.ladder_ctl.effective(self.max_queue,
+                                                     self.deadline_s)
+        if self.max_queue is not None and depth + ids.size > self.max_queue:
             return self._shed(t, "queue-full")
+        if eff_queue is not None and depth + ids.size > eff_queue:
+            # below the hard wall but above the ladder-tightened bound:
+            # graceful brownout, accounted separately from blackout
+            return self._shed(t, "brownout")
         self._pending.append(t)
         return t
+
+    @property
+    def rung(self) -> int:
+        """Current degradation rung (0 when no ladder is attached)."""
+        return 0 if self.ladder_ctl is None else self.ladder_ctl.rung
 
     @property
     def queue_depth(self) -> int:
@@ -186,9 +271,13 @@ class MicroBatcher:
         serving it would push every younger ticket later still."""
         if self.deadline_s is None or not self._pending:
             return 0
+        deadline = self.deadline_s
+        if self.ladder_ctl is not None:
+            _, deadline = self.ladder_ctl.effective(self.max_queue,
+                                                    self.deadline_s)
         keep, n = [], 0
         for t in self._pending:
-            if now - t.t_submit > self.deadline_s:
+            if now - t.t_submit > deadline:
                 self._shed(t, "deadline")
                 n += 1
             else:

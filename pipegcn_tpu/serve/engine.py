@@ -605,12 +605,13 @@ class ServingEngine:
                      max_delay_ms: float = 5.0,
                      clock=time.monotonic,
                      max_queue: Optional[int] = None,
-                     ticket_deadline_ms: Optional[float] = None
-                     ) -> MicroBatcher:
+                     ticket_deadline_ms: Optional[float] = None,
+                     ladder=None) -> MicroBatcher:
         return MicroBatcher(
             run=lambda ids: self.query(ids, stats=stats),
             max_batch=self.ladder[-1], max_delay_ms=max_delay_ms,
             ladder_min=self.ladder[0], clock=clock,
             observer=stats.note_batch if stats is not None else None,
             max_queue=max_queue, ticket_deadline_ms=ticket_deadline_ms,
-            on_shed=stats.note_shed if stats is not None else None)
+            on_shed=stats.note_shed if stats is not None else None,
+            admission_ladder=ladder)

@@ -211,6 +211,16 @@ class ReplicaServer:
                 self._emit_spans(trace, ids.size, t_handle0,
                                  t_eng0, t_eng1)
             return {"ok": True, "logits": _encode_f32(out), "meta": meta}
+        if op == "update":
+            # mixed update/query workload (loadgen --update-fraction):
+            # patch owned-node features + refresh the halo under the
+            # engine lock, exactly like the single-process churn path
+            ids = np.asarray(msg["ids"], np.int64)
+            vals = _decode_f32(msg["vals"])
+            with self._lock:
+                self.engine.apply_updates(ids, vals)
+                self.engine.refresh_boundary()
+            return {"ok": True, "n": int(ids.size)}
         if op == "health":
             with self._lock:
                 return {"ok": True, "replica": self.replica_id,
@@ -220,6 +230,8 @@ class ReplicaServer:
                             int(self.engine.param_generation),
                         "param_staleness":
                             int(self.engine.param_staleness),
+                        "n_feat_raw": int(getattr(self.engine,
+                                                  "n_feat_raw", 0)),
                         "n_queries": int(self.n_queries)}
         if op == "stop":
             self._stop.set()
@@ -394,6 +406,11 @@ class TcpReplicaClient:
         self.timeout_s = float(timeout_s)
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        # network-fault chaos seam (serve/autoscale.NetFaultInjector):
+        # when set, called as fault_gate(replica_id, op) before every
+        # RPC — it may sleep (net-delay) or raise ConnectionError
+        # (net-drop / net-partition). None in production.
+        self.fault_gate: Optional[Callable[[int, str], None]] = None
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
@@ -409,6 +426,14 @@ class TcpReplicaClient:
         # black-box annotation must name (replica, op, endpoint)
         from ..obs import flight as _flight
 
+        gate = self.fault_gate
+        if gate is not None:
+            try:
+                gate(self.replica_id, str(msg.get("op", "?")))
+            except ConnectionError as exc:
+                raise ReplicaError(
+                    f"replica {self.replica_id} at "
+                    f"{self.host}:{self.port}: {exc}") from exc
         frec = _flight.get_recorder()
         frec.enter("rpc", replica=self.replica_id,
                    op=str(msg.get("op", "?")),
@@ -451,6 +476,14 @@ class TcpReplicaClient:
             msg["trace"] = list(trace)
         resp = self._rpc(msg)
         return _decode_f32(resp["logits"]), resp.get("meta", {})
+
+    def update(self, ids: np.ndarray, vals: np.ndarray) -> int:
+        """Broadcastable feature update (mixed workload): patch owned
+        rows + refresh the halo replica-side. Returns rows applied."""
+        resp = self._rpc({"op": "update",
+                          "ids": np.asarray(ids, np.int64).tolist(),
+                          "vals": _encode_f32(vals)})
+        return int(resp.get("n", 0))
 
     def health(self) -> dict:
         return self._rpc({"op": "health"})
@@ -498,6 +531,9 @@ class _Replica:
         self.died_at: Optional[float] = None
         self.launched_at: Optional[float] = None
         self.gave_up = False
+        # autoscale scale-down: set BEFORE the stop RPC so poll() never
+        # reads the intentional exit as a death to relaunch
+        self.retired = False
 
 
 class FleetManager:
@@ -536,11 +572,18 @@ class FleetManager:
         self.log = log
         self.replicas = {rid: _Replica(rid)
                          for rid in range(self.n_replicas)}
-        self._policies = {rid: RestartPolicy(
-            max_restarts=max_restarts, backoff_base_s=backoff_base_s,
-            backoff_max_s=backoff_max_s)
-            for rid in range(self.n_replicas)}
+        # kept so autoscale-spawned slots get the same brake policy
+        self._policy_args = dict(max_restarts=max_restarts,
+                                 backoff_base_s=backoff_base_s,
+                                 backoff_max_s=backoff_max_s)
+        self._policies = {rid: RestartPolicy(**self._policy_args)
+                          for rid in range(self.n_replicas)}
         self.window = -1  # updated by the load loop for record context
+        # net-fault chaos seam, installed on every client this manager
+        # builds (serve/autoscale.NetFaultInjector.gate); None = off
+        self.fault_gate = None
+        self.n_spawned = 0
+        self.n_retired = 0
 
     # ---------------- launch ------------------------------------------
 
@@ -602,9 +645,87 @@ class FleetManager:
         for rid, rep in self.replicas.items():
             info = self.wait_ready(rid)
             rep.client = TcpReplicaClient("127.0.0.1", info["port"], rid)
+            rep.client.fault_gate = self.fault_gate
             rep.up = True
             clients[rid] = rep.client
         return clients
+
+    def install_fault_gate(self, gate) -> None:
+        """Arm the net-fault chaos seam on every existing client and
+        every client this manager builds from now on."""
+        self.fault_gate = gate
+        for rep in self.replicas.values():
+            if rep.client is not None:
+                rep.client.fault_gate = gate
+
+    def active_count(self) -> int:
+        """Replica slots that are part of the intended fleet size:
+        not retired, not given up. The autoscaler's notion of
+        n_replicas — a slot mid-relaunch still counts (capacity is
+        coming back; spawning MORE on top would double-correct)."""
+        return sum(1 for r in self.replicas.values()
+                   if not r.retired and not r.gave_up)
+
+    # ---------------- autoscale actuation -----------------------------
+
+    def spawn_replica(self, router: Optional[Router] = None) -> int:
+        """Scale-up actuation: launch a NEW replica slot (next unused
+        id) without blocking — poll() folds it into the router via the
+        standard rejoin path once its readiness file appears, so the
+        load loop never stalls waiting on an engine build. Returns the
+        new replica id."""
+        from ..resilience.elastic import RestartPolicy
+
+        rid = max(self.replicas) + 1 if self.replicas else 0
+        rep = _Replica(rid)
+        self.replicas[rid] = rep
+        self._policies[rid] = RestartPolicy(**self._policy_args)
+        self.n_replicas = len(self.replicas)
+        self.launch(rid)
+        self.n_spawned += 1
+        if self.ml is not None:
+            self.ml.fleet("spawn", rid, window=self.window,
+                          incarnation=rep.incarnation)
+        return rid
+
+    def retire_replica(self, rid: Optional[int] = None,
+                       router: Optional[Router] = None) -> Optional[int]:
+        """Scale-down actuation: pick a victim (highest-id live slot
+        when `rid` is None), pull it out of routing FIRST (its ring
+        arcs remap, in-flight batches finish), then stop the process.
+        The slot is flagged `retired` before the stop RPC so poll()
+        never reads the intentional exit as a death. Returns the
+        retired id (None when nothing was retirable)."""
+        if rid is None:
+            live = [r for r in sorted(self.replicas)
+                    if not self.replicas[r].retired
+                    and not self.replicas[r].gave_up]
+            if not live:
+                return None
+            rid = live[-1]
+        rep = self.replicas[rid]
+        rep.retired = True
+        rep.up = False
+        if router is not None:
+            router.remove_replica(rid)
+        if rep.client is not None:
+            rep.client.stop()
+        if rep.proc is not None and rep.proc.poll() is None:
+            try:
+                rep.proc.terminate()
+            except OSError:
+                # genuinely-optional (storage-fault audit): it already
+                # exited on the stop op; poll-side reaping is enough
+                pass
+        if rep.client is not None:
+            rep.client.close()
+        self.n_retired += 1
+        if self.ml is not None:
+            self.ml.fleet("retire", rid, window=self.window,
+                          incarnation=rep.incarnation)
+        self.log(f"fleet: retired replica {rid} (scale-down); "
+                 f"{self.active_count()} slots remain")
+        return rid
 
     # ---------------- liveness ----------------------------------------
 
@@ -671,8 +792,8 @@ class FleetManager:
     def poll(self, router: Optional[Router] = None) -> None:
         """One supervision step: detect deaths, run due relaunches,
         fold ready rejoins back into the router."""
-        for rep in self.replicas.values():
-            if rep.gave_up:
+        for rep in list(self.replicas.values()):
+            if rep.gave_up or rep.retired:
                 continue
             if rep.up:
                 if rep.proc is not None and rep.proc.poll() is not None:
@@ -680,6 +801,27 @@ class FleetManager:
                         rep, f"exit rc={rep.proc.returncode}", router)
                 elif self._heartbeat_stale(rep):
                     self._on_death(rep, "heartbeat-stale", router)
+                elif router is not None and rep.client is not None \
+                        and router.has_replica(rep.rid) \
+                        and not router.is_up(rep.rid):
+                    # alive by process AND heartbeat, but routed out —
+                    # a dispatch error marked it down (e.g. a transient
+                    # net fault). Probe it directly; a healthy answer
+                    # routes it back in WITHOUT a relaunch — the
+                    # partition-heal path
+                    try:
+                        rep.client.health()
+                    except ReplicaError:
+                        pass  # still unreachable; keep it routed out
+                    else:
+                        if router.mark_up(rep.rid):
+                            if self.ml is not None:
+                                self.ml.fleet(
+                                    "replica-reachable", rep.rid,
+                                    window=self.window,
+                                    incarnation=rep.incarnation)
+                            self.log(f"fleet: replica {rep.rid} "
+                                     f"reachable again; routed back in")
                 continue
             # down: launch when the backoff expires...
             if rep.relaunch_at is not None \
@@ -692,13 +834,19 @@ class FleetManager:
                     if rep.client is None:
                         rep.client = TcpReplicaClient(
                             "127.0.0.1", info["port"], rep.rid)
+                        rep.client.fault_gate = self.fault_gate
                     else:
                         rep.client.reconnect(info["port"])
                     rep.up = True
                     latency = (time.monotonic() - rep.died_at
                                if rep.died_at is not None else 0.0)
                     if router is not None:
-                        router.mark_up(rep.rid)
+                        if router.has_replica(rep.rid):
+                            router.mark_up(rep.rid)
+                        else:
+                            # autoscale-spawned slot the router has
+                            # never seen: fold it into the ring
+                            router.add_replica(rep.rid, rep.client)
                     if self.ml is not None:
                         self.ml.fleet(
                             "replica-rejoin", rep.rid,
@@ -783,6 +931,12 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
                    ticket_deadline_ms: Optional[float] = None,
                    seed: int = 0, ml=None,
                    fault_plan=None,
+                   traffic: Optional[str] = None,
+                   update_fraction: float = 0.0,
+                   ladder=None,
+                   autoscaler=None,
+                   alerts_fn: Optional[Callable[[], List[str]]] = None,
+                   net_faults=None,
                    trace_sample_rate: float = 0.0,
                    poll_every_s: float = 0.1,
                    stop: Optional[Callable[[], bool]] = None,
@@ -791,14 +945,27 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
     """Open-loop load over the fleet; returns the aggregate summary.
 
     The driver-side MicroBatcher does the queueing (bounded queue +
-    deadline shedding); worker threads pull taken batches off an
-    internal dispatch queue and push them through the router, so
-    batches flow to every up replica concurrently. A serving window
-    closes every `report_every_s`: an aggregated `serving` record is
-    emitted, per-replica depth/shed counters are sampled, the
-    supervision poll runs, and any `replica-kill@W[:mK]` fault due at
-    that window boundary fires (windows are 1-indexed: window 1 is
-    the first report)."""
+    deadline shedding, optionally tightened by a graceful-degradation
+    `ladder` — serve/batcher.AdmissionLadder); worker threads pull
+    taken batches off an internal dispatch queue and push them through
+    the router, so batches flow to every up replica concurrently. A
+    serving window closes every `report_every_s`: an aggregated
+    `serving` record is emitted, per-replica depth/shed counters are
+    sampled, the supervision poll runs, any `replica-kill@W[:mK]` /
+    net-fault entry due at that window boundary fires (windows are
+    1-indexed: window 1 is the first report), and — when `autoscaler`
+    (serve/autoscale.AutoscalePolicy) is set — the window's telemetry
+    plus any `alerts_fn()` fire edges feed one policy decision, whose
+    scale-up/scale-down the manager executes immediately (spawn is
+    non-blocking; the new replica joins routing via the standard
+    rejoin path when ready).
+
+    `traffic` / `update_fraction` shape the arrival schedule
+    (serve/loadgen.RateShape): update arrivals broadcast a seeded
+    feature patch to every up replica (best-effort — a replica
+    relaunched mid-run misses earlier updates) and never enter the
+    query ticket ledger, so conservation stays a statement about
+    queries alone."""
     import queue as _queue
 
     stats = ServingStats(clock)
@@ -820,26 +987,67 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
     spans = SpanWriter(ml if trace_sample_rate > 0 else None,
                        clock=clock, source="driver")
 
+    shed_cum: Dict[str, int] = {}  # cumulative, survives window resets
+
+    def on_shed(t, reason):
+        stats.note_shed(t, reason)
+        shed_cum[reason] = shed_cum.get(reason, 0) + int(t.ids.size)
+
     batcher = MicroBatcher(
         run=lambda ids: (_ for _ in ()).throw(
             RuntimeError("fleet loop dispatches via the router")),
         max_batch=max_batch, max_delay_ms=max_delay_ms,
         ladder_min=ladder_min, clock=clock, observer=observer,
         max_queue=max_queue, ticket_deadline_ms=ticket_deadline_ms,
-        on_shed=stats.note_shed, on_span=spans.emit)
+        on_shed=on_shed, on_span=spans.emit,
+        admission_ladder=ladder)
+
+    # network-fault chaos: arm an injector whenever a fault plan is in
+    # play (inert until a net-* entry fires) and install its gate on
+    # every client the manager owns or will build
+    net = net_faults
+    if net is None and fault_plan is not None:
+        from .autoscale import NetFaultInjector
+        net = NetFaultInjector(clock=clock, sleep=sleep)
+    if net is not None:
+        # getattr: manager fakes in tests may not model the seam
+        install = getattr(manager, "install_fault_gate", None)
+        if install is not None:
+            install(net.gate)
+
+    def active_count() -> int:
+        f = getattr(manager, "active_count", None)
+        return f() if f is not None else manager.n_replicas
 
     work: "_queue.Queue" = _queue.Queue()
     n_fleet_shed = 0
+    n_update_rpcs = 0
+    n_update_errors = 0
     window = [0]  # 1-indexed once the first report window closes
 
     def worker():
-        nonlocal n_fleet_shed
+        nonlocal n_fleet_shed, n_update_rpcs, n_update_errors
         while True:
             item = work.get()
             if item is None:
                 work.task_done()
                 return
-            take, ids = item
+            if item[0] == "u":
+                # feature-update broadcast: best-effort to every up
+                # replica, outside the query ticket ledger
+                _, u_ids, u_vals = item
+                for u_rid in router.up_replicas():
+                    u_rep = manager.replicas.get(u_rid)
+                    if u_rep is None or u_rep.client is None:
+                        continue
+                    try:
+                        u_rep.client.update(u_ids, u_vals)
+                        n_update_rpcs += 1
+                    except Exception:  # noqa: BLE001 — best-effort
+                        n_update_errors += 1
+                work.task_done()
+                continue
+            _, take, ids = item
             traced = [t.trace_id for t in take
                       if t.trace_id is not None]
             try:
@@ -873,7 +1081,9 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
             finally:
                 work.task_done()
 
-    n_workers = max(2, 2 * manager.n_replicas)
+    max_fleet = (autoscaler.max_replicas if autoscaler is not None
+                 else manager.n_replicas)
+    n_workers = max(2, 2 * max(manager.n_replicas, max_fleet))
     workers = [threading.Thread(target=worker, daemon=True,
                                 name=f"fleet-worker-{i}")
                for i in range(n_workers)]
@@ -881,13 +1091,34 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
         w.start()
 
     gen = OpenLoopGenerator(num_nodes, qps, duration_s,
-                            ids_per_query=ids_per_query, seed=seed)
+                            ids_per_query=ids_per_query, seed=seed,
+                            traffic=traffic,
+                            update_fraction=update_fraction)
+    # mixed workload: update arrivals need the raw feature width to
+    # synthesize patches; probe it once before load starts (a replica
+    # under use_pp reports 0 — updates then count but don't broadcast)
+    upd_rng = None
+    feat_dim = 0
+    if gen.update_fraction > 0:
+        upd_rng = np.random.default_rng(seed + 7919)
+        for rid in router.up_replicas():
+            rep = manager.replicas.get(rid)
+            if rep is None or rep.client is None:
+                continue
+            try:
+                feat_dim = int(rep.client.health().get("n_feat_raw", 0))
+                break
+            except ReplicaError:
+                continue
     t0 = clock()
     next_report = t0 + report_every_s
     next_poll = t0 + poll_every_s
     n_records = 0
     total_q = 0
     kills: List[dict] = []
+    scale_events: List[dict] = []
+    net_events: List[dict] = []
+    rung_max = [0]
     per_replica_depth_max: Dict[int, int] = {
         rid: 0 for rid in manager.replicas}
 
@@ -900,13 +1131,73 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
         for rid, d in depths.items():
             per_replica_depth_max[rid] = max(
                 per_replica_depth_max.get(rid, 0), d)
+        rung_max[0] = max(rung_max[0], batcher.rung)
         if ml is not None:
+            # uncontracted extras: replicas_up / replica_queue_depth /
+            # rung feed the exporter's fleet gauges (obs/health.py)
             extra = {"replicas_up": len(router.up_replicas()),
-                     "window": window[0]}
+                     "window": window[0],
+                     "replica_queue_depth": {
+                         str(r): int(d) for r, d in depths.items()},
+                     "rung": int(batcher.rung)}
             if final:
                 extra["final"] = True
             ml.serving(**rec, **extra)
         n_records += 1
+        return rec
+
+    def autoscale_tick(now, rec):
+        """One closed-loop step: window telemetry (+ alert fire edges)
+        -> policy decision -> actuation + contracted record."""
+        alerts = list(alerts_fn()) if alerts_fn is not None else []
+        served, shed = rec["queries"], rec["shed"]
+        shed_rate = shed / max(served + shed, 1)
+        n_before = active_count()
+        dec = autoscaler.observe(
+            window[0], queue_depth=rec["queue_depth"],
+            shed_rate=shed_rate, p99_ms=rec["p99_ms"],
+            n_replicas=n_before, alerts=alerts)
+        if dec.action == "hold":
+            return
+        acted = None
+        if dec.action == "scale-up":
+            acted = manager.spawn_replica(router)
+        elif dec.action == "scale-down":
+            acted = manager.retire_replica(router=router)
+            if acted is None:
+                return  # nothing retirable; no record for a no-op
+        scale_events.append({"window": window[0],
+                             "action": dec.action,
+                             "reason": dec.reason,
+                             "replica": acted})
+        if ml is not None:
+            ml.autoscale(dec.action, dec.reason, window[0],
+                         n_before, dec.target, dec.evidence)
+
+    def net_tick(now):
+        """Arm any net-fault entries due at this window boundary."""
+        for kind in ("net-delay", "net-drop", "net-partition"):
+            hit = fault_plan.due_member_arg(kind, window[0])
+            if hit is None:
+                continue
+            rid, arg = hit
+            if kind == "net-delay":
+                ms = float(arg) if arg > 0 else 50.0
+                net.delay(rid, ms, report_every_s)
+                detail = {"ms": ms}
+            elif kind == "net-drop":
+                net.drop(rid, 1)
+                detail = {}
+            else:
+                secs = float(arg) if arg > 0 else report_every_s
+                net.partition(rid, secs)
+                detail = {"duration_s": secs}
+            net_events.append({"window": window[0], "kind": kind,
+                               "replica": rid, **detail})
+            if ml is not None:
+                ml.fleet(kind, rid, window=window[0], **detail)
+            manager.log(f"fleet: CHAOS {kind} replica {rid} "
+                        f"at window {window[0]} {detail}")
 
     def tick(now):
         nonlocal next_report, next_poll
@@ -916,23 +1207,29 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
         if now >= next_report:
             window[0] += 1
             manager.window = window[0]
-            emit(now)
+            rec = emit(now)
             next_report = now + report_every_s
             if fault_plan is not None:
                 rid = fault_plan.due_member("replica-kill", window[0])
                 if rid is not None and rid in manager.replicas:
                     manager.kill_replica(rid)
                     kills.append({"window": window[0], "replica": rid})
+                if net is not None:
+                    net_tick(now)
+            if autoscaler is not None:
+                autoscale_tick(now, rec)
 
     def maybe_dispatch(now, force=False):
         while True:
             batch = batcher.take_batch(now, force=force)
             if batch is None:
                 return
-            work.put(batch)
+            take, ids = batch
+            work.put(("q", take, ids))
 
     stopped = False
-    for t_arr, q in zip(gen.arrivals, gen.queries):
+    n_update_arrivals = 0
+    for i, (t_arr, q) in enumerate(zip(gen.arrivals, gen.queries)):
         if stop is not None and stop():
             stopped = True
             break
@@ -949,7 +1246,16 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
             sleep(min(target - now, 0.0005))
         if stopped:
             break
-        batcher.submit(q, trace_id=sampler.sample())
+        if gen.is_update[i]:
+            # an update arrival, not a query: broadcast the seeded
+            # feature patch off-thread (never blocks the open loop)
+            n_update_arrivals += 1
+            if feat_dim > 0:
+                vals = upd_rng.standard_normal(
+                    (q.size, feat_dim)).astype(np.float32)
+                work.put(("u", np.asarray(q, np.int64), vals))
+        else:
+            batcher.submit(q, trace_id=sampler.sample())
         now = clock()
         maybe_dispatch(now)
         tick(now)
@@ -978,6 +1284,7 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
         "qps": float(total_q / dt),
         "n_queries": int(total_q),
         "duration_s": float(dt),
+        "traffic": gen.shape.kind,
         "p50_ms": float(np.percentile(lat, 50)) if lat.size else None,
         "p95_ms": float(np.percentile(lat, 95)) if lat.size else None,
         "p99_ms": float(np.percentile(lat, 99)) if lat.size else None,
@@ -987,9 +1294,23 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
         "n_served": int(batcher.n_served_rows),
         "n_shed": int(batcher.n_shed_rows),
         "n_fleet_shed": int(n_fleet_shed),
+        "shed_by_reason": dict(shed_cum),
+        "rung_max": int(rung_max[0]),
+        "n_update_arrivals": int(n_update_arrivals),
+        "n_update_rpcs": int(n_update_rpcs),
+        "n_update_errors": int(n_update_errors),
         "n_failovers": int(router.n_failovers),
         "n_retried_rows": int(router.n_retried_rows),
         "replicas_up": len(router.up_replicas()),
+        "replicas_active": active_count(),
+        "n_spawned": int(getattr(manager, "n_spawned", 0)),
+        "n_retired": int(getattr(manager, "n_retired", 0)),
+        "scale_events": scale_events,
+        "net_events": net_events,
+        "autoscale": (None if autoscaler is None else {
+            "up": int(autoscaler.n_up),
+            "down": int(autoscaler.n_down),
+            "refused": int(autoscaler.n_refused)}),
         "per_replica_dispatched": {
             str(k): int(v) for k, v in router.n_dispatched.items()},
         "per_replica_queue_depth_max": {

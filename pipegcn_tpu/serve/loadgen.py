@@ -1,37 +1,243 @@
 """Synthetic open-loop load generator + the shared serving loop.
 
-Open-loop means arrival times are fixed up front (Poisson process at
-the target QPS) and do NOT adapt to service time — the honest way to
-measure a serving system, since closed-loop generators hide overload
-by slowing down with the server (coordinated omission). `bench.py
---serve` and `python -m pipegcn_tpu.cli.serve` both drive the same
+Open-loop means arrival times are fixed up front and do NOT adapt to
+service time — the honest way to measure a serving system, since
+closed-loop generators hide overload by slowing down with the server
+(coordinated omission). `bench.py --serve` and
+`python -m pipegcn_tpu.cli.serve` both drive the same
 `run_serving_loop`, which owns the report / freshness-refresh / update-
 churn cadences and emits schema-v5 `serving` records.
+
+Traffic shapes (``--traffic``, docs/SERVING.md "Autoscaling &
+overload"): the arrival process is a non-homogeneous Poisson process
+against a rate function λ(t), realized by Lewis-Shedler THINNING —
+draw a homogeneous process at the peak rate, keep each arrival t with
+probability λ(t)/λ_peak. The schedule stays fixed up front (no
+coordinated omission) and is a pure function of the seed, so shaped
+episodes replay bitwise under the soak harness. Rescaling a constant-
+rate stream would get the mean right but the burst statistics wrong —
+thinning is the correct construction. Shapes:
+
+  constant                        homogeneous Poisson at --serve-qps
+                                  (the legacy stream, bit-identical to
+                                  pre-shape seeds)
+  diurnal[:<period_s>[:<floor>]]  sinusoid between floor*qps and qps
+                                  (trough at t=0, peak at period/2);
+                                  default period = duration, floor 0.25
+  flash-crowd[:<mult>[:<t0>[:<t1>]]]
+                                  base qps outside, qps*mult inside the
+                                  [t0*T, t1*T) crowd window (defaults
+                                  mult 4, t0 0.4, t1 0.7) — the step
+                                  overload the autoscaler must absorb
+  trace:<path>                    replay a recorded rate trace: a JSON
+                                  list of [t_seconds, qps] breakpoints,
+                                  piecewise-constant, last value held
+
+A mixed update/query workload rides the same arrival stream: with
+``update_fraction`` > 0 each arrival is independently (seeded) marked
+as a feature-update instead of a query — updates churn the graph, they
+never enter the ticket ledger, so conservation stays a statement about
+queries alone.
 """
 
 from __future__ import annotations
 
+import json
+import math
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 
 from .batcher import ServingStats
 from .tracing import SpanWriter, TraceSampler
 
+TRAFFIC_SHAPES = ("constant", "diurnal", "flash-crowd", "trace")
+
+
+class RateShape:
+    """A rate function λ(t) over [0, duration_s] with a known peak —
+    everything thinning needs. Construct via :meth:`parse` from a
+    ``--traffic`` spec string; `qps` is the PEAK rate for the shaped
+    kinds (diurnal/flash-crowd scale relative to it)."""
+
+    def __init__(self, kind: str, qps: float, duration_s: float, *,
+                 period_s: Optional[float] = None, floor: float = 0.25,
+                 mult: float = 4.0, t0_frac: float = 0.4,
+                 t1_frac: float = 0.7,
+                 points: Optional[List[List[float]]] = None):
+        if kind not in TRAFFIC_SHAPES:
+            raise ValueError(f"unknown traffic shape {kind!r}; one of "
+                             f"{TRAFFIC_SHAPES}")
+        self.kind = kind
+        self.qps = float(qps)
+        self.duration_s = float(duration_s)
+        self.period_s = float(period_s if period_s else duration_s)
+        self.floor = float(floor)
+        self.mult = float(mult)
+        self.t0_frac = float(t0_frac)
+        self.t1_frac = float(t1_frac)
+        if kind == "diurnal" and not 0.0 <= self.floor <= 1.0:
+            raise ValueError(f"diurnal floor {self.floor} not in [0, 1]")
+        if kind == "flash-crowd" and not (
+                0.0 <= self.t0_frac < self.t1_frac <= 1.0):
+            raise ValueError(
+                f"flash-crowd window [{self.t0_frac}, {self.t1_frac}) "
+                f"must satisfy 0 <= t0 < t1 <= 1")
+        if kind == "trace":
+            if not points:
+                raise ValueError("trace shape needs [t, qps] points")
+            pts = sorted((float(t), float(q)) for t, q in points)
+            if any(q < 0 for _, q in pts):
+                raise ValueError("trace rates must be >= 0")
+            self._trace_t = np.asarray([t for t, _ in pts], np.float64)
+            self._trace_q = np.asarray([q for _, q in pts], np.float64)
+        else:
+            self._trace_t = self._trace_q = None
+
+    @classmethod
+    def parse(cls, spec: Optional[str], qps: float,
+              duration_s: float) -> "RateShape":
+        """``--traffic`` grammar: ``constant`` |
+        ``diurnal[:<period_s>[:<floor>]]`` |
+        ``flash-crowd[:<mult>[:<t0_frac>[:<t1_frac>]]]`` |
+        ``trace:<path>``. None/empty means constant."""
+        spec = (spec or "constant").strip()
+        if spec.startswith("trace:"):
+            path = spec[len("trace:"):]
+            with open(path, encoding="utf-8") as f:
+                points = json.load(f)
+            return cls("trace", qps, duration_s, points=points)
+        parts = spec.split(":")
+        kind, args = parts[0], parts[1:]
+        if kind not in TRAFFIC_SHAPES or kind == "trace":
+            raise ValueError(
+                f"bad --traffic spec {spec!r}: expected constant | "
+                f"diurnal[:period[:floor]] | "
+                f"flash-crowd[:mult[:t0[:t1]]] | trace:<path>")
+        try:
+            nums = [float(a) for a in args]
+        except ValueError as exc:
+            raise ValueError(f"bad --traffic spec {spec!r}: non-numeric "
+                             f"argument") from exc
+        kw = {}
+        if kind == "diurnal":
+            if len(nums) > 2:
+                raise ValueError(f"bad --traffic spec {spec!r}: diurnal "
+                                 f"takes at most period,floor")
+            if nums:
+                kw["period_s"] = nums[0]
+            if len(nums) > 1:
+                kw["floor"] = nums[1]
+        elif kind == "flash-crowd":
+            if len(nums) > 3:
+                raise ValueError(f"bad --traffic spec {spec!r}: "
+                                 f"flash-crowd takes at most mult,t0,t1")
+            for key, v in zip(("mult", "t0_frac", "t1_frac"), nums):
+                kw[key] = v
+        elif nums:
+            raise ValueError(f"bad --traffic spec {spec!r}: constant "
+                             f"takes no arguments")
+        return cls(kind, qps, duration_s, **kw)
+
+    # ---------------- the rate function --------------------------------
+
+    def rate(self, t: np.ndarray) -> np.ndarray:
+        """λ(t), vectorized (accepts scalars or arrays)."""
+        t = np.asarray(t, np.float64)
+        if self.kind == "constant":
+            return np.full_like(t, self.qps)
+        if self.kind == "diurnal":
+            # trough floor*qps at t=0, peak qps at period/2
+            lo = self.floor * self.qps
+            amp = (self.qps - lo) * 0.5
+            return lo + amp * (1.0 - np.cos(
+                2.0 * math.pi * t / self.period_s))
+        if self.kind == "flash-crowd":
+            t0 = self.t0_frac * self.duration_s
+            t1 = self.t1_frac * self.duration_s
+            return np.where((t >= t0) & (t < t1),
+                            self.qps * self.mult, self.qps)
+        idx = np.clip(np.searchsorted(self._trace_t, t, side="right")
+                      - 1, 0, len(self._trace_q) - 1)
+        return self._trace_q[idx]
+
+    @property
+    def peak(self) -> float:
+        """λ_peak — the thinning envelope."""
+        if self.kind == "flash-crowd":
+            return self.qps * self.mult
+        if self.kind == "trace":
+            return float(self._trace_q.max()) if len(self._trace_q) \
+                else 0.0
+        return self.qps
+
+    def crowd_window(self):
+        """(t0, t1) seconds of the flash-crowd step (None otherwise) —
+        the soak harness schedules its mid-crowd net-partition off
+        this."""
+        if self.kind != "flash-crowd":
+            return None
+        return (self.t0_frac * self.duration_s,
+                self.t1_frac * self.duration_s)
+
+
+def thinned_arrivals(shape: RateShape, duration_s: float,
+                     rng: np.random.Generator) -> np.ndarray:
+    """Non-homogeneous Poisson arrivals over [0, duration_s] by
+    Lewis-Shedler thinning: homogeneous candidates at λ_peak, each
+    kept with probability λ(t)/λ_peak. Deterministic for a given rng
+    state; sorted ascending."""
+    lam = shape.peak
+    if lam <= 0 or duration_s <= 0:
+        return np.zeros(0, np.float64)
+    out: List[np.ndarray] = []
+    t = 0.0
+    # chunked draw so a long/low-rate schedule never loops per-arrival
+    chunk = max(64, int(lam * duration_s * 0.25) + 16)
+    while t < duration_s:
+        gaps = rng.exponential(1.0 / lam, chunk)
+        cand = t + np.cumsum(gaps)
+        keep = rng.random(chunk) * lam < shape.rate(cand)
+        out.append(cand[keep & (cand < duration_s)])
+        t = float(cand[-1])
+    arr = np.concatenate(out) if out else np.zeros(0, np.float64)
+    return arr[arr < duration_s]
+
 
 class OpenLoopGenerator:
-    """Deterministic (seeded) Poisson arrival schedule over random
-    node-id queries, with each query carrying `ids_per_query` ids."""
+    """Deterministic (seeded) arrival schedule over random node-id
+    queries, each carrying `ids_per_query` ids.
+
+    With ``traffic`` unset/constant the stream is the legacy
+    homogeneous Poisson draw (bit-identical to pre-shape seeds); a
+    shaped spec switches arrival generation to thinning against the
+    shape's λ(t). ``update_fraction`` > 0 marks arrivals as feature
+    updates (`is_update`); the draw happens only when the fraction is
+    non-zero so the zero-fraction bitstream is unchanged."""
 
     def __init__(self, num_nodes: int, qps: float, duration_s: float,
-                 ids_per_query: int = 1, seed: int = 0):
+                 ids_per_query: int = 1, seed: int = 0,
+                 traffic=None, update_fraction: float = 0.0):
         rng = np.random.default_rng(seed)
-        n = max(1, int(round(qps * duration_s)))
-        gaps = rng.exponential(1.0 / max(qps, 1e-9), n)
-        self.arrivals = np.minimum(np.cumsum(gaps), duration_s)
-        self.queries = rng.integers(0, num_nodes, (n, ids_per_query),
-                                    dtype=np.int64)
+        shape = (traffic if isinstance(traffic, RateShape)
+                 else RateShape.parse(traffic, qps, duration_s))
+        self.shape = shape
+        if shape.kind == "constant":
+            n = max(1, int(round(qps * duration_s)))
+            gaps = rng.exponential(1.0 / max(qps, 1e-9), n)
+            self.arrivals = np.minimum(np.cumsum(gaps), duration_s)
+        else:
+            self.arrivals = thinned_arrivals(shape, duration_s, rng)
+        n = len(self.arrivals)
+        self.queries = rng.integers(0, num_nodes,
+                                    (max(n, 1), ids_per_query),
+                                    dtype=np.int64)[:n]
+        if update_fraction > 0:
+            self.is_update = rng.random(n) < float(update_fraction)
+        else:
+            self.is_update = np.zeros(n, bool)
+        self.update_fraction = float(update_fraction)
         self.duration_s = float(duration_s)
 
     def __len__(self) -> int:
@@ -50,6 +256,9 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
                      max_queue: Optional[int] = None,
                      ticket_deadline_ms: Optional[float] = None,
                      trace_sample_rate: float = 0.0,
+                     traffic: Optional[str] = None,
+                     update_fraction: float = 0.0,
+                     ladder=None,
                      stop: Optional[Callable[[], bool]] = None,
                      clock: Callable[[], float] = time.monotonic,
                      sleep: Callable[[float], None] = time.sleep) -> dict:
@@ -72,8 +281,15 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
     Overload protection (docs/SERVING.md "Load shedding"): `max_queue`
     bounds the queued row count (over-bound submits are shed with
     reason queue-full), `ticket_deadline_ms` sheds tickets that waited
-    past the deadline at flush time. Shed counts land in each serving
-    record (`shed`) and the summary (`n_shed`)."""
+    past the deadline at flush time, and `ladder` (an AdmissionLadder)
+    tightens both adaptively as queue pressure rises — brownout before
+    blackout. Shed counts land in each serving record (`shed`) and the
+    summary (`n_shed`).
+
+    Traffic realism: `traffic` is a ``--traffic`` shape spec (module
+    docstring); `update_fraction` turns that share of arrivals into
+    feature-update churn instead of queries (inert under use_pp, like
+    the timer-driven churn)."""
     stats = ServingStats(clock)
     all_lat: list = []
     fills: list = []
@@ -86,7 +302,8 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
     batcher = engine.make_batcher(stats=stats,
                                   max_delay_ms=max_delay_ms, clock=clock,
                                   max_queue=max_queue,
-                                  ticket_deadline_ms=ticket_deadline_ms)
+                                  ticket_deadline_ms=ticket_deadline_ms,
+                                  ladder=ladder)
     batcher._observer = observer
     # sampled per-query tracing (serve/tracing.py): off at rate 0; all
     # host-side, so the compiled-program population is untouched (the
@@ -96,9 +313,24 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
                        clock=clock, source="serve")
     batcher._on_span = spans.emit
     gen = OpenLoopGenerator(engine.num_global_nodes, qps, duration_s,
-                            ids_per_query=ids_per_query, seed=seed)
+                            ids_per_query=ids_per_query, seed=seed,
+                            traffic=traffic,
+                            update_fraction=update_fraction)
     churn = np.random.default_rng(seed + 1)
     do_updates = update_every_s > 0 and not engine.cfg.use_pp
+    # update-arrival churn (mixed workload): same inertness rule as
+    # the timer path — the pipelined engine owns no update seam
+    do_arrival_updates = (gen.update_fraction > 0
+                          and not engine.cfg.use_pp)
+    n_update_arrivals = 0
+
+    def apply_churn():
+        ids = churn.integers(0, engine.num_global_nodes,
+                             update_rows, dtype=np.int64)
+        vals = churn.standard_normal(
+            (update_rows, engine.n_feat_raw)).astype(np.float32)
+        engine.apply_updates(ids, vals)
+        engine.refresh_boundary()
 
     t0 = clock()
     next_report = t0 + report_every_s
@@ -127,12 +359,7 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
     def tick(now):
         nonlocal next_report, next_refresh, next_update
         if do_updates and now >= next_update:
-            ids = churn.integers(0, engine.num_global_nodes,
-                                 update_rows, dtype=np.int64)
-            vals = churn.standard_normal(
-                (update_rows, engine.n_feat_raw)).astype(np.float32)
-            engine.apply_updates(ids, vals)
-            engine.refresh_boundary()
+            apply_churn()
             next_update = now + update_every_s
         if now >= next_refresh:
             engine.refresh()
@@ -142,7 +369,7 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
             next_report = now + report_every_s
 
     stopped = False
-    for t_arr, q in zip(gen.arrivals, gen.queries):
+    for i, (t_arr, q) in enumerate(zip(gen.arrivals, gen.queries)):
         if stop is not None and stop():
             stopped = True
             break
@@ -159,7 +386,14 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
             sleep(min(target - now, 0.0005))
         if stopped:
             break
-        batcher.submit(q, trace_id=sampler.sample())
+        if gen.is_update[i]:
+            # mixed workload: this arrival is churn, not a query — it
+            # never enters the ticket ledger
+            n_update_arrivals += 1
+            if do_arrival_updates:
+                apply_churn()
+        else:
+            batcher.submit(q, trace_id=sampler.sample())
         now = clock()
         batcher.pump(now)
         tick(now)
@@ -187,6 +421,8 @@ def run_serving_loop(engine, *, duration_s: float, qps: float,
         "drained": batcher.queue_depth == 0,
         "stopped_early": bool(stopped),
         "n_shed": int(total_shed),
+        "traffic": gen.shape.kind,
+        "n_update_arrivals": int(n_update_arrivals),
         "n_traced": int(sampler.n_sampled),
         "n_spans": int(spans.n_spans),
         "n_submitted": int(batcher.n_submitted_rows),

@@ -100,11 +100,18 @@ class Router:
         self.n_failovers = 0
         self.n_retried_rows = 0
         # virtual-node hash ring, sorted by point: each replica owns
-        # `ring_points` arcs so load stays even and a death remaps
-        # only the dead replica's arcs
+        # `ring_points` arcs so load stays even and a death (or an
+        # autoscale retire) remaps only that replica's arcs
+        self._ring_points = int(ring_points)
+        self._ring: List[Tuple[int, int]] = []
+        self._rebuild_ring()
+
+    def _rebuild_ring(self) -> None:
+        """Recompute the vnode ring from current membership. Caller
+        holds the lock (or is __init__, pre-threading)."""
         ring: List[Tuple[int, int]] = []
         for rid in self._clients:
-            for v in range(ring_points):
+            for v in range(self._ring_points):
                 ring.append((_ring_point(f"replica-{rid}-vnode-{v}"),
                              rid))
         ring.sort()
@@ -116,6 +123,8 @@ class Router:
         """Take a replica out of rotation; returns True on the DOWN
         edge (so callers emit exactly one fault record per death)."""
         with self._lock:
+            if rid not in self._clients:  # already retired
+                return False
             was_up = self._up.get(rid, False)
             self._up[rid] = False
         if was_up and self._on_fault is not None:
@@ -126,9 +135,39 @@ class Router:
         """Put a replica back into rotation (rejoin); returns True on
         the UP edge."""
         with self._lock:
+            if rid not in self._clients:  # already retired
+                return False
             was_down = not self._up.get(rid, False)
             self._up[rid] = True
         return was_down
+
+    def has_replica(self, rid: int) -> bool:
+        with self._lock:
+            return rid in self._clients
+
+    def add_replica(self, rid: int, client) -> None:
+        """Fold a newly spawned replica into routing (autoscale
+        scale-up / elastic rejoin of a never-seen id): registers the
+        client, marks it up, and remaps the vnode ring — only the new
+        replica's arcs move."""
+        with self._lock:
+            self._clients[int(rid)] = client
+            self._up[int(rid)] = True
+            self._inflight.setdefault(int(rid), 0)
+            self.n_dispatched.setdefault(int(rid), 0)
+            self._rebuild_ring()
+
+    def remove_replica(self, rid: int) -> None:
+        """Retire a replica from routing entirely (autoscale
+        scale-down): no new batches land on it, its arcs remap to
+        survivors. In-flight batches on worker threads finish
+        normally — the client object stays valid until the fleet
+        manager closes it AFTER this returns."""
+        with self._lock:
+            self._clients.pop(rid, None)
+            self._up.pop(rid, None)
+            self._inflight.pop(rid, None)
+            self._rebuild_ring()
 
     def is_up(self, rid: int) -> bool:
         with self._lock:
@@ -204,12 +243,18 @@ class Router:
                             self.max_backoff_s)
                 continue
             with self._lock:
-                self._inflight[rid] += int(ids.size)
+                # a concurrent remove_replica may have retired rid
+                # between _pick and here: treat it like a miss
+                client = self._clients.get(rid)
+                if client is None:
+                    continue
+                self._inflight[rid] = (self._inflight.get(rid, 0)
+                                       + int(ids.size))
             try:
                 if trace:
-                    out = self._clients[rid].query(ids, trace=trace)
+                    out = client.query(ids, trace=trace)
                 else:
-                    out = self._clients[rid].query(ids)
+                    out = client.query(ids)
             except Exception as exc:  # noqa: BLE001 — any client error
                 last_err = f"{type(exc).__name__}: {exc}"
                 excluded.add(rid)
@@ -222,8 +267,10 @@ class Router:
                 continue
             finally:
                 with self._lock:
-                    self._inflight[rid] -= int(ids.size)
-            self.n_dispatched[rid] += int(ids.size)
+                    if rid in self._inflight:
+                        self._inflight[rid] -= int(ids.size)
+            self.n_dispatched[rid] = (self.n_dispatched.get(rid, 0)
+                                      + int(ids.size))
             if attempt > 1 and self._on_failover is not None:
                 self._on_failover(rid, int(ids.size), attempt)
             # the client's result is opaque to the router: a plain

@@ -107,6 +107,21 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m forensics \
     -p no:cacheprovider "$@"
 
+# Autoscale lane (docs/SERVING.md "Autoscaling & overload"): the
+# closed-loop autoscaling + traffic-realism suite — shaped arrival
+# schedules (diurnal / flash-crowd / trace replay, Lewis-Shedler
+# thinning, seeded determinism), AutoscalePolicy scale-up/down/
+# cooldown/storm-brake transitions under a fake clock, the graceful-
+# degradation ladder (brownout before blackout, per-reason shed
+# accounting), the net-delay/net-drop/net-partition fault kinds
+# through the router retry/backoff path, and spawn/retire consistent-
+# hash ring remap. Fake-clock/fake-client based, tier-1-safe; run
+# standalone so an autoscaling regression fails the chaos lane even
+# when someone trims the tier-1 selection.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m autoscale \
+    -p no:cacheprovider "$@"
+
 # Monitor lane (docs/OBSERVABILITY.md "Live monitoring"): the live
 # telemetry plane — metrics-stream discovery + tail-follow torn-line
 # tolerance, edge-triggered SLO alert fire/dedupe/resolve under a

@@ -55,6 +55,10 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="add the serving-fleet ticket-conservation "
                          "drill to each episode")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="add the closed-loop autoscale drill (flash-"
+                         "crowd + replica-kill + mid-crowd net-"
+                         "partition; invariant #7) to each episode")
     ap.add_argument("--max-restarts", type=int, default=d.max_restarts)
     ap.add_argument("--episode-timeout", type=float,
                     default=d.episode_timeout_s)
@@ -66,7 +70,8 @@ def main(argv=None) -> int:
         seed=a.seed, episodes=a.episodes, n_epochs=a.n_epochs,
         checkpoint_every=a.checkpoint_every, out_dir=a.out_dir,
         dataset=a.dataset, force_faults=tuple(a.force_fault),
-        serve=a.serve, max_restarts=a.max_restarts,
+        serve=a.serve, autoscale=a.autoscale,
+        max_restarts=a.max_restarts,
         episode_timeout_s=a.episode_timeout, keep_dirs=a.keep_dirs)
     summary = run_soak(cfg)
     return 0 if summary["verdict"] == "green" else 1

@@ -125,6 +125,19 @@ QUEUE = [
       "--serve-secs", "30", "--serve-qps", "200",
       "--metrics-out", "results/serve_bench_metrics.jsonl"],
      1800, [_BENCH_PART]),
+    # round-17: closed-loop autoscaling measured on chip — a flash-
+    # crowd arrival schedule (4x for the middle third) over the fleet
+    # path with the AutoscalePolicy spawning/retiring replicas and the
+    # degradation ladder browning out ahead of the hard queue wall;
+    # headline is replica count tracking load (scale_events), p99
+    # inside SLO outside the crowd edges, and a conservation-clean
+    # shed_by_reason ledger (docs/SERVING.md "Autoscaling & overload").
+    ("serve_autoscale_bench",
+     [sys.executable, "bench.py", "--serve", "--no-compare",
+      "--autoscale", "--traffic", "flash-crowd:4",
+      "--serve-secs", "45", "--serve-qps", "150",
+      "--metrics-out", "results/serve_autoscale_metrics.jsonl"],
+     1800, [_BENCH_PART]),
     # round-13: streaming-graph delta ingestion measured on chip —
     # per-delta patch cost + forced-probe drift through the live fit()
     # loop, incremental-vs-full table rebuild, and the serving topology

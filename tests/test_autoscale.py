@@ -1,0 +1,607 @@
+"""Closed-loop autoscaling + traffic realism (serve/autoscale.py,
+serve/loadgen.py shapes, serve/batcher.py AdmissionLadder,
+docs/SERVING.md "Autoscaling & overload").
+
+These tests pin the round-17 contracts:
+  - shaped arrival schedules: Lewis-Shedler thinning against the
+    RateShape grammar (constant / diurnal / flash-crowd / trace
+    replay), seeded determinism (same seed -> bitwise-identical
+    schedule), the constant path bit-identical to the legacy draw,
+    no coordinated omission (the schedule is fixed up front), and the
+    mixed update/query marking leaving the query bitstream unchanged;
+  - AutoscalePolicy under a fake clock: sustained-queue /
+    immediate-shed / p99-SLO / alert-edge scale-up triggers, the
+    idle scale-down, cooldown + storm-brake refusals carrying the
+    trigger evidence, max-replicas refusal, the silent min-replicas
+    hold, and the one-replica-per-decision ramp;
+  - the graceful-degradation ladder: pure rung mapping, transition
+    counting, effective-bound tightening, brownout-before-blackout
+    through MicroBatcher with per-reason shed accounting and the
+    conservation invariant intact;
+  - the net-delay / net-drop / net-partition fault-plan kinds: parse
+    grammar, single-shot due_member_arg, and the NetFaultInjector
+    gate driving the router's retry/backoff path against slow,
+    lossy, and partitioned (then healed) replicas;
+  - consistent-hash ring remap on spawn/retire membership changes:
+    only the joining/leaving replica's arcs move;
+  - the contracted schema-v12 `autoscale` record round-trip.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pipegcn_tpu.obs.metrics import MetricsLogger, read_metrics
+from pipegcn_tpu.obs.schema import validate_record
+from pipegcn_tpu.resilience import FaultPlan
+from pipegcn_tpu.serve.autoscale import (
+    AutoscalePolicy,
+    NetFaultInjector,
+    ScaleDecision,
+)
+from pipegcn_tpu.serve.batcher import AdmissionLadder, MicroBatcher
+from pipegcn_tpu.serve.loadgen import (
+    OpenLoopGenerator,
+    RateShape,
+    thinned_arrivals,
+)
+from pipegcn_tpu.serve.router import Router
+
+pytestmark = pytest.mark.autoscale
+
+
+class FakeTime:
+    """Injectable clock whose sleep() advances it (no real waiting)."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += max(float(s), 0.0)
+
+
+# ---------------- traffic shapes ---------------------------------------
+
+
+def test_rate_shape_parse_grammar():
+    s = RateShape.parse("diurnal:20:0.5", qps=40.0, duration_s=10.0)
+    assert s.kind == "diurnal" and s.period_s == 20.0 and s.floor == 0.5
+    s = RateShape.parse("flash-crowd:6:0.2:0.5", qps=40.0,
+                        duration_s=10.0)
+    assert (s.kind == "flash-crowd" and s.mult == 6.0
+            and s.t0_frac == 0.2 and s.t1_frac == 0.5)
+    assert RateShape.parse(None, 40.0, 10.0).kind == "constant"
+    assert RateShape.parse("", 40.0, 10.0).kind == "constant"
+    for bad in ("sawtooth", "constant:3", "diurnal:1:2:3",
+                "flash-crowd:4:0.7:0.4", "diurnal:abc"):
+        with pytest.raises(ValueError):
+            RateShape.parse(bad, 40.0, 10.0)
+
+
+def test_rate_shape_rate_functions():
+    d = RateShape("diurnal", 100.0, 10.0, period_s=10.0, floor=0.25)
+    assert d.rate(0.0) == pytest.approx(25.0)     # trough at t=0
+    assert d.rate(5.0) == pytest.approx(100.0)    # peak at period/2
+    assert d.peak == pytest.approx(100.0)
+    f = RateShape("flash-crowd", 50.0, 10.0, mult=4.0,
+                  t0_frac=0.4, t1_frac=0.7)
+    assert f.rate(1.0) == pytest.approx(50.0)
+    assert f.rate(5.0) == pytest.approx(200.0)    # inside [4, 7)
+    assert f.rate(8.0) == pytest.approx(50.0)
+    assert f.peak == pytest.approx(200.0)
+    assert f.crowd_window() == pytest.approx((4.0, 7.0))
+    assert d.crowd_window() is None
+
+
+def test_trace_shape_replay(tmp_path):
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps([[0.0, 10.0], [5.0, 100.0]]))
+    s = RateShape.parse(f"trace:{p}", qps=0.0, duration_s=10.0)
+    assert s.rate(2.0) == pytest.approx(10.0)
+    assert s.rate(7.0) == pytest.approx(100.0)   # last value held
+    assert s.peak == pytest.approx(100.0)
+    rng = np.random.default_rng(0)
+    arr = thinned_arrivals(s, 10.0, rng)
+    first, second = (arr < 5.0).sum(), (arr >= 5.0).sum()
+    # 10 qps for 5 s vs 100 qps for 5 s: the replay must be lopsided
+    assert second > 4 * first
+
+
+def test_thinning_flash_crowd_burst_statistics():
+    shape = RateShape("flash-crowd", 50.0, 30.0, mult=4.0,
+                      t0_frac=0.4, t1_frac=0.7)
+    arr = thinned_arrivals(shape, 30.0, np.random.default_rng(1))
+    t0, t1 = shape.crowd_window()
+    in_crowd = ((arr >= t0) & (arr < t1)).sum()
+    outside = len(arr) - in_crowd
+    # expected 50*4*9 = 1800 inside vs 50*21 = 1050 outside; the
+    # per-second RATE ratio must be ~ mult (loose: Poisson noise)
+    rate_ratio = (in_crowd / (t1 - t0)) / (outside / (30.0 - (t1 - t0)))
+    assert 3.0 < rate_ratio < 5.0
+    assert np.all(np.diff(arr) >= 0)  # sorted: fixed up front, open loop
+
+
+def test_thinned_arrivals_deterministic_per_seed():
+    shape = RateShape("diurnal", 80.0, 12.0)
+    a = thinned_arrivals(shape, 12.0, np.random.default_rng(7))
+    b = thinned_arrivals(shape, 12.0, np.random.default_rng(7))
+    c = thinned_arrivals(shape, 12.0, np.random.default_rng(8))
+    np.testing.assert_array_equal(a, b)
+    assert len(a) != len(c) or not np.array_equal(a, c)
+
+
+def test_generator_constant_path_bit_identical_to_legacy():
+    """traffic=None and traffic='constant' must both take the legacy
+    homogeneous draw — bit-identical arrivals AND queries, so
+    pre-shape seeds replay unchanged."""
+    g0 = OpenLoopGenerator(100, 40.0, 5.0, seed=3)
+    g1 = OpenLoopGenerator(100, 40.0, 5.0, seed=3, traffic="constant")
+    np.testing.assert_array_equal(g0.arrivals, g1.arrivals)
+    np.testing.assert_array_equal(g0.queries, g1.queries)
+    assert not g0.is_update.any()
+
+
+def test_generator_update_fraction_marks_without_perturbing_stream():
+    g0 = OpenLoopGenerator(100, 40.0, 5.0, seed=3)
+    g1 = OpenLoopGenerator(100, 40.0, 5.0, seed=3, update_fraction=0.3)
+    # the update draw happens AFTER arrivals/queries: same bitstream
+    np.testing.assert_array_equal(g0.arrivals, g1.arrivals)
+    np.testing.assert_array_equal(g0.queries, g1.queries)
+    frac = g1.is_update.mean()
+    assert 0.15 < frac < 0.45
+    g2 = OpenLoopGenerator(100, 40.0, 5.0, seed=3, update_fraction=0.3)
+    np.testing.assert_array_equal(g1.is_update, g2.is_update)
+
+
+def test_generator_shaped_deterministic():
+    g1 = OpenLoopGenerator(100, 30.0, 8.0, seed=5,
+                           traffic="flash-crowd:4")
+    g2 = OpenLoopGenerator(100, 30.0, 8.0, seed=5,
+                           traffic="flash-crowd:4")
+    np.testing.assert_array_equal(g1.arrivals, g2.arrivals)
+    np.testing.assert_array_equal(g1.queries, g2.queries)
+    assert g1.shape.kind == "flash-crowd"
+
+
+# ---------------- autoscale policy -------------------------------------
+
+
+def _obs(p, window, *, q=0, shed=0.0, p99=None, n=1, alerts=()):
+    return p.observe(window, q, shed, p99, n, alerts=alerts)
+
+
+def test_policy_scale_up_on_sustained_queue_pressure():
+    ft = FakeTime()
+    p = AutoscalePolicy(queue_high=64, sustain_ticks=2, cooldown_s=10,
+                        clock=ft.clock)
+    d = _obs(p, 0, q=100)          # first hot window: a blip
+    assert d.action == "hold"
+    ft.t += 1
+    d = _obs(p, 1, q=100)          # sustained: scale
+    assert d.action == "scale-up" and d.reason == "queue-pressure"
+    assert d.target == 2 and d.wants_scale
+    assert d.evidence["queue_depth"] == 100
+    assert p.n_up == 1
+
+
+def test_policy_shed_rate_scales_immediately():
+    p = AutoscalePolicy(shed_high=0.01, clock=FakeTime().clock)
+    d = _obs(p, 0, q=0, shed=0.2)  # already dropping work: no sustain
+    assert d.action == "scale-up" and d.reason == "shed-rate"
+
+
+def test_policy_p99_slo_sustained():
+    ft = FakeTime()
+    p = AutoscalePolicy(p99_slo_ms=50.0, sustain_ticks=2,
+                        clock=ft.clock)
+    assert _obs(p, 0, p99=80.0).action == "hold"
+    d = _obs(p, 1, p99=80.0)
+    assert d.action == "scale-up" and d.reason == "p99-slo"
+    # None p99 (no latency samples this window) resets the streak
+    p2 = AutoscalePolicy(p99_slo_ms=50.0, sustain_ticks=2,
+                         clock=ft.clock)
+    _obs(p2, 0, p99=80.0)
+    _obs(p2, 1, p99=None)
+    assert _obs(p2, 2, p99=80.0).action == "hold"
+
+
+def test_policy_alert_edge_scales_up():
+    p = AutoscalePolicy(clock=FakeTime().clock)
+    d = _obs(p, 0, alerts=("shed-rate",))
+    assert d.action == "scale-up" and d.reason == "alert:shed-rate"
+    # non-overload rules are not scale evidence
+    p2 = AutoscalePolicy(clock=FakeTime().clock)
+    assert _obs(p2, 0, alerts=("silent-source",)).action == "hold"
+
+
+def test_policy_scale_down_after_idle_ticks():
+    ft = FakeTime()
+    p = AutoscalePolicy(queue_low=8, idle_ticks=3, cooldown_s=0.0,
+                        clock=ft.clock)
+    for w in range(2):
+        assert _obs(p, w, q=0, n=3).action == "hold"
+        ft.t += 1
+    d = _obs(p, 2, q=0, n=3)
+    assert d.action == "scale-down" and d.reason == "idle"
+    assert d.target == 2 and p.n_down == 1
+    # any shed breaks the idle streak even with an empty queue
+    p2 = AutoscalePolicy(queue_low=8, idle_ticks=2, cooldown_s=0.0,
+                         clock=ft.clock)
+    _obs(p2, 0, q=0, n=3)
+    _obs(p2, 1, q=0, shed=0.001, n=3)
+    assert _obs(p2, 2, q=0, n=3).action == "hold"
+
+
+def test_policy_min_replicas_holds_silently():
+    ft = FakeTime()
+    p = AutoscalePolicy(min_replicas=1, idle_ticks=1, cooldown_s=0.0,
+                        clock=ft.clock)
+    d = _obs(p, 0, q=0, n=1)
+    assert d.action == "hold" and d.reason == "min-replicas"
+    assert p.n_refused == 0  # the floor is not a refusal
+
+
+def test_policy_max_replicas_refuses_with_trigger():
+    p = AutoscalePolicy(max_replicas=2, shed_high=0.01,
+                        clock=FakeTime().clock)
+    d = _obs(p, 0, shed=0.5, n=2)
+    assert d.action == "refuse" and d.reason == "max-replicas"
+    assert d.evidence["trigger"] == "shed-rate"
+    assert not d.wants_scale and p.n_refused == 1
+
+
+def test_policy_cooldown_refuses_then_allows():
+    ft = FakeTime()
+    p = AutoscalePolicy(shed_high=0.01, cooldown_s=10.0,
+                        clock=ft.clock)
+    assert _obs(p, 0, shed=0.5).action == "scale-up"
+    ft.t += 3.0
+    d = _obs(p, 1, shed=0.5, n=2)
+    assert d.action == "refuse" and d.reason == "cooldown"
+    assert d.evidence["trigger"] == "shed-rate"
+    ft.t += 10.0
+    assert _obs(p, 2, shed=0.5, n=2).action == "scale-up"
+
+
+def test_policy_storm_brake():
+    ft = FakeTime()
+    p = AutoscalePolicy(shed_high=0.01, cooldown_s=0.0,
+                        storm_window_s=60.0, storm_threshold=2,
+                        clock=ft.clock)
+    assert _obs(p, 0, shed=0.5, n=1).action == "scale-up"
+    ft.t += 1
+    assert _obs(p, 1, shed=0.5, n=2).action == "scale-up"
+    ft.t += 1
+    d = _obs(p, 2, shed=0.5, n=3)
+    assert d.action == "refuse" and d.reason == "storm-brake"
+    # outside the window the breaker resets
+    ft.t += 120.0
+    assert _obs(p, 3, shed=0.5, n=3).action == "scale-up"
+
+
+def test_policy_one_replica_per_decision():
+    ft = FakeTime()
+    p = AutoscalePolicy(shed_high=0.01, cooldown_s=0.0,
+                        storm_threshold=100, clock=ft.clock)
+    d = _obs(p, 0, shed=0.9, n=1)
+    assert d.target == 2  # never jumps, however bad the telemetry
+    ft.t += 1
+    assert _obs(p, 1, shed=0.9, n=2).target == 3
+
+
+def test_policy_scale_resets_hysteresis():
+    ft = FakeTime()
+    p = AutoscalePolicy(queue_high=10, sustain_ticks=2, cooldown_s=0.0,
+                        clock=ft.clock)
+    _obs(p, 0, q=50)
+    assert _obs(p, 1, q=50).action == "scale-up"
+    # the executed scale zeroed the streak: next hot window is tick 1
+    assert _obs(p, 2, q=50, n=2).action == "hold"
+
+
+def test_policy_validates_bounds():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+# ---------------- degradation ladder -----------------------------------
+
+
+def test_ladder_rung_mapping_and_transitions():
+    lad = AdmissionLadder()
+    assert lad.rung_for(0.0) == 0
+    assert lad.rung_for(0.49) == 0
+    assert lad.rung_for(0.5) == 1
+    assert lad.rung_for(0.74) == 1
+    assert lad.rung_for(0.9) == 2
+    assert lad.observe(0, 100) == 0
+    assert lad.observe(60, 100) == 1
+    assert lad.observe(90, 100) == 2
+    assert lad.observe(10, 100) == 0
+    assert lad.n_transitions == 3
+
+
+def test_ladder_effective_tightening():
+    lad = AdmissionLadder()
+    lad.observe(90, 100)  # rung 2
+    eff_q, eff_d = lad.effective(100, 1.0)
+    assert eff_q == 80 and eff_d == pytest.approx(0.25)
+    assert lad.effective(None, None) == (None, None)
+    lad.observe(0, 100)   # back to rest: no tightening
+    assert lad.effective(100, 1.0) == (100, 1.0)
+
+
+def test_ladder_validates_rungs():
+    with pytest.raises(ValueError):
+        AdmissionLadder(rungs=((0.5, 0.9, 0.5),))       # no rung 0
+    with pytest.raises(ValueError):
+        AdmissionLadder(rungs=((0.0, 1.0, 1.0),
+                               (0.8, 0.9, 0.5),
+                               (0.5, 0.8, 0.25)))       # unsorted
+    with pytest.raises(ValueError):
+        AdmissionLadder(rungs=((0.0, 0.0, 1.0),))       # zero bound
+
+
+def test_batcher_brownout_before_blackout():
+    ft = FakeTime()
+    sheds = []
+    b = MicroBatcher(lambda ids: np.zeros((ids.size, 2), np.float32),
+                     max_batch=64, max_delay_ms=10_000.0,
+                     clock=ft.clock, max_queue=10,
+                     on_shed=lambda t, r: sheds.append(r),
+                     admission_ladder=AdmissionLadder())
+    for _ in range(8):
+        t = b.submit(np.array([1]))
+        assert not t.shed
+    # depth 8 -> pressure 0.8 -> rung 2 tightens the bound to 8: the
+    # next row is under the HARD wall (8+1 <= 10) but browns out
+    t = b.submit(np.array([2]))
+    assert t.shed and t.shed_reason == "brownout"
+    assert b.rung == 2
+    # past the hard wall itself: blackout keeps its own reason
+    t = b.submit(np.array([3, 4, 5]))
+    assert t.shed and t.shed_reason == "queue-full"
+    assert sheds == ["brownout", "queue-full"]
+    # conservation: submitted == served + shed + queued, always
+    assert (b.n_submitted_rows
+            == b.n_served_rows + b.n_shed_rows + b.queue_depth)
+    b.drain()
+    assert (b.n_submitted_rows
+            == b.n_served_rows + b.n_shed_rows + b.queue_depth)
+    assert b.n_served_rows == 8
+
+
+def test_batcher_without_ladder_keeps_legacy_wall():
+    ft = FakeTime()
+    b = MicroBatcher(lambda ids: np.zeros((ids.size, 2), np.float32),
+                     max_batch=64, max_delay_ms=10_000.0,
+                     clock=ft.clock, max_queue=10)
+    assert b.rung == 0
+    for _ in range(10):
+        assert not b.submit(np.array([1])).shed
+    t = b.submit(np.array([1]))
+    assert t.shed and t.shed_reason == "queue-full"
+
+
+# ---------------- net-fault plan grammar -------------------------------
+
+
+def test_fault_plan_net_kinds_parse_and_roundtrip():
+    fp = FaultPlan.parse("net-delay@2:m1:250,net-drop@3:m0,"
+                         "net-partition@5:2")
+    assert fp.remaining() == ["net-delay@2:m1:250", "net-drop@3:m0",
+                              "net-partition@5:2"]
+    assert fp.due_member_arg("net-delay", 1) is None  # not yet due
+    assert fp.due_member_arg("net-delay", 2) == (1, 250)
+    assert fp.due_member_arg("net-delay", 2) is None  # single-shot
+    assert fp.due_member_arg("net-drop", 4) == (0, 0)  # default member
+    assert fp.due_member_arg("net-partition", 5) == (0, 2)
+
+
+def test_fault_plan_net_kinds_reject_malformed():
+    with pytest.raises(ValueError):
+        FaultPlan.parse("net-frob@1")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("net-drop@1:250")  # drop takes no argument
+
+
+# ---------------- net-fault injector + router retry --------------------
+
+
+class GatedClient:
+    """Replica client double whose every query consults the injector
+    gate first — the TcpReplicaClient.fault_gate seam, minus TCP."""
+
+    def __init__(self, rid, net):
+        self.rid = rid
+        self.net = net
+        self.n_queries = 0
+
+    def query(self, ids):
+        self.net.gate(self.rid, "query")
+        self.n_queries += 1
+        ids = np.asarray(ids)
+        return np.stack([ids, ids * 2], axis=1).astype(np.float32)
+
+
+def test_injector_partition_window_and_heal():
+    ft = FakeTime()
+    net = NetFaultInjector(clock=ft.clock, sleep=ft.sleep)
+    net.partition(0, 5.0)
+    assert net.partitioned(0) and not net.partitioned(1)
+    with pytest.raises(ConnectionError):
+        net.gate(0, "query")
+    net.gate(1, "query")  # other replicas unaffected
+    ft.t += 6.0
+    assert not net.partitioned(0)
+    net.gate(0, "query")  # healed: no raise
+    assert net.n_gated == 1
+
+
+def test_injector_drop_is_counted():
+    net = NetFaultInjector(clock=FakeTime().clock)
+    net.drop(0, n=2)
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            net.gate(0, "query")
+    net.gate(0, "query")  # budget spent
+    assert net.n_gated == 2
+
+
+def test_injector_delay_sleeps_until_expiry():
+    ft = FakeTime()
+    net = NetFaultInjector(clock=ft.clock, sleep=ft.sleep)
+    net.delay(0, 250.0, 10.0)
+    t0 = ft.t
+    net.gate(0, "query")
+    assert ft.t - t0 == pytest.approx(0.25)
+    ft.t = 20.0  # arming expired
+    t0 = ft.t
+    net.gate(0, "query")
+    assert ft.t == t0
+
+
+def test_router_fails_over_on_net_drop():
+    ft = FakeTime()
+    net = NetFaultInjector(clock=ft.clock, sleep=ft.sleep)
+    clients = {0: GatedClient(0, net), 1: GatedClient(1, net)}
+    r = Router(clients, clock=ft.clock, sleep=ft.sleep,
+               retry_timeout_s=5.0)
+    net.drop(0, n=1)
+    out, rid = r.dispatch(np.array([5]))
+    assert rid == 1 and r.n_failovers == 1
+    assert not r.is_up(0)  # the drop marked it down eagerly
+    # the manager's health probe heals it; traffic returns
+    assert r.mark_up(0)
+    _, rid = r.dispatch(np.array([6]))
+    assert rid == 0
+
+
+def test_router_full_partition_raises_fleet_unavailable():
+    """A partition of the WHOLE fleet ends in FleetUnavailable fast —
+    the caller sheds the batch explicitly instead of hanging on the
+    retry budget once every replica is marked down."""
+    from pipegcn_tpu.serve.router import FleetUnavailable
+
+    ft = FakeTime()
+    net = NetFaultInjector(clock=ft.clock, sleep=ft.sleep)
+    clients = {0: GatedClient(0, net), 1: GatedClient(1, net)}
+    r = Router(clients, clock=ft.clock, sleep=ft.sleep,
+               retry_timeout_s=2.0)
+    net.partition(0, 100.0)
+    net.partition(1, 100.0)
+    with pytest.raises(FleetUnavailable):
+        r.dispatch(np.array([1]))
+    assert r.up_replicas() == []       # both marked down eagerly
+    assert ft.t < 2.0                  # short-circuit, not a timeout
+    # the partition heals and the manager's probe marks them up:
+    # dispatch works again with no replica restarted
+    ft.t += 200.0
+    r.mark_up(0), r.mark_up(1)
+    _, rid = r.dispatch(np.array([3]))
+    assert rid in (0, 1)
+
+
+def test_router_survives_net_delay_within_budget():
+    ft = FakeTime()
+    net = NetFaultInjector(clock=ft.clock, sleep=ft.sleep)
+    clients = {0: GatedClient(0, net)}
+    r = Router(clients, clock=ft.clock, sleep=ft.sleep,
+               retry_timeout_s=5.0)
+    net.delay(0, 300.0, 10.0)
+    out, rid = r.dispatch(np.array([7]))
+    assert rid == 0 and out[0, 1] == 14.0
+    assert ft.t == pytest.approx(0.3)  # slow, not dead: no failover
+    assert r.n_failovers == 0
+
+
+# ---------------- membership: ring remap on spawn/retire ---------------
+
+
+class FakeTimeClient:
+    """Minimal client for membership tests (never dispatched)."""
+
+    def __init__(self, rid):
+        self.rid = rid
+
+    def query(self, ids):
+        ids = np.asarray(ids)
+        return np.stack([ids, ids * 2], axis=1).astype(np.float32)
+
+
+def _hash_map(r, keys):
+    return {k: r._pick(np.asarray([k]), set()) for k in keys}
+
+
+def test_add_replica_remaps_only_new_arcs():
+    c = {0: FakeTimeClient(0), 1: FakeTimeClient(1)}
+    r = Router(c, policy="hash", sleep=lambda s: None)
+    keys = range(400)
+    before = _hash_map(r, keys)
+    r.add_replica(2, FakeTimeClient(2))
+    after = _hash_map(r, keys)
+    moved = [k for k in keys if before[k] != after[k]]
+    assert moved, "the new replica must take some arcs"
+    assert all(after[k] == 2 for k in moved)
+    assert len(moved) < len(list(keys)) / 2  # only ITS arcs moved
+    assert r.has_replica(2) and r.is_up(2)
+
+
+def test_remove_replica_remaps_only_its_arcs():
+    c = {0: FakeTimeClient(0), 1: FakeTimeClient(1),
+         2: FakeTimeClient(2)}
+    r = Router(c, policy="hash", sleep=lambda s: None)
+    keys = range(400)
+    before = _hash_map(r, keys)
+    r.remove_replica(2)
+    after = _hash_map(r, keys)
+    for k in keys:
+        if before[k] != 2:
+            assert after[k] == before[k]  # survivors' arcs untouched
+        else:
+            assert after[k] in (0, 1)
+    assert not r.has_replica(2)
+    # mark_down/mark_up on a retired rid are inert, not a resurrection
+    assert r.mark_down(2) is False
+    assert r.mark_up(2) is False
+    assert 2 not in r.queue_depths()
+
+
+# ---------------- schema: the autoscale record -------------------------
+
+
+def test_autoscale_record_contract(tmp_path):
+    path = tmp_path / "m.jsonl"
+    ml = MetricsLogger(str(path))
+    ml.autoscale("scale-up", "queue-pressure", 7, 2, 3,
+                 {"queue_depth": 90, "shed_rate": 0.0,
+                  "alerts": []})
+    ml.autoscale("refuse", "cooldown", 8, 3, 3,
+                 {"trigger": "shed-rate"})
+    ml.close()
+    recs = [r for r in read_metrics(str(path))
+            if r.get("event") == "autoscale"]
+    assert len(recs) == 2
+    for r in recs:
+        validate_record(r)
+    assert recs[0]["action"] == "scale-up"
+    assert recs[0]["target"] == 3
+    assert recs[0]["evidence"]["queue_depth"] == 90
+    assert recs[1]["reason"] == "cooldown"
+    # a malformed record (evidence must be an object) is rejected
+    with pytest.raises(ValueError):
+        validate_record({"event": "autoscale", "action": "scale-up",
+                         "reason": "x", "window": 1, "n_replicas": 1,
+                         "target": 2, "evidence": "not-an-object"})
+
+
+def test_scale_decision_surface():
+    d = ScaleDecision("hold", 2, "steady", {})
+    assert not d.wants_scale
+    assert ScaleDecision("scale-down", 1, "idle", {}).wants_scale
