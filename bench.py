@@ -967,6 +967,14 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
 
             _floor_lever("rng-rbg", tkw=dict(rng_impl="rbg"))
             _floor_lever("dropout-bits8", mkw=dict(dropout_bits=8))
+            # integrity plane at its worst-case cadence (a check every
+            # boundary): digest capture/verify + static scrub +
+            # Freivalds + the wire-checksum lane, all in ONE compile —
+            # the guard is a trace-time choice, so the delta is pure
+            # check cost, never recompile cost. Expect a NEGATIVE
+            # delta (the lever spends time buying detection).
+            _floor_lever("integrity-c1",
+                         tkw=dict(integrity_check_every=1))
             if headline_pipeline:
                 _floor_lever("halo-float8",
                              tkw=dict(halo_dtype="float8"))
@@ -981,6 +989,8 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
             for dkey, ref, var in (
                     ("rng_impl_delta_s", "base", "rng-rbg"),
                     ("dropout_bits_delta_s", "base", "dropout-bits8"),
+                    ("integrity_check_delta_s", "base",
+                     "integrity-c1"),
                     ("halo_dtype_delta_s", "base", "halo-float8"),
                     ("epoch_block_delta_s", "unfused", "base"),
                     ("comm_prefetch_delta_s", "prefetch-off",

@@ -436,6 +436,7 @@ def run(args) -> dict:
         comm_prefetch=args.comm_prefetch,
         numerics_tripwire=args.numerics_tripwire,
         loss_scale=args.loss_scale,
+        integrity_check_every=args.integrity_check_every,
     )
     trainer = Trainer(sg, cfg, tcfg)
 
@@ -606,6 +607,18 @@ def cli_entry() -> None:
         print(f"preempted at epoch {p.epoch} ({p.reason}); resumable — "
               f"rerun with --resume --checkpoint-dir "
               f"{args.checkpoint_dir!r} [exit {EXIT_PREEMPTED}]")
+        import jax
+
+        if jax.process_count() > 1:
+            # a ONE-SIDED preemption (an SDC quarantine asks only the
+            # striking rank to leave) strands the peers mid-epoch: the
+            # graceful exit's distributed-shutdown barrier can never
+            # complete once they watchdog out, and the coordination
+            # client would SIGABRT over the resumable status — same
+            # reasoning as the PeerLost branch below
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(EXIT_PREEMPTED)
         sys.exit(EXIT_PREEMPTED)
     except PeerLost as p:
         # a dead peer is the platform's problem, not this state's: the

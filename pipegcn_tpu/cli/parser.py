@@ -375,6 +375,17 @@ def create_parser() -> argparse.ArgumentParser:
                              "(0 disables; mismatch emits a 'desync' "
                              "fault and aborts resumably unless "
                              "--desync-resync)")
+    parser.add_argument("--integrity-check-every",
+                        "--integrity_check_every", type=int, default=0,
+                        help="epochs between SDC integrity checks "
+                             "(resilience/integrity.py): fletcher-"
+                             "digest scrub of static device tables and "
+                             "Freivalds verification of the production "
+                             "SpMM at this cadence, cheap params/carry "
+                             "digest compares at every boundary, and "
+                             "the halo wire-checksum lane in the "
+                             "pipelined step; 0 disables (and keeps "
+                             "the compiled step byte-identical)")
     parser.add_argument("--desync-resync", "--desync_resync",
                         action="store_true",
                         help="on a detected cross-rank desync, resync "

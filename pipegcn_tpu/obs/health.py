@@ -336,6 +336,14 @@ def prometheus_text(agg: LiveAggregator,
     for kind, n in sorted(agg.recovery_counts.items()):
         gauge("pipegcn_recoveries_total", n, {"kind": kind},
               mtype="counter")
+    for outcome, n in sorted(getattr(agg, "integrity_counts",
+                                     {}).items()):
+        gauge("pipegcn_integrity_checks_total", n,
+              {"outcome": outcome}, mtype="counter")
+    # a GAUGE: rises on quarantine-request, falls when a later
+    # membership assignment seats the member again (operator rejoin)
+    gauge("pipegcn_quarantined_ranks",
+          len(getattr(agg, "quarantined", ())))
     gauge("pipegcn_io_degraded",
           int(agg.fault_counts.get("io-degraded", 0)
               > agg.recovery_counts.get("io-degraded", 0)))

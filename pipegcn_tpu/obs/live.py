@@ -212,6 +212,8 @@ class LiveAggregator:
       fault_counts[kind] / recovery_counts[kind]   run-wide
       shed_by_reason[reason]  run-wide shed row totals
       autoscale_counts[action]  run-wide scale-decision totals
+      integrity_counts[outcome]  run-wide integrity-check totals
+      quarantined            members with a standing SDC quarantine
       last_seen[source]       clock time a record last ARRIVED — the
                               silent-source alert's input
       epoch_times[source]     recent step_time_s history (regression
@@ -236,6 +238,14 @@ class LiveAggregator:
         # scale-down | refuse) — the exporter's
         # pipegcn_autoscale_decisions_total{direction} input
         self.autoscale_counts: Dict[str, int] = {}
+        # integrity-plane check outcomes (ok | mismatch) — the
+        # exporter's pipegcn_integrity_checks_total{outcome} input
+        self.integrity_counts: Dict[str, int] = {}
+        # members with a standing SDC quarantine: added on a
+        # quarantine-request fault record, removed when a later
+        # membership assignment seats the member again (the operator's
+        # explicit rejoin cleared the marker)
+        self.quarantined: set = set()
         self.last_seen: Dict[str, float] = {}
         self.epoch_times: Dict[str, List[float]] = {}
         self.n_records = 0
@@ -296,6 +306,19 @@ class LiveAggregator:
         elif kind == "fault":
             k = str(rec.get("kind"))
             self.fault_counts[k] = self.fault_counts.get(k, 0) + 1
+            if k == "quarantine-request" and isinstance(
+                    rec.get("member"), int):
+                self.quarantined.add(rec["member"])
+        elif kind == "integrity":
+            o = str(rec.get("outcome"))
+            self.integrity_counts[o] = (
+                self.integrity_counts.get(o, 0) + 1)
+        elif kind == "membership":
+            asg = rec.get("assignment")
+            if isinstance(asg, dict):
+                seated = {m for m in asg.values()
+                          if isinstance(m, int)}
+                self.quarantined -= seated
         elif kind == "recovery":
             k = str(rec.get("kind"))
             self.recovery_counts[k] = self.recovery_counts.get(k, 0) + 1
@@ -354,6 +377,8 @@ class LiveAggregator:
             "recovery_counts": dict(self.recovery_counts),
             "shed_by_reason": dict(self.shed_by_reason),
             "autoscale_counts": dict(self.autoscale_counts),
+            "integrity_counts": dict(self.integrity_counts),
+            "quarantined_members": sorted(self.quarantined),
         }
         if diagnosis:
             # the latest postmortem verdict per stream (obs/

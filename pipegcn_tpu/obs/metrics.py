@@ -603,6 +603,30 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def integrity(self, epoch: int, check: str, outcome: str,
+                  target: Optional[str], cadence: int,
+                  overhead_s: float, **extra) -> Dict[str, Any]:
+        """One SDC-detector verdict (resilience/integrity.py): a
+        digest scrub, Freivalds compute verification, or halo wire
+        checksum outcome at a check boundary. Mismatch records are
+        hard-flushed (the run may be about to roll back or quarantine
+        itself); ok records take the ordinary flush-per-write path —
+        they are cadence-periodic bookkeeping, not last words."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "integrity",
+            "epoch": int(epoch),
+            "check": str(check),
+            "outcome": str(outcome),
+            "target": None if target is None else str(target),
+            "cadence": int(cadence),
+            "overhead_s": float(overhead_s),
+            **extra,
+        })
+        if outcome != "ok":
+            self.hard_flush()
+        return rec
+
     def event(self, event: str, **fields) -> Dict[str, Any]:
         """Free-form record (e.g. bench headline, rank progress) — only
         the ``event`` discriminator is contracted."""

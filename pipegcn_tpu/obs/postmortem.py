@@ -366,6 +366,47 @@ def _rule_desync(b: Dict) -> Optional[Dict]:
                            "nondeterministic kernels)"}
 
 
+def _rule_sdc(b: Dict) -> Optional[Dict]:
+    """Silent data corruption (resilience/integrity.py): sdc fault
+    records, integrity mismatch records, quarantine requests. A
+    quarantine (or an unrecovered detection) is high-confidence — the
+    run named a defective member; a recovered one-off detection is
+    background context only."""
+    fs = _faults(b, "sdc")
+    mism = [r for r in b.get("records", ())
+            if r.get("event") == "integrity"
+            and r.get("outcome") == "mismatch"]
+    if not fs and not mism:
+        return None
+    ev = [f"fault record: sdc ({r.get('target')}) at epoch "
+          f"{r.get('epoch')} (rank {r.get('rank')}, "
+          f"{r.get('strikes', 1)} strike(s))" for r in fs[:3]]
+    ev += [f"integrity record: {r.get('check')} mismatch on "
+           f"{r.get('target')} at epoch {r.get('epoch')} "
+           f"({str(r.get('detail', ''))[:60]})" for r in mism[:3]]
+    quarantined = (_faults(b, "quarantine-request")
+                   or _grep(b, r"quarantine requested for member",
+                            max_hits=2))
+    if quarantined:
+        ev += [f"fault record: quarantine-request (member "
+               f"{r.get('member')}, {r.get('strikes')} strikes)"
+               for r in _faults(b, "quarantine-request")[:2]]
+        ev += [h for h in quarantined if isinstance(h, str)]
+        conf = 0.9
+    else:
+        recovered = bool(_recoveries(b, "sdc"))
+        conf = 0.5 if recovered else 0.8
+        if recovered:
+            ev.append("sdc recovery record present: rollback/flush/"
+                      "rebuild completed")
+    return {"confidence": conf, "evidence": ev,
+            "remediation": "silent data corruption detected; if one "
+                           "rank keeps tripping (quarantined), pull "
+                           "that host for screening — rejoin only via "
+                           "an explicit rejoin request after clearing "
+                           "its quarantine marker"}
+
+
 def _rule_storage_fault(b: Dict) -> Optional[Dict]:
     fs = _faults(b, "io-degraded")
     ev = [f"fault record: io-degraded at epoch {r.get('epoch')} "
@@ -468,6 +509,7 @@ _RULES: List[Tuple[str, Callable[[Dict], Optional[Dict]]]] = [
     ("corrupt-artifact", _rule_corrupt_artifact),
     ("config-error", _rule_config_error),
     ("desync", _rule_desync),
+    ("sdc", _rule_sdc),
     ("storage-fault", _rule_storage_fault),
     ("recompile-storm", _rule_recompile_storm),
     ("divergence", _rule_divergence),

@@ -17,8 +17,12 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 12  # v12: autoscale record kind (closed-loop scale
-#                      decisions with triggering evidence)
+SCHEMA_VERSION = 13  # v13: integrity record kind (SDC detector
+#                      outcomes: digest scrub, Freivalds compute
+#                      verification, halo wire checksum —
+#                      resilience/integrity.py)
+#                 v12: autoscale record kind (closed-loop scale
+#                          decisions with triggering evidence)
 #                 v11: blackbox record kind (flight-recorder crash
 #                          dumps, obs/flight.py) + diagnosis record kind
 #                          (postmortem verdicts, obs/postmortem.py +
@@ -366,7 +370,7 @@ BLACKBOX_FIELDS: Dict[str, str] = {
 # failed-step auto-explain): the confidence-ranked root cause of a run.
 # verdict names the failure class (wedged-collective | oom |
 # fallback-exhausted | corrupt-artifact | config-error | desync |
-# storage-fault | recompile-storm | divergence | preemption |
+# sdc | storage-fault | recompile-storm | divergence | preemption |
 # clean-exit | unknown); evidence is the citing strings (file: record)
 # the rule matched on; deterministic says whether a supervisor should
 # fail fast (True: relaunching reproduces the failure) or keep its
@@ -405,6 +409,30 @@ AUTOSCALE_FIELDS: Dict[str, str] = {
     "evidence": "object",          # triggering telemetry snapshot
 }
 
+# one record per integrity-plane detector verdict (resilience/
+# integrity.py, driven by fit() at --integrity-check-every cadence):
+# check names the detector (scrub = fletcher digest compare of device
+# state against its baseline, freivalds = randomized algebraic SpMM
+# verification through the production kernel, wire = the halo
+# checksum lane riding each ppermute distance block); outcome is
+# "ok" | "mismatch"; target attributes the state class the detector
+# guards (params | carry | tables | halo — null when the check spans
+# classes); cadence echoes the configured check period so a reader
+# can judge detection latency from the record alone; overhead_s is
+# the measured host+device cost of THIS check (the bench.py
+# integrity_delta_s lever aggregates it). Extras: detail (bounded
+# human-readable mismatch description), dirty_shards (shard ids the
+# scrubber attributed, drives the dirty-shard rebuild).
+INTEGRITY_FIELDS: Dict[str, str] = {
+    "event": "string",             # "integrity"
+    "epoch": "integer",            # boundary the check ran at
+    "check": "string",             # scrub | freivalds | wire
+    "outcome": "string",           # ok | mismatch
+    "target": "string?",           # params | carry | tables | halo
+    "cadence": "integer",          # configured --integrity-check-every
+    "overhead_s": "number",        # measured cost of this check
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -428,6 +456,7 @@ _BY_EVENT = {
     "blackbox": BLACKBOX_FIELDS,
     "diagnosis": DIAGNOSIS_FIELDS,
     "autoscale": AUTOSCALE_FIELDS,
+    "integrity": INTEGRITY_FIELDS,
 }
 
 _JSON_TYPES = {

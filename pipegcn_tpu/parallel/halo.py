@@ -103,23 +103,71 @@ def _ring_permute(blk: jax.Array, axis_name: str, perm) -> jax.Array:
     return jax.lax.ppermute(blk, axis_name, perm)
 
 
+def wire_sum(x: jax.Array) -> jax.Array:
+    """uint32 wraparound sum of an array's raw bits — the wire-
+    integrity checksum (resilience/integrity.py uses the same
+    order-independent construction for its at-rest digests; a single
+    flipped bit shifts the sum by +-2^k != 0 mod 2^32, so one-flip
+    detection is certain). Jittable; any dtype."""
+    x = x.reshape(-1)
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    size = jnp.dtype(x.dtype).itemsize
+    if size == 1:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+    elif size == 2:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+    else:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32).reshape(-1)
+    if u.shape[0] == 0:
+        return jnp.zeros((), jnp.uint32)
+    return jnp.sum(u, dtype=jnp.uint32)
+
+
 def _permute_compressed(blk: jax.Array, axis_name: str, perm,
-                        transport_dt) -> jax.Array:
+                        transport_dt, guard: bool = False):
     """Ring-permute one distance block, optionally in a narrow wire
     dtype. fp8 payloads use the amax-clamped cast and ship the sender's
     power-of-two inverse scale through the SAME permutation, so the
     receiver decodes with its peer's scale — never its own. The result
-    is always back in blk's original dtype."""
+    is always back in blk's original dtype.
+
+    guard=True adds the wire-integrity checksum lane: the sender's
+    :func:`wire_sum` of the exact permuted payload rides the SAME
+    permutation (like the fp8 inverse scale), the receiver recomputes
+    it on what arrived, and the return becomes ``(blk, bad)`` with
+    ``bad`` an int32 0/1 mismatch flag. The lane is a trace-time
+    choice: guard=False compiles the byte-identical program this
+    module always built."""
+
+    def _guarded(payload):
+        s = _ring_permute(wire_sum(payload), axis_name, perm)
+        rx = _ring_permute(payload, axis_name, perm)
+        return rx, (wire_sum(rx) != s).astype(jnp.int32)
+
     if transport_dt is None:
-        return _ring_permute(blk, axis_name, perm)
+        if not guard:
+            return _ring_permute(blk, axis_name, perm)
+        return _guarded(blk)
     out_dt = blk.dtype
     y, inv = amax_transport_cast(blk, transport_dt)
-    y = _ring_permute(y, axis_name, perm)
+    bad = None
+    if guard:
+        y, bad = _guarded(y)
+    else:
+        y = _ring_permute(y, axis_name, perm)
     if inv is None:
         # bf16 wire: a straight cast round-trips through the permute
-        return y.astype(out_dt)
-    inv = _ring_permute(jnp.asarray(inv, jnp.float32), axis_name, perm)
-    return (y.astype(jnp.float32) * inv).astype(out_dt)
+        out = y.astype(out_dt)
+        return (out, bad) if guard else out
+    if guard:
+        inv, bad_inv = _guarded(jnp.asarray(inv, jnp.float32))
+        bad = jnp.maximum(bad, bad_inv)
+    else:
+        inv = _ring_permute(jnp.asarray(inv, jnp.float32), axis_name,
+                            perm)
+    out = (y.astype(jnp.float32) * inv).astype(out_dt)
+    return (out, bad) if guard else out
 
 
 def exchange_blocks(
@@ -129,7 +177,8 @@ def exchange_blocks(
     axis_name: str,
     num_parts: int,
     transport_dt=None,
-) -> jax.Array:
+    guard: bool = False,
+):
     """Gather boundary rows and ring-exchange them.
 
     h: [N, F] inner rows; send_idx/mask: [P-1, B]. Returns the halo block
@@ -138,27 +187,40 @@ def exchange_blocks(
     dtype (decoded back to h.dtype on arrival) — pipelined-mode halo
     compression; leave None on differentiated paths.
 
+    guard=True (trace-time) threads the wire-integrity checksum lane
+    through every distance block and returns ``(halo, bad)`` — ``bad``
+    an int32 count of distance blocks whose received payload failed
+    the sender's checksum (0 on a healthy wire).
+
     The whole gather->permute->concat runs under the "halo_exchange"
     named scope so --profile-dir traces attribute the ring collectives
     (and their backward scatters) to the phase, not anonymous fusions.
     """
     with jax.named_scope("halo_exchange"):
         blocks = []
+        bad = jnp.zeros((), jnp.int32)
         for d in range(1, num_parts):
             blk = jnp.take(h, send_idx[d - 1], axis=0, mode="clip")
             blk = jnp.where(send_mask[d - 1][:, None], blk, 0.0)
-            blocks.append(
-                _permute_compressed(blk, axis_name,
-                                    _fwd_perm(num_parts, d),
-                                    transport_dt))
+            out = _permute_compressed(blk, axis_name,
+                                      _fwd_perm(num_parts, d),
+                                      transport_dt, guard=guard)
+            if guard:
+                out, b = out
+                bad = bad + b
+            blocks.append(out)
         if not blocks:
             # P=1: no halo, but the empty result must still be marked
             # device-varying so it types consistently as carry state
             # (e.g. in the fused-epoch scan)
-            return _ensure_varying(
+            empty = _ensure_varying(
                 jnp.zeros((0, h.shape[-1]), h.dtype), axis_name
             )
-        return jnp.concatenate(blocks, axis=0)
+            if guard:
+                return empty, _ensure_varying(bad, axis_name)
+            return empty
+        halo = jnp.concatenate(blocks, axis=0)
+        return (halo, bad) if guard else halo
 
 
 def halo_exchange(
@@ -185,7 +247,8 @@ def return_blocks(
     num_parts: int,
     b_max: int,
     transport_dt=None,
-) -> jax.Array:
+    guard: bool = False,
+):
     """Route halo cotangents back to their owners.
 
     halo_grad: [(P-1)*B, F] in distance order. The distance-d block came
@@ -193,21 +256,30 @@ def return_blocks(
     same [(P-1)*B, F] layout — the gradients its peers computed for the
     rows listed in its own send_idx (block d-1 <- peer (r+d)).
     `transport_dt` narrows the wire payload like exchange_blocks — use
-    the cotangent dtype (e5m2 under float8) for gradient range."""
+    the cotangent dtype (e5m2 under float8) for gradient range.
+    guard=True returns ``(blocks, bad)`` like exchange_blocks."""
     with jax.named_scope("bgrad_return"):
         outs = []
+        bad = jnp.zeros((), jnp.int32)
         for d in range(1, num_parts):
             blk = jax.lax.dynamic_slice_in_dim(
                 halo_grad, (d - 1) * b_max, b_max, axis=0
             )
-            outs.append(
-                _permute_compressed(blk, axis_name,
-                                    _bwd_perm(num_parts, d),
-                                    transport_dt))
+            out = _permute_compressed(blk, axis_name,
+                                      _bwd_perm(num_parts, d),
+                                      transport_dt, guard=guard)
+            if guard:
+                out, b = out
+                bad = bad + b
+            outs.append(out)
         if not outs:
             # P=1 empty case: keep the varying type (see exchange_blocks)
-            return _ensure_varying(jnp.zeros_like(halo_grad), axis_name)
-        return jnp.concatenate(outs, axis=0)
+            empty = _ensure_varying(jnp.zeros_like(halo_grad), axis_name)
+            if guard:
+                return empty, _ensure_varying(bad, axis_name)
+            return empty
+        ret = jnp.concatenate(outs, axis=0)
+        return (ret, bad) if guard else ret
 
 
 def make_stale_concat(send_idx: jax.Array, send_mask: jax.Array, n_dst: int):

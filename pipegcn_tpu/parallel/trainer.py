@@ -134,6 +134,13 @@ class TrainConfig:
     # reduced gradient skips that epoch's parameter update (select, no
     # extra dispatch) and the host state machine backs the scale off.
     loss_scale: str = "off"
+    # ---- integrity plane (resilience/integrity.py) ----
+    # epochs between silent-data-corruption checks: the static-table
+    # scrub, the params/carry digest verification, and the Freivalds
+    # aggregation check all run at this cadence, and the pipelined
+    # halo exchange gains its wire-checksum lane. 0 (default) disables
+    # everything and compiles the byte-identical pre-integrity step.
+    integrity_check_every: int = 0
     # Run the P-part SPMD program on ONE device: the identical
     # per-device step is wrapped in jax.vmap(axis_name='parts') instead
     # of shard_map — vmap implements psum/ppermute/axis_index
@@ -752,6 +759,120 @@ class Trainer:
         report.tables_rebuilt = rebuilt
         return report
 
+    # ---------------- integrity plane (resilience/integrity.py) -------
+
+    def _rebuild_static_data(self, dirty=None) -> int:
+        """Rebuild the kernel tables (dirty shards only where the
+        builder supports it) from the host partition artifact and
+        re-upload the static data dict — the SDC scrubber's recovery
+        path. Shares the dirty-shard machinery with streaming
+        (apply_graph_deltas); compiled shapes are untouched, so the
+        zero-recompile pin holds. Returns per-shard rebuild count."""
+        dirty = sorted(int(d) for d in dirty) if dirty else None
+        rebuilt = 0
+        if self._bucket_tables is not None:
+            self._use_bucket(dirty=dirty)
+            rebuilt += len(dirty) if dirty else self.P
+        if self._block_tables is not None:
+            self._use_block()  # block plans are whole-shard
+            rebuilt += self.P
+        if self._gat_tables is not None:
+            from ..ops.gat_bucket import build_sharded_gat_tables
+
+            self._gat_tables = self._cached_tables(
+                "gat", lambda: build_sharded_gat_tables(self.sg))
+            rebuilt += self.P
+        # re-upload, mirroring __init__'s placement dance: edges ride
+        # along only when the pp precompute (or the raw-edge kernel)
+        # needs them, and are trimmed back to a token shape after
+        pp_via_tables = (self._bucket_tables is not None
+                         or self._block_tables is not None)
+        need_edges = (not self._edges_trimmed) or \
+            (self.cfg.use_pp and not pp_via_tables)
+        self.data = self._put_data(skip_edges=not need_edges)
+        if self.cfg.use_pp:
+            self.data["feat"] = self._precompute_pp()
+        if self.cfg.compute_dtype != jnp.float32:
+            self.data["feat"] = self.data["feat"].astype(
+                self.cfg.compute_dtype)
+        if self._edges_trimmed and need_edges:
+            dummy = jnp.zeros((self.P, 8), jnp.int32)
+            self.data["edge_src"] = jax.device_put(dummy, self._shard)
+            self.data["edge_dst"] = jax.device_put(dummy, self._shard)
+        if not rebuilt:
+            rebuilt = self.P  # raw-edge mode: the re-upload itself
+        return rebuilt
+
+    def _inject_bitflip(self, target: str, epoch: int, log_fn) -> bool:
+        """Chaos-lane SDC injection (bitflip@E[:rN]:<target>): flip one
+        bit, host-side, in the named state class on THIS rank. The
+        device programs are never altered (the resilience/faults.py
+        invariant) — the corruption model is state rotting while it
+        sits at the boundary, exactly the window the integrity plane's
+        digest scrub covers."""
+        from ..resilience.integrity import flip_bit
+
+        if jax.process_count() > 1 and target != "params":
+            # fetching a SHARDED array is a cross-process collective
+            # only this rank would run; multi-process drills flip the
+            # replicated params (locally fetchable) instead
+            log_fn(f"bitflip:{target} at epoch {epoch} skipped: "
+                   f"multi-process injection supports params only")
+            return False
+        local_devs = [d for d in self.mesh.devices.flat
+                      if d.process_index == jax.process_index()]
+
+        def _replicate_local(arr):
+            shards = [jax.device_put(arr, d) for d in local_devs]
+            return jax.make_array_from_single_device_arrays(
+                arr.shape, self._repl, shards)
+
+        if target == "params":
+            host_p = jax.device_get(self.state["params"])
+            leaves, treedef = jax.tree_util.tree_flatten(host_p)
+            # a mid-mantissa bit: the corrupt value stays finite (the
+            # point of SDC — the numerics tripwire must NOT see it)
+            leaves[0] = flip_bit(leaves[0], bit=11, index=epoch)
+            self.state = dict(self.state)
+            self.state["params"] = jax.tree_util.tree_map(
+                _replicate_local,
+                jax.tree_util.tree_unflatten(treedef, leaves))
+            return True
+        if target in ("carry", "halo"):
+            comm = self.state.get("comm") or {}
+            group = ("halo" if target == "halo" else
+                     next((k for k in sorted(comm) if k != "halo"),
+                          None))
+            sub = comm.get(group) if group else None
+            if not sub:
+                log_fn(f"bitflip:{target} at epoch {epoch} skipped: "
+                       f"pipelined carry not enabled")
+                return False
+            key = sorted(sub)[0]
+            arr = sub[key]
+            host = flip_bit(jax.device_get(arr), bit=7, index=epoch)
+            comm = dict(comm)
+            comm[group] = dict(sub)
+            comm[group][key] = jax.device_put(jnp.asarray(host),
+                                              arr.sharding)
+            self.state = dict(self.state)
+            self.state["comm"] = comm
+            return True
+        if target == "tables":
+            cand = [k for k in sorted(self.data)
+                    if k.startswith(("bkt_", "blk_", "blkrem_",
+                                     "gat_"))]
+            key = cand[0] if cand else "send_idx"
+            arr = self.data[key]
+            host = flip_bit(jax.device_get(arr), bit=3, index=epoch)
+            self.data = dict(self.data)
+            self.data[key] = jax.device_put(jnp.asarray(host),
+                                            arr.sharding)
+            return True
+        log_fn(f"bitflip:{target} at epoch {epoch} skipped: "
+               f"unknown target class")
+        return False
+
     def _flush_comm_rows(self, report) -> None:
         """Zero the pipelined carry rows invalidated by a patch: halo
         slots whose send-list entry moved/appeared/vanished carry
@@ -941,6 +1062,17 @@ class Trainer:
         # layer-0 exchange at all.
         prefetch = (pipeline and bool(getattr(tcfg, "comm_prefetch", False))
                     and not cfg.use_pp and 0 in glayers)
+        # wire-integrity checksum lane (parallel/halo.py guard=True):
+        # every pipelined ring payload (halo features forward, boundary
+        # grads back) ships a sender-side checksum through the same
+        # permute; receiver mismatches surface as the per-epoch
+        # `wire_bad` metric fit() turns into carry-flush recovery. A
+        # trace-time gate like the tripwire: off (the default) compiles
+        # the byte-identical pre-integrity program. Pipelined mode only
+        # — the vanilla exchange is differentiated and its payloads are
+        # re-verified by the desync detector instead.
+        wire_guard = (pipeline and
+                      int(getattr(tcfg, "integrity_check_every", 0)) > 0)
 
         def step(state, data, rng, scale):
             # strip the leading size-1 device axis of sharded blocks
@@ -955,6 +1087,7 @@ class Trainer:
             psum = lambda x: jax.lax.psum(x, PARTS_AXIS)
 
             fresh_halo: Dict[str, jax.Array] = {}
+            wire_bad: list = []  # per-exchange checksum-mismatch counts
 
             cdt = cfg.compute_dtype
             if pipeline:
@@ -979,10 +1112,15 @@ class Trainer:
                             ds0 = jnp.sqrt(d["in_deg"].astype(jnp.float32))
                             h0 = (h0.astype(jnp.float32)
                                   / ds0[: h0.shape[0], None]).astype(cdt)
-                        fresh_halo["0"] = exchange_blocks(
+                        out = exchange_blocks(
                             h0, d["send_idx"], d["send_mask"],
                             PARTS_AXIS, P, transport_dt=feat_dt,
+                            guard=wire_guard,
                         )
+                        if wire_guard:
+                            out, wb = out
+                            wire_bad.append(wb)
+                        fresh_halo["0"] = out
 
                 def comm_update(i, h):
                     k = str(i)
@@ -1007,11 +1145,15 @@ class Trainer:
                     # only. Layer 0's was already issued at step top
                     # when prefetching (identical payload).
                     if k not in fresh_halo:
-                        fresh_halo[k] = exchange_blocks(
+                        out = exchange_blocks(
                             jax.lax.stop_gradient(h), d["send_idx"],
                             d["send_mask"], PARTS_AXIS, P,
-                            transport_dt=feat_dt,
+                            transport_dt=feat_dt, guard=wire_guard,
                         )
+                        if wire_guard:
+                            out, wb = out
+                            wire_bad.append(wb)
+                        fresh_halo[k] = out
                     return fbuf
             else:
                 probes = {}
@@ -1125,7 +1267,11 @@ class Trainer:
                     new_comm["halo"][k] = fresh_halo[k]
                     # ship this epoch's halo cotangents to their owners
                     bg = return_blocks(probe_grads[k], PARTS_AXIS, P,
-                                       b_max, transport_dt=bgrad_dt)
+                                       b_max, transport_dt=bgrad_dt,
+                                       guard=wire_guard)
+                    if wire_guard:
+                        bg, wb = bg
+                        wire_bad.append(wb)
                     if ls_on:
                         # probe cotangents carry this epoch's loss
                         # scale; the carry stores them UNSCALED (see
@@ -1159,6 +1305,10 @@ class Trainer:
                 m["numerics"] = nf_counts
             if ls_on:
                 m["overflow"] = (gbad > 0).astype(jnp.int32)
+            if wire_guard:
+                local_bad = sum(wire_bad) if wire_bad \
+                    else jnp.zeros((), jnp.int32)
+                m["wire_bad"] = psum(local_bad)
             return new_state, m
 
         if self.emulated:
@@ -1219,6 +1369,8 @@ class Trainer:
                                        for ph in PHASES}
         if ls_on:
             metric_spec["overflow"] = PartitionSpec()
+        if wire_guard:
+            metric_spec["wire_bad"] = PartitionSpec()
         smapped = jax.shard_map(
             step,
             mesh=self.mesh,
@@ -1842,9 +1994,27 @@ class Trainer:
         #                      healthy = recovered (resets the counter)
         last_good = None     # (epoch, host snapshot) rollback target
         coord_on = coord is not None and coord.active
-        # a consensus-propagated peer trip needs the same rollback
-        # machinery whether or not the LOCAL sentinel is armed
-        if sentinel is not None or coord_on:
+        # ---- integrity plane (resilience/integrity.py): SDC
+        # detectors driven at every boundary (cheap dynamic digests)
+        # and at --integrity-check-every cadence (table scrub +
+        # Freivalds). Cadence boundaries are period boundaries: a
+        # fused block must not straddle one ----
+        integ = None
+        integ_every = max(int(getattr(
+            tcfg, "integrity_check_every", 0) or 0), 0)
+        if integ_every > 0:
+            from ..resilience.integrity import (
+                SDC_CODES, SDC_NAMES, IntegrityPlane,
+                request_quarantine)
+            integ = IntegrityPlane(integ_every,
+                                   rank=jax.process_index(),
+                                   log=log_fn)
+            periods.append(integ_every)
+            integ.baseline(self)
+        # a consensus-propagated peer trip (or an SDC params rollback)
+        # needs the same rollback machinery whether or not the LOCAL
+        # sentinel is armed
+        if sentinel is not None or coord_on or integ is not None:
             last_good = (start_epoch, self.host_state())
         snap_every = max(int((sentinel.cfg if sentinel is not None
                               else SentinelConfig()).snapshot_every), 1)
@@ -1943,6 +2113,57 @@ class Trainer:
                                              epoch=epoch,
                                              pending_since=ckpt_pending)
                         ckpt_pending = None
+                # ---- SDC chaos + detection (resilience/integrity):
+                # inject scheduled bit flips FIRST (the corruption
+                # model is state rotting while parked at the
+                # boundary), then run the detectors BEFORE anything
+                # legitimately mutates state below (stream deltas,
+                # desync chaos) — so a mismatch is attributable ----
+                local_sdc_code = 0
+                sdc_results: list = []
+                if fault_plan is not None:
+                    flip_target = fault_plan.due_str_arg(
+                        "bitflip", epoch)
+                    if flip_target is not None and self._inject_bitflip(
+                            flip_target, epoch, log_fn):
+                        log_fn(f"fault-injected bitflip:{flip_target} "
+                               f"at epoch {epoch}")
+                        frec.crumb("bitflip-injected", epoch=epoch,
+                                   target=flip_target)
+                        if metrics is not None:
+                            metrics.fault(
+                                kind="injected", epoch=epoch,
+                                reason=f"bitflip:{flip_target}")
+                if integ is not None:
+                    deep = integ.due(epoch)
+                    sdc_results = integ.run_checks(self, epoch,
+                                                   deep=deep)
+                    for res in sdc_results:
+                        if res.outcome == "mismatch":
+                            frec.crumb("sdc-detected", epoch=epoch,
+                                       check=res.check,
+                                       target=res.target)
+                            log_fn(f"integrity: {res.check} mismatch "
+                                   f"on {res.target} at epoch {epoch}"
+                                   f" ({res.detail})")
+                        # ok records only for the deep (cadence)
+                        # checks: per-boundary ok digests would drown
+                        # the stream
+                        if metrics is not None and (
+                                res.outcome == "mismatch" or deep):
+                            metrics.integrity(
+                                epoch=epoch, check=res.check,
+                                outcome=res.outcome,
+                                target=res.target,
+                                cadence=integ.check_every,
+                                overhead_s=round(res.overhead_s, 6),
+                                detail=res.detail,
+                                dirty_shards=list(res.dirty_shards))
+                    bad = [r for r in sdc_results
+                           if r.outcome == "mismatch"]
+                    if bad:
+                        local_sdc_code = SDC_CODES.get(
+                            bad[0].target, 0)
                 # ---- streaming deltas: the graph changes HERE, at the
                 # boundary where the donated state is consistent ----
                 stream_reports = []
@@ -1997,6 +2218,12 @@ class Trainer:
                         stream_reports.append(rep)
                         if rep.repadded:
                             seen_chunks.clear()
+                if integ is not None and stream_reports:
+                    # the deltas legitimately rebuilt tables and
+                    # flushed carry rows: re-baseline, forget the
+                    # now-stale dynamic digests
+                    integ.baseline(self)
+                    integ.drop_dynamic()
                 if fault_plan is not None and fault_plan.due("crash", epoch):
                     raise RuntimeError(
                         f"fault-injected crash at epoch {epoch}")
@@ -2075,6 +2302,11 @@ class Trainer:
                     self.state["params"] = jax.tree_util.tree_map(
                         _replicate_local, host_p)
                     log_fn(f"fault-injected param desync at epoch {epoch}")
+                    if integ is not None:
+                        # the perturbation targets the DESYNC detector;
+                        # forget the params digests so the integrity
+                        # plane doesn't claim the other lane's fault
+                        integ.drop_dynamic()
                 preempt_reason = (preemption.reason
                                   if preemption is not None
                                   and preemption.requested else None)
@@ -2082,12 +2314,21 @@ class Trainer:
                         fault_plan.due("sigterm", epoch):
                     preempt_reason = preempt_reason or "fault-plan sigterm"
                 preempt_extra = {}
+                sdc_code = local_sdc_code
+                sdc_rank = (jax.process_index()
+                            if local_sdc_code else -1)
                 if coord_on:
                     # boundary consensus: a shutdown request on ANY rank
                     # checkpoints + exits 75 on ALL ranks, in lockstep —
-                    # one rank leaving unilaterally deadlocks the rest
+                    # one rank leaving unilaterally deadlocks the rest.
+                    # The SDC code rides the same word so every rank
+                    # executes the identical recovery below
                     agreed = coord.agree_boundary(
-                        preempt=preempt_reason is not None)
+                        preempt=preempt_reason is not None,
+                        sdc_code=local_sdc_code)
+                    if agreed.sdc:
+                        sdc_code = agreed.sdc_code
+                        sdc_rank = agreed.sdc_rank
                     if agreed.preempt:
                         preempt_extra = {"agreed": True,
                                          "source_rank": agreed.preempt_rank}
@@ -2117,6 +2358,107 @@ class Trainer:
                             coord.note_snapshot(*last_good)
                     # the crash handler below does the rank-0 save
                     raise Preempted(epoch, preempt_reason)
+                # ---- SDC containment & recovery: agreed above, so the
+                # action below runs in lockstep on every rank ----
+                if integ is not None and sdc_code:
+                    sdc_target = SDC_NAMES.get(sdc_code, "params")
+                    dirty = tuple(sorted({
+                        int(s) for r in sdc_results
+                        if r.outcome == "mismatch"
+                        for s in r.dirty_shards}))
+                    frec.crumb("sdc-recover", epoch=epoch,
+                               target=sdc_target)
+                    if metrics is not None:
+                        metrics.fault(
+                            kind="sdc", epoch=epoch,
+                            target=sdc_target,
+                            source_rank=sdc_rank,
+                            strikes=integ.total_detections(),
+                            agreed=coord_on)
+                    # containment first: a member that keeps detecting
+                    # SDC is the defective one — ask to leave the
+                    # fleet (durable marker the elastic supervisor
+                    # consumes at its next replan) before recovering
+                    if (integ.should_quarantine()
+                            and local_sdc_code
+                            and coord is not None
+                            and getattr(coord.cfg, "dir", "")):
+                        # elastic.MEMBER_ENV: the supervisor's member
+                        # id for this process (falls back to the rank
+                        # outside supervised runs)
+                        member = int(os.environ.get(
+                            "PIPEGCN_ELASTIC_MEMBER",
+                            jax.process_index()))
+                        marker = request_quarantine(
+                            coord.cfg.dir, member,
+                            reason="recurring silent data corruption",
+                            strikes=integ.total_detections(),
+                            targets=sorted(integ.detections))
+                        log_fn(f"integrity: recurring SDC "
+                               f"({integ.total_detections()} strikes)"
+                               f"; quarantine requested for member "
+                               f"{member} ({marker})")
+                        if metrics is not None:
+                            metrics.fault(
+                                kind="quarantine-request",
+                                epoch=epoch, member=member,
+                                strikes=integ.total_detections(),
+                                targets=sorted(integ.detections))
+                        if jax.process_count() > 1 \
+                                and last_good is not None:
+                            last_good = (epoch, self.host_state())
+                            if coord is not None:
+                                coord.note_snapshot(*last_good)
+                        raise Preempted(
+                            epoch, "recurring silent data corruption")
+                    if sdc_target == "tables":
+                        n_reb = self._rebuild_static_data(
+                            dirty or None)
+                        integ.baseline(self)
+                        log_fn(f"integrity: rebuilt "
+                               f"{'shards ' + str(list(dirty)) if dirty else 'all shards'}"
+                               f" from the host artifact at epoch "
+                               f"{epoch}")
+                        if metrics is not None:
+                            metrics.recovery(
+                                kind="sdc", epoch=epoch,
+                                target=sdc_target,
+                                tables_rebuilt=n_reb,
+                                dirty_shards=list(dirty))
+                    elif sdc_target in ("halo", "carry"):
+                        # poisoned boundary data: flush the pipelined
+                        # carry (epoch-0 warmup semantics) instead of
+                        # training on it for one more epoch
+                        if tcfg.enable_pipeline:
+                            self.reset_comm()
+                        integ.drop_dynamic()
+                        log_fn(f"integrity: flushed pipelined carry "
+                               f"at epoch {epoch} ({sdc_target} "
+                               f"corruption)")
+                        if metrics is not None:
+                            metrics.recovery(kind="sdc", epoch=epoch,
+                                             target=sdc_target,
+                                             flushed=True)
+                    elif last_good is not None:  # params
+                        rollback_to, good_state = last_good
+                        log_fn(f"integrity: params corruption at "
+                               f"epoch {epoch}; rolling back to "
+                               f"epoch {rollback_to}")
+                        self.restore_state(good_state)
+                        self.last_epoch = rollback_to
+                        if tcfg.enable_pipeline:
+                            self.reset_comm()
+                        integ.drop_dynamic()
+                        if metrics is not None:
+                            metrics.recovery(
+                                kind="sdc", epoch=epoch,
+                                target=sdc_target,
+                                rollback_epoch=rollback_to)
+                        pending = None  # in-flight eval snapshot is
+                        #                 from the corrupt timeline
+                        eval_in_stream = False
+                        epoch = rollback_to
+                        continue
                 if profile_dir and not profiling:
                     if prof_window is not None:
                         if prof_window[0] <= epoch < prof_window[1]:
@@ -2200,9 +2542,11 @@ class Trainer:
                 eval_in_stream = False
                 # ---- kernel fallbacks taken during the dispatch:
                 # surface them as contracted `fallback` records ----
+                fb_new = False
                 for fb in self.fallbacks:
                     if not fb.get("emitted"):
                         fb["emitted"] = True
+                        fb_new = True
                         frec.crumb("fallback", epoch=epoch,
                                    from_impl=fb["from_impl"],
                                    to_impl=fb["to_impl"])
@@ -2216,6 +2560,42 @@ class Trainer:
                         # the downgraded step recompiles; exclude its
                         # first blocks from the timing stats
                         seen_chunks.clear()
+                if fb_new and integ is not None:
+                    # the fallback rebuilt tables one rung down: the
+                    # static baseline (and the carry it flushed) are
+                    # legitimately different now
+                    integ.baseline(self)
+                    integ.drop_dynamic()
+                # ---- halo wire checksum lane (parallel/halo.py):
+                # harvested from the step metrics; a nonzero count
+                # means a ppermute payload arrived with a different
+                # checksum than it left with — flush the poisoned
+                # carry rather than consume it next epoch ----
+                if integ is not None and "wire_bad" in self._last_metrics:
+                    wb_n = int(np.sum(np.asarray(
+                        self._last_metrics["wire_bad"])))
+                    if wb_n:
+                        integ.detections["halo"] = \
+                            integ.detections.get("halo", 0) + 1
+                        frec.crumb("wire-bad", epoch=epoch,
+                                   blocks=wb_n)
+                        log_fn(f"integrity: halo wire checksum "
+                               f"mismatch in {wb_n} distance block(s)"
+                               f" at epoch {epoch}; flushing carry")
+                        if metrics is not None:
+                            metrics.integrity(
+                                epoch=epoch, check="wire",
+                                outcome="mismatch", target="halo",
+                                cadence=integ.check_every,
+                                overhead_s=0.0,
+                                blocks=wb_n)
+                            metrics.fault(kind="sdc", epoch=epoch,
+                                          target="halo", check="wire",
+                                          blocks=wb_n,
+                                          agreed=False)
+                        if tcfg.enable_pipeline:
+                            self.reset_comm()
+                        integ.drop_dynamic()
                 # grad norms ride the step output ([k] arrays for fused
                 # blocks) — harvested here for the metrics records AND
                 # the sentinel check
@@ -2379,6 +2759,11 @@ class Trainer:
                                 local_mismatch=bool(desync_local),
                                 mismatched_leaves=int(
                                     coord.last_desync_mismatch),
+                                # the mismatching leaf NAMES (bounded):
+                                # postmortem evidence distinguishing
+                                # one-tensor corruption from full
+                                # divergence
+                                leaves=list(coord.last_desync_leaves),
                                 source_rank=agreed.desync_rank,
                                 agreed=True)
                         if coord.cfg.desync_resync:
@@ -2386,6 +2771,8 @@ class Trainer:
                                    f"(source rank {agreed.desync_rank}); "
                                    f"resyncing every rank from rank 0")
                             coord.resync(self, epoch + chunk)
+                            if integ is not None:
+                                integ.drop_dynamic()
                             if metrics is not None:
                                 metrics.recovery(kind="desync",
                                                  epoch=epoch + chunk - 1,
@@ -2436,6 +2823,8 @@ class Trainer:
                     # divergent one
                     self.restore_state(good_state)
                     self.last_epoch = rollback_to
+                    if integ is not None:
+                        integ.drop_dynamic()
                     if retries > scfg.max_retries:
                         raise DivergenceError(
                             f"training diverged and "
@@ -2470,6 +2859,11 @@ class Trainer:
                         last_good = (epoch + chunk, self.host_state())
                         if coord is not None:
                             coord.note_snapshot(*last_good)
+                if integ is not None:
+                    # capture params+carry digests at their production
+                    # point; the NEXT boundary verifies state survived
+                    # its parked window unchanged
+                    integ.note_dynamic(self)
                 epoch += chunk - 1  # body below sees the block's last epoch
                 if measure_comm_cost and not comm_measured and \
                         epoch >= min(start_epoch + 5, n_epochs - 1):

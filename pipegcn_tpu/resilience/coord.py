@@ -52,11 +52,12 @@ from .preemption import EXIT_PREEMPTED
 # One int32 vector per rank, summed across ranks by a single psum.
 # Bit slots sum to the number of raisers; rank slots carry (rank + 1)
 # so the source rank is recoverable when exactly one rank raised.
-WORD_LEN = 8
+WORD_LEN = 11
 IDX_TRIP, IDX_TRIP_CODE, IDX_TRIP_RANK = 0, 1, 2
 IDX_PREEMPT, IDX_PREEMPT_RANK = 3, 4
 IDX_DESYNC, IDX_DESYNC_RANK = 5, 6
-IDX_COUNT = 7
+IDX_SDC, IDX_SDC_CODE, IDX_SDC_RANK = 7, 8, 9
+IDX_COUNT = 10
 
 # sentinel trip reasons compressed into a code (free text cannot ride a
 # psum); decoded best-effort on the receiving ranks
@@ -110,6 +111,9 @@ class Agreed:
     preempt_rank: int = -1
     desync: bool = False
     desync_rank: int = -1
+    sdc: bool = False
+    sdc_code: int = 0      # integrity.SDC_CODES target class (0 = none)
+    sdc_rank: int = -1
     n_ranks: int = 1
 
     def trip_reason(self) -> str:
@@ -130,6 +134,18 @@ def digest_leaves(tree: Any) -> np.ndarray:
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     leaves = sorted(leaves, key=lambda kv: _path_str(kv[0]))
     return np.asarray([_crc(np.asarray(v)) for _, v in leaves], np.uint32)
+
+
+def digest_leaf_names(tree: Any) -> list:
+    """Leaf paths in the exact order :func:`digest_leaves` digests them
+    — index i of the digest vector is leaf ``names[i]``, so a digest
+    mismatch can be attributed to a NAMED tensor."""
+    import jax
+
+    from ..utils.checkpoint import _path_str
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return sorted(_path_str(p) for p, _ in leaves)
 
 
 class FaultConsensus:
@@ -416,6 +432,10 @@ class Coordinator:
         self.metrics = metrics
         self.log = log
         self.last_desync_mismatch = 0
+        # names of the mismatching leaves (bounded), so the fault
+        # record can distinguish one-tensor corruption from full
+        # divergence
+        self.last_desync_leaves: list = []
         self._started = False
         # emergency context for the hard-deadline path: the freshest
         # host-side snapshot (device state may be unreachable while the
@@ -557,7 +577,7 @@ class Coordinator:
     # ---------------- consensus ---------------------------------------
 
     def _exchange(self, trip_code: int = 0, preempt: bool = False,
-                  desync: bool = False) -> Agreed:
+                  desync: bool = False, sdc_code: int = 0) -> Agreed:
         word = np.zeros(WORD_LEN, np.int32)
         if trip_code:
             word[IDX_TRIP] = 1
@@ -569,6 +589,10 @@ class Coordinator:
         if desync:
             word[IDX_DESYNC] = 1
             word[IDX_DESYNC_RANK] = self.rank + 1
+        if sdc_code:
+            word[IDX_SDC] = 1
+            word[IDX_SDC_CODE] = sdc_code
+            word[IDX_SDC_RANK] = self.rank + 1
         word[IDX_COUNT] = 1
         # no consensus channel yet (mesh not attached): decode locally
         if self.active and self._consensus is not None:
@@ -587,15 +611,20 @@ class Coordinator:
                                      IDX_TRIP_RANK)
         pre, _, prank = _decode(IDX_PREEMPT, None, IDX_PREEMPT_RANK)
         des, _, drank = _decode(IDX_DESYNC, None, IDX_DESYNC_RANK)
+        sdc, scode, srank = _decode(IDX_SDC, IDX_SDC_CODE, IDX_SDC_RANK)
         return Agreed(trip=trip, trip_code=tcode, trip_rank=trank,
                       preempt=pre, preempt_rank=prank,
                       desync=des, desync_rank=drank,
+                      sdc=sdc, sdc_code=scode, sdc_rank=srank,
                       n_ranks=int(word[IDX_COUNT]))
 
-    def agree_boundary(self, preempt: bool = False) -> Agreed:
-        """Epoch-boundary (pre-dispatch) consensus: preemption
-        requests. Every rank calls this at the same program point."""
-        return self._exchange(preempt=preempt)
+    def agree_boundary(self, preempt: bool = False,
+                       sdc_code: int = 0) -> Agreed:
+        """Epoch-boundary (pre-dispatch) consensus: preemption requests
+        and local SDC verdicts (the integrity plane's checks run at the
+        boundary, before the state they indict gets dispatched again).
+        Every rank calls this at the same program point."""
+        return self._exchange(preempt=preempt, sdc_code=sdc_code)
 
     def agree_step(self, trip_reason: Optional[str] = None,
                    desync: bool = False) -> Agreed:
@@ -621,8 +650,12 @@ class Coordinator:
         VERDICT — like every recovery decision — is agreed."""
         digs = digest_leaves(params_host)
         ref = self._consensus.broadcast0(digs)
-        mism = int(np.sum(digs != ref))
+        bad = np.nonzero(digs != ref)[0]
+        mism = int(bad.size)
         self.last_desync_mismatch = mism
+        names = digest_leaf_names(params_host)
+        self.last_desync_leaves = [
+            names[i] for i in bad[:8] if i < len(names)]
         return mism > 0
 
     def resync(self, trainer, epoch: int) -> None:

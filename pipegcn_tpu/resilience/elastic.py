@@ -686,7 +686,44 @@ class ElasticSupervisor:
             trigger = "rejoin" if victim is None else trigger
             self._log(f"rejoin: members {sorted(rejoining)} fold back in "
                       f"at generation {generation + 1}")
+        members, stripped = self._strip_quarantined(members, rejoining)
+        if stripped:
+            trigger = "quarantine"
         return members, trigger
+
+    def _strip_quarantined(self, members: List[int],
+                           rejoining=frozenset()
+                           ) -> Tuple[List[int], bool]:
+        """Drop quarantined members (resilience/integrity.py markers —
+        recurring silent data corruption on that rank) from the
+        candidate set at every replan. A pending explicit rejoin
+        request is the operator's release valve: it clears the marker
+        and the member stays in. Quarantining EVERY member keeps the
+        full set with a loud log — a fleet of zero trains nothing."""
+        from .integrity import clear_quarantine, read_quarantines
+
+        q = read_quarantines(self.coord_dir)
+        if not q:
+            return members, False
+        for m in sorted(set(rejoining) & set(q)):
+            clear_quarantine(self.coord_dir, m)
+            q.pop(m, None)
+            self._log(f"member {m} released from quarantine by "
+                      f"explicit rejoin request")
+        banned = [m for m in members if m in q]
+        if not banned:
+            return members, False
+        keep = [m for m in members if m not in q]
+        if not keep:
+            self._log(f"every member ({banned}) is quarantined; "
+                      f"keeping the full membership — an operator must "
+                      f"clear the markers to make progress")
+            return members, False
+        reasons = ", ".join(
+            f"m{m}: {q[m].get('reason', '?')}" for m in banned)
+        self._log(f"quarantine: excluding members {banned} from the "
+                  f"next generation ({reasons})")
+        return keep, True
 
     def _flush_ledger_pending(self) -> bool:
         """Retry queued ledger appends in generation order, stopping at
@@ -807,6 +844,11 @@ class ElasticSupervisor:
                 self.n_parts / max(int(self.args.parts_per_node), 1))
             members = list(range(max(n_nodes0, 1)))
             trigger = "start"
+        # quarantine markers survive a supervisor restart: excluded
+        # members stay out until the operator clears them
+        members, stripped = self._strip_quarantined(members)
+        if stripped:
+            trigger = "quarantine"
         latency: Optional[float] = None
 
         while True:

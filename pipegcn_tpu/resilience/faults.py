@@ -91,6 +91,14 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 whole time. Exercises router mark-down + the fleet
                 poll's health-probe reconciliation that routes the
                 healthy-again peer back in (no relaunch involved)
+  bitflip       ``bitflip@E[:rN]:<params|carry|tables|halo>``: one real
+                bit is flipped in the named target class at that epoch
+                boundary — replicated params, the pipelined non-halo
+                carry, a static device kernel table, or a stored halo
+                feature block — exercising the integrity plane's
+                detect/attribute/recover path (resilience/integrity.py,
+                docs/RESILIENCE.md "Silent data corruption"). The
+                class argument is REQUIRED
 
 The optional ``:rN`` qualifier targets one rank (``jax.process_index``)
 so multi-process chaos drills can kill, desynchronize, or hang a single
@@ -124,24 +132,31 @@ from .storage import IO_KINDS
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
          "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin",
          "replica-kill", "graph-delta", "net-delay", "net-drop",
-         "net-partition") + IO_KINDS
+         "net-partition", "bitflip") + IO_KINDS
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire.
 # IO kinds arm at the boundary and disarm by the next checkpoint
 # boundary, so a resume past the arming epoch has outlived them too.
 _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
                    "kill", "replica-kill", "graph-delta", "net-delay",
-                   "net-drop", "net-partition") + IO_KINDS
+                   "net-drop", "net-partition", "bitflip") + IO_KINDS
 
 # the optional third group is 'r<N>' (rank), 'm<K>' (member), or a bare
 # number — the per-kind argument (slow-fs / hang: milliseconds). A
 # rank/member qualifier may additionally be FOLLOWED by a bare arg
-# (``hang@6:r1:250``), the fourth group.
-_ENTRY_RE = re.compile(r"^([a-z-]+)@(\d+)(?::([rm]?)(\d+))?(?::(\d+))?$")
+# (``hang@6:r1:250``) or a word argument (``bitflip@6:r0:tables``),
+# the fourth group.
+_ENTRY_RE = re.compile(
+    r"^([a-z-]+)@(\d+)(?::([rm]?)(\d+))?(?::([a-z0-9]+))?$")
 
 # kinds whose entries may carry a bare numeric argument
 # (slow-fs / hang / net-delay: milliseconds; net-partition: seconds)
 _ARG_KINDS = ("slow-fs", "hang", "net-delay", "net-partition")
+
+# kinds whose entries carry a REQUIRED word argument (the SDC target
+# class); the legal classes live next to the detectors
+_STR_ARG_KINDS = ("bitflip",)
+_BITFLIP_CLASSES = ("params", "carry", "tables", "halo")
 
 
 @dataclasses.dataclass
@@ -151,6 +166,7 @@ class _Entry:
     rank: Optional[int] = None    # None = every rank (``:rN``)
     member: Optional[int] = None  # serving replica target (``:mK``)
     arg: Optional[int] = None     # per-kind argument (slow-fs ms)
+    sarg: Optional[str] = None    # per-kind word argument (bitflip class)
     consumed: bool = False
 
 
@@ -179,7 +195,7 @@ class FaultPlan:
                     f"kind@epoch[:rN] or kind@window[:mK] (e.g. "
                     f"nan-loss@5:r1,sigterm@8,replica-kill@2:m1)")
             kind, epoch = m.group(1), int(m.group(2))
-            erank = emember = earg = None
+            erank = emember = earg = esarg = None
             if m.group(3) == "r":
                 erank = int(m.group(4))
             elif m.group(3) == "m":
@@ -191,17 +207,34 @@ class FaultPlan:
                     raise ValueError(
                         f"bad fault-plan entry {raw!r}: at most one "
                         f"bare numeric argument (kind@E[:rN]:<N>)")
-                earg = int(m.group(5))
+                if m.group(5).isdigit():
+                    earg = int(m.group(5))
+                else:
+                    esarg = m.group(5)
             if earg is not None and kind not in _ARG_KINDS:
                 raise ValueError(
                     f"bad fault-plan entry {raw!r}: a bare numeric "
                     f"argument (kind@E[:rN]:<N>) is only valid for "
                     f"{' / '.join(_ARG_KINDS)} (milliseconds)")
+            if esarg is not None and kind not in _STR_ARG_KINDS:
+                raise ValueError(
+                    f"bad fault-plan entry {raw!r}: expected "
+                    f"kind@epoch[:rN] — a word argument "
+                    f"(kind@E[:rN]:<word>) is only valid for "
+                    f"{' / '.join(_STR_ARG_KINDS)}")
+            if kind in _STR_ARG_KINDS:
+                if esarg not in _BITFLIP_CLASSES:
+                    raise ValueError(
+                        f"bad fault-plan entry {raw!r}: {kind} needs a "
+                        f"target class, one of "
+                        f"{' / '.join(_BITFLIP_CLASSES)} "
+                        f"(e.g. bitflip@6:r0:tables)")
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r}; known: "
                     f"{', '.join(KINDS)}")
-            entries.append(_Entry(kind, epoch, erank, emember, earg))
+            entries.append(_Entry(kind, epoch, erank, emember, earg,
+                                  esarg))
         return cls(entries, rank=rank)
 
     def _mine(self, e: _Entry) -> bool:
@@ -218,6 +251,7 @@ class FaultPlan:
                 + (f":r{e.rank}" if e.rank is not None else "")
                 + (f":m{e.member}" if e.member is not None else "")
                 + (f":{e.arg}" if e.arg is not None else "")
+                + (f":{e.sarg}" if e.sarg is not None else "")
                 for e in self._entries if not e.consumed]
 
     def skip_before(self, start_epoch: int) -> None:
@@ -294,6 +328,17 @@ class FaultPlan:
                     and self._mine(e):
                 e.consumed = True
                 return e.arg if e.arg is not None else 0
+        return None
+
+    def due_str_arg(self, kind: str, epoch: int) -> Optional[str]:
+        """Like :meth:`due`, but returns the entry's word argument —
+        for kinds that carry one (``bitflip@E[:rN]:<class>``). None
+        when nothing is due."""
+        for e in self._entries:
+            if not e.consumed and e.kind == kind and e.epoch <= epoch \
+                    and self._mine(e):
+                e.consumed = True
+                return e.sarg
         return None
 
     def due_in(self, kind: str, lo: int, hi: int) -> Optional[int]:

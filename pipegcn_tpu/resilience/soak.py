@@ -113,6 +113,14 @@ class SoakConfig:
     # replica-count trajectory match the ledger's spawn/retire records
     # and ticket conservation hold through the scale events
     autoscale: bool = False
+    # adds the silent-data-corruption drill: one seeded bitflip per
+    # episode (random target class: params / carry / tables / halo)
+    # with --enable-pipeline and --integrity-check-every; invariant #8
+    # (check_integrity) demands every injected flip be detected within
+    # the cadence, attributed to the right class, and the episode
+    # still resume green
+    integrity: bool = False
+    integrity_every: int = 2
     max_restarts: int = 6
     episode_timeout_s: float = 900.0
     keep_dirs: bool = False  # keep green episode dirs for inspection
@@ -143,6 +151,13 @@ def compose_schedule(cfg: SoakConfig, episode: int) \
             entries.append(f"slow-fs@{e}:{rng.choice((5, 20))}")
         else:
             entries.append(f"{kind}@{e}")
+    if cfg.integrity:
+        # drawn AFTER the base kinds so non-integrity schedules stay
+        # bit-identical for a given seed; one flip per episode keeps
+        # the per-process strike count below the quarantine threshold
+        e = rng.randrange(1, cfg.n_epochs - 1)
+        cls = rng.choice(("params", "carry", "tables", "halo"))
+        entries.append(f"bitflip@{e}:{cls}")
     stream_epoch = min((term_epochs[-1] if term_epochs else 0) + 1,
                        cfg.n_epochs - 1)
     return entries, stream_epoch
@@ -256,6 +271,7 @@ _KIND_TO_CLASS: Dict[str, Tuple[str, ...]] = {
     "kill": ("crash", "wedged-collective", "preemption"),
     "sigterm": ("preemption", "crash"),
     "crash": ("crash", "preemption"),
+    "bitflip": ("sdc",),
 }
 
 
@@ -381,6 +397,61 @@ def check_autoscale(fleet_summary: Optional[Dict],
                 **({"error": "; ".join(errors)} if errors else {}))
 
 
+def check_integrity(metric_files: Sequence[str],
+                    schedule: Sequence[str],
+                    cadence: int) -> Dict:
+    """Invariant #8 (``integrity`` episodes): every scheduled bitflip
+    actually fired (an episode that completes to n_epochs must have
+    crossed the injection epoch in some generation), and every
+    ``fault kind=injected reason=bitflip:<class>`` record has a
+    matching detection — an ``integrity`` mismatch record or an
+    ``sdc`` fault record naming the SAME target class — within
+    ``cadence`` epochs of the injection. Vacuously green when the
+    schedule holds no bitflips."""
+    scheduled = [e for e in schedule if e.startswith("bitflip@")]
+    if not scheduled:
+        return _inv(True, skipped=True)
+    injected: List[Tuple[int, str]] = []
+    detected: List[Tuple[int, str]] = []
+    for path in metric_files:
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                ev = rec.get("event")
+                if (ev == "fault" and rec.get("kind") == "injected"
+                        and str(rec.get("reason", ""))
+                        .startswith("bitflip:")):
+                    injected.append((int(rec.get("epoch", -1)),
+                                     str(rec["reason"]).split(":", 1)[1]))
+                elif ev == "integrity" and rec.get("outcome") == "mismatch":
+                    detected.append((int(rec.get("epoch", -1)),
+                                     str(rec.get("target") or "")))
+                elif ev == "fault" and rec.get("kind") == "sdc":
+                    detected.append((int(rec.get("epoch", -1)),
+                                     str(rec.get("target") or "")))
+    errors = []
+    fired_classes = {cls for _, cls in injected}
+    for entry in scheduled:
+        cls = entry.rsplit(":", 1)[-1]
+        if cls not in fired_classes:
+            errors.append(f"scheduled {entry} never injected")
+    for e, cls in injected:
+        hit = any(dcls == cls and e <= de <= e + max(cadence, 1)
+                  for de, dcls in detected)
+        if not hit:
+            errors.append(f"bitflip:{cls}@{e} undetected within "
+                          f"cadence {cadence}")
+    return _inv(not errors, scheduled=list(scheduled),
+                injected=sorted(set(injected)),
+                detected=sorted(set(detected))[:8],
+                **({"error": "; ".join(errors)} if errors else {}))
+
+
 # ---------------------------------------------------------------------
 # episode driver
 # ---------------------------------------------------------------------
@@ -398,7 +469,7 @@ def _episode_env() -> Dict[str, str]:
 
 def _train_argv(cfg: SoakConfig, ep_dir: str, delta_path: str,
                 stream_epoch: int) -> List[str]:
-    return [
+    argv = [
         "--dataset", cfg.dataset,
         "--n-partitions", str(cfg.n_parts),
         "--parts-per-node", str(cfg.n_parts),  # one member: streaming
@@ -416,6 +487,11 @@ def _train_argv(cfg: SoakConfig, ep_dir: str, delta_path: str,
         "--stream-plan", f"{delta_path}@{stream_epoch}",
         "--metrics-out", os.path.join(ep_dir, "metrics.jsonl"),
     ]
+    if cfg.integrity:
+        # pipeline on so the carry/halo target classes are injectable
+        argv += ["--enable-pipeline",
+                 "--integrity-check-every", str(cfg.integrity_every)]
+    return argv
 
 
 def _write_delta_file(cfg: SoakConfig, episode: int, path: str) -> None:
@@ -590,6 +666,11 @@ def run_episode(cfg: SoakConfig, episode: int,
         "autoscale": (check_autoscale(
             autoscale_summary, os.path.join(ep_dir, "autoscale.jsonl"))
             if cfg.autoscale else _inv(True, skipped=True)),
+        # invariant #8: every injected bitflip detected within cadence,
+        # attributed to the right target class
+        "integrity": (check_integrity(metric_files, schedule,
+                                      cfg.integrity_every)
+                      if cfg.integrity else _inv(True, skipped=True)),
         "resume": _inv(res_rc == 0,
                        rc=res_rc,
                        **({} if res_rc == 0

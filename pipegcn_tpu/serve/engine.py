@@ -172,10 +172,24 @@ class ServingEngine:
             exchange_fn, mesh=mesh, in_specs=(spec, st_spec),
             out_specs=spec))
 
+        # wire-integrity guard on the dirty-row exchange: a trace-time
+        # choice (guard off compiles the historical byte-identical
+        # program), so the no-recompile pin holds either way — the inc
+        # program still traces exactly once per engine
+        wire_guard = int(getattr(trainer.tcfg, "integrity_check_every",
+                                 0) or 0) > 0
+        self._wire_guard = wire_guard
+        self.wire_bad_total = 0
+
         def inc_fn(feat, halo0, dirty, d):
             TRACE_COUNTS["inc"] += 1
             d = {k: v[0] for k, v in d.items()}
             h = send_view(feat[0], d["in_deg"])
+            if wire_guard:
+                new, bad = dirty_exchange_blocks(
+                    h, halo0[0], dirty[0], d["send_idx"],
+                    d["send_mask"], PARTS_AXIS, P, guard=True)
+                return new[None], jax.lax.psum(bad, PARTS_AXIS)
             new = dirty_exchange_blocks(
                 h, halo0[0], dirty[0], d["send_idx"], d["send_mask"],
                 PARTS_AXIS, P)
@@ -183,7 +197,8 @@ class ServingEngine:
 
         self._inc_prog = jax.jit(jax.shard_map(
             inc_fn, mesh=mesh, in_specs=(spec, spec, spec, st_spec),
-            out_specs=spec), donate_argnums=(1,))
+            out_specs=(spec, repl) if wire_guard else spec),
+            donate_argnums=(1,))
 
         def refresh_fn(params, norm, feat, halo0, d):
             TRACE_COUNTS["refresh"] += 1
@@ -543,15 +558,41 @@ class ServingEngine:
         self.topo_generation += 1
         return touched
 
-    def refresh_boundary(self) -> int:
+    def refresh_boundary(self, ml=None) -> int:
         """Replay the send-list exchange for dirty rows only, merging
         fresh values into the resident halo cache (bit-identical to a
-        full re-exchange — pinned by test). Returns slots refreshed."""
+        full re-exchange — pinned by test). Returns slots refreshed.
+
+        With the wire-integrity guard on (--integrity-check-every),
+        a checksum mismatch on any dirty-row block discards the merge
+        and rebuilds the whole halo from a full exchange — the
+        recovery hammer — recording a contracted ``integrity`` event
+        on `ml` when a metrics logger is supplied."""
         if not self.freshness.any:
             return 0
         n = self.cache.n_stale
-        self._halo0 = self._inc_prog(
-            self._feat, self._halo0, self.freshness.dirty, self._static)
+        if self._wire_guard:
+            new_halo, bad = self._inc_prog(
+                self._feat, self._halo0, self.freshness.dirty,
+                self._static)
+            wb = int(bad)
+            if wb:
+                self.wire_bad_total += wb
+                # the merged halo is suspect: rebuild from scratch
+                new_halo = self.full_boundary_exchange()
+                if ml is not None:
+                    ml.integrity(epoch=self.topo_generation,
+                                 check="wire", outcome="mismatch",
+                                 target="halo", cadence=0,
+                                 overhead_s=0.0, blocks=wb,
+                                 detail="serving dirty-row exchange; "
+                                        "halo rebuilt via full "
+                                        "exchange")
+            self._halo0 = new_halo
+        else:
+            self._halo0 = self._inc_prog(
+                self._feat, self._halo0, self.freshness.dirty,
+                self._static)
         self.freshness.clear()
         self.cache.mark_fresh()
         self._halo_lag = 0

@@ -85,10 +85,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_msg(sock: socket.socket) -> dict:
+    """Read one length-prefixed JSON message. Every way a torn,
+    corrupt, or hostile byte stream can present — an absurd length
+    (a desynced/garbage prefix decodes as a huge uint32), a zero
+    length, payload that is not valid JSON, or JSON that is not an
+    object — raises ConnectionError, which the per-connection handler
+    treats as a clean close of THAT connection; the server and its
+    other connections are unaffected."""
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    if n > _MAX_MSG:
-        raise ConnectionError(f"message length {n} exceeds cap")
-    return json.loads(_recv_exact(sock, n))
+    if n == 0 or n > _MAX_MSG:
+        raise ConnectionError(
+            f"message length {n} outside (0, {_MAX_MSG}]: "
+            f"torn or hostile prefix")
+    payload = _recv_exact(sock, n)
+    try:
+        msg = json.loads(payload)
+    except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+        raise ConnectionError(f"malformed message payload: {exc}") \
+            from exc
+    if not isinstance(msg, dict):
+        raise ConnectionError(
+            f"message is {type(msg).__name__}, expected object")
+    return msg
 
 
 def _encode_f32(arr: np.ndarray) -> dict:

@@ -22,7 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..parallel.halo import _fwd_perm
+from ..parallel.halo import _fwd_perm, _permute_compressed
 
 
 class FreshnessTracker:
@@ -49,7 +49,8 @@ class FreshnessTracker:
 
 
 def dirty_exchange_blocks(h, halo, dirty, send_idx, send_mask,
-                          axis_name: str, num_parts: int):
+                          axis_name: str, num_parts: int,
+                          guard: bool = False):
     """Inside-shard_map: re-exchange only dirty send-list rows and
     merge them into the resident halo block `halo` ([(P-1)*B, F]).
 
@@ -58,21 +59,39 @@ def dirty_exchange_blocks(h, halo, dirty, send_idx, send_mask,
     transport compression), so its merged value equals the full
     exchange's; a clean masked row keeps its prior exact value; a
     masked-off slot was zero at init and its dirty bit never fires.
+
+    guard=True rides the same wire-integrity checksum lane as the
+    training exchange (parallel/halo.py): each distance block — the
+    row payload AND its dirty-bit lane — ships its sender-side
+    checksum through the SAME permutation and the return becomes
+    ``(merged, bad)`` with ``bad`` an int32 count of mismatching
+    blocks on this shard. guard=False compiles the byte-identical
+    program this module always built.
     """
     if num_parts == 1:
-        return halo
+        return (halo, jnp.zeros((), jnp.int32)) if guard else halo
     rows_out, bits_out = [], []
+    bad = jnp.zeros((), jnp.int32)
     for d in range(1, num_parts):
         idx = send_idx[d - 1]
         blk = jnp.take(h, idx, axis=0, mode="clip")
         bit = jnp.take(dirty, idx, axis=0, mode="clip") & send_mask[d - 1]
         blk = jnp.where(bit[:, None], blk, jnp.zeros((), blk.dtype))
         perm = _fwd_perm(num_parts, d)
-        blk = jax.lax.ppermute(blk, axis_name, perm)
         # bool collectives are flaky across backends; ship the bit as u8
-        bit = jax.lax.ppermute(bit.astype(jnp.uint8), axis_name, perm)
+        bit8 = bit.astype(jnp.uint8)
+        if guard:
+            blk, b0 = _permute_compressed(blk, axis_name, perm, None,
+                                          guard=True)
+            bit8, b1 = _permute_compressed(bit8, axis_name, perm, None,
+                                           guard=True)
+            bad = bad + b0 + b1
+        else:
+            blk = jax.lax.ppermute(blk, axis_name, perm)
+            bit8 = jax.lax.ppermute(bit8, axis_name, perm)
         rows_out.append(blk)
-        bits_out.append(bit != 0)
+        bits_out.append(bit8 != 0)
     fresh = jnp.concatenate(rows_out, axis=0)
     bits = jnp.concatenate(bits_out, axis=0)
-    return jnp.where(bits[:, None], fresh.astype(halo.dtype), halo)
+    merged = jnp.where(bits[:, None], fresh.astype(halo.dtype), halo)
+    return (merged, bad) if guard else merged

@@ -133,3 +133,16 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
 timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m live \
     -p no:cacheprovider "$@"
+
+# Integrity lane (docs/RESILIENCE.md "Silent data corruption"): the
+# SDC defense plane — Fletcher digest host/device bit-parity, the
+# seeded bitflip-detection matrix (every target class x kernel
+# family detected within the configured cadence with the exact
+# contracted `integrity` record), the halo wire-checksum lane, the
+# quarantine marker round-trip, and `pipegcn-debug scrub` on a real
+# run dir. The recurring-SDC two-process quarantine drill is marked
+# slow and rides here too; run standalone so an integrity regression
+# fails the chaos lane even when someone trims the tier-1 selection.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m integrity \
+    -p no:cacheprovider "$@"

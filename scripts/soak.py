@@ -59,6 +59,14 @@ def main(argv=None) -> int:
                     help="add the closed-loop autoscale drill (flash-"
                          "crowd + replica-kill + mid-crowd net-"
                          "partition; invariant #7) to each episode")
+    ap.add_argument("--integrity", action="store_true",
+                    help="add the silent-data-corruption drill (one "
+                         "seeded bitflip per episode, pipeline on, "
+                         "--integrity-check-every; invariant #8)")
+    ap.add_argument("--integrity-every", type=int,
+                    default=d.integrity_every,
+                    help="integrity-check cadence used by the SDC "
+                         "drill")
     ap.add_argument("--max-restarts", type=int, default=d.max_restarts)
     ap.add_argument("--episode-timeout", type=float,
                     default=d.episode_timeout_s)
@@ -71,6 +79,7 @@ def main(argv=None) -> int:
         checkpoint_every=a.checkpoint_every, out_dir=a.out_dir,
         dataset=a.dataset, force_faults=tuple(a.force_fault),
         serve=a.serve, autoscale=a.autoscale,
+        integrity=a.integrity, integrity_every=a.integrity_every,
         max_restarts=a.max_restarts,
         episode_timeout_s=a.episode_timeout, keep_dirs=a.keep_dirs)
     summary = run_soak(cfg)

@@ -150,6 +150,17 @@ QUEUE = [
       "--stream-deltas", "6",
       "--metrics-out", "results/stream_bench_metrics.jsonl"],
      3600, []),
+    # round-18: the integrity plane's per-check overhead measured on
+    # chip — bench.py's floor-lever pass times the headline config
+    # with --integrity-check-every 1 (worst-case cadence: digest
+    # capture/verify + static scrub + Freivalds + the wire-checksum
+    # lane every boundary) against the unguarded base and publishes
+    # integrity_check_delta_s in the BENCH json; the guard is a
+    # trace-time choice, so the delta is pure check cost, never
+    # recompile cost (docs/RESILIENCE.md "Silent data corruption")
+    ("integrity_overhead",
+     [sys.executable, "bench.py", "--no-compare", "--force-candidate"],
+     3600, [_BENCH_PART]),
     # VERDICT r5 item 8: second shape point for the auto-kernel policy
     ("offshape_products",
      [sys.executable, "scripts/offshape_bench.py", "--shape",

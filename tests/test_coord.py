@@ -339,8 +339,8 @@ def test_peer_preemption_propagates_and_checkpoints(sharded, tmp_path,
     orig = c.agree_boundary
     state = {"fired": False}
 
-    def fake_agree_boundary(preempt=False):
-        a = orig(preempt=preempt)
+    def fake_agree_boundary(preempt=False, sdc_code=0):
+        a = orig(preempt=preempt, sdc_code=sdc_code)
         if not state["fired"] and c._progress_epoch >= 6 and not preempt:
             state["fired"] = True
             return Agreed(preempt=True, preempt_rank=1, n_ranks=2)
