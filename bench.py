@@ -998,6 +998,80 @@ def _measure(args, backend, device_kind, n_parts, degraded, sg,
                 if floor.get(ref) and floor.get(var):
                     extras[dkey] = round(floor[ref] - floor[var], 4)
 
+        # ---- training-span pass (obs/trainspan.py) --------------------
+        # Two questions, one crash-isolated block. (1) What do the
+        # always-on spans SAY about this config: measured overlap
+        # (overlap_spans), mean comm-wait share, per-rank straggler
+        # gaps (bench is usually single-controller, so the straggler
+        # map is often empty). (2) What do they COST: spans-on vs
+        # spans-off epoch time published as train_traces_delta_s
+        # (positive = tracing off is faster; expect ~0, the plane is
+        # host-side bookkeeping). fit() drives both runs because the
+        # span plane lives there — eval off, temp metrics sink, and
+        # measure_comm_cost so the comm tail arms.
+        if (((backend == "tpu" and not args.small)
+             or args.force_candidate)
+                and not extras.get("degraded")):
+            import tempfile
+
+            from pipegcn_tpu.obs import MetricsLogger
+            from pipegcn_tpu.obs.metrics import read_metrics
+            from pipegcn_tpu.obs.trainspan import fold_spans
+
+            tspan_t = {}
+
+            def _span_fit(name, traces):
+                try:
+                    t0 = time.perf_counter()
+                    tr_s = Trainer(sg, cfg, TrainConfig(
+                        lr=0.01, n_epochs=args.blocks * blk,
+                        enable_pipeline=headline_pipeline, seed=0,
+                        eval=False, fused_epochs=blk,
+                        train_traces=traces))
+                    path = os.path.join(
+                        tempfile.mkdtemp(prefix="bench-tspan-"),
+                        f"{name}.jsonl")
+                    with MetricsLogger(path) as ml:
+                        r = tr_s.fit(metrics=ml,
+                                     log_fn=lambda *_a, **_k: None,
+                                     measure_comm_cost=True)
+                    tspan_t[name] = (round(r["epoch_time"], 4)
+                                     if r.get("epoch_time") else None)
+                    print(f"# train-span pass {name}: "
+                          f"{tspan_t[name]}s/epoch "
+                          f"(total {time.perf_counter()-t0:.0f}s)",
+                          file=sys.stderr)
+                    del tr_s
+                    return path
+                except Exception as exc:  # noqa: BLE001
+                    tspan_t[name] = None
+                    print(f"# train-span pass {name} failed: {exc!r}",
+                          file=sys.stderr)
+                    return None
+
+            on_path = _span_fit("spans-on", True)
+            if on_path:
+                try:
+                    fold = fold_spans(read_metrics(on_path))
+                    if fold.get("overlap_spans") is not None:
+                        extras["overlap_spans"] = round(
+                            fold["overlap_spans"], 4)
+                    shares = fold.get("comm_wait_share_by_rank") or {}
+                    if shares:
+                        extras["comm_wait_share"] = round(
+                            sum(shares.values()) / len(shares), 4)
+                    gaps = fold.get("straggler_gap_s_by_rank") or {}
+                    if gaps:
+                        extras["straggler_gap_s"] = {
+                            f"r{r}": v for r, v in gaps.items()}
+                except Exception as exc:  # noqa: BLE001
+                    print(f"# train-span fold failed: {exc!r}",
+                          file=sys.stderr)
+            _span_fit("spans-off", False)
+            if tspan_t.get("spans-on") and tspan_t.get("spans-off"):
+                extras["train_traces_delta_s"] = round(
+                    tspan_t["spans-on"] - tspan_t["spans-off"], 4)
+
         # ---- reorder x slab before/after pass -------------------------
         # The locality lever's evidence: the SAME bucket program timed
         # on (1) the unreordered artifact, (2) the reordered one, and

@@ -437,6 +437,7 @@ def run(args) -> dict:
         numerics_tripwire=args.numerics_tripwire,
         loss_scale=args.loss_scale,
         integrity_check_every=args.integrity_check_every,
+        train_traces=not args.no_train_traces,
     )
     trainer = Trainer(sg, cfg, tcfg)
 

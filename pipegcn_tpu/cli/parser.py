@@ -433,6 +433,16 @@ def create_parser() -> argparse.ArgumentParser:
                              "docs/OBSERVABILITY.md) to this file; "
                              "summarize with python -m "
                              "pipegcn_tpu.cli.report")
+    parser.add_argument("--no-train-traces", "--no_train_traces",
+                        action="store_true",
+                        help="disable the always-on training-span plane "
+                             "(per-block compute/halo_exchange/"
+                             "bgrad_return/grad_reduce/checkpoint/eval "
+                             "spans + tracesync clock anchors in the "
+                             "metrics stream; obs/trainspan.py, "
+                             "docs/OBSERVABILITY.md 'Training traces'). "
+                             "Spans are host-side only and inert "
+                             "without --metrics-out")
     parser.add_argument("--sharded-eval", "--sharded_eval",
                         action="store_true",
                         help="evaluate through the training mesh instead "

@@ -216,6 +216,37 @@ def summarize_run(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
                                                         (int, float)):
             out["overlap_divergence"] = bool(abs(meas - est) > 0.25)
 
+    # ---- training-path spans (obs/trainspan.py, schema v14) ----
+    # the always-on span plane yields a MEASURED overlap verdict with
+    # no profiler capture window, plus per-rank comm-wait share and
+    # straggler attribution on the tracesync-aligned clock
+    from ..obs.trainspan import fold_spans, train_spans
+
+    if train_spans(records):
+        fold = fold_spans(records)
+        if fold.get("overlap_spans") is not None:
+            out["overlap_spans"] = round(fold["overlap_spans"], 4)
+        if fold.get("comm_wait_share_by_rank"):
+            out["comm_wait_share_by_rank"] = {
+                f"r{r}": round(v, 4)
+                for r, v in fold["comm_wait_share_by_rank"].items()}
+        if fold.get("straggler_max_gap_s") is not None:
+            out["straggler_max_gap_s"] = fold["straggler_max_gap_s"]
+            out["straggler_rank"] = fold["straggler_rank"]
+        if fold.get("offsets"):
+            out["trace_clock_offsets"] = {
+                f"r{r}": v for r, v in fold["offsets"].items()}
+        # span-derived divergence fallback: the same 0.25 threshold as
+        # the profiler window, applied whenever no window ran — runs
+        # without a capture still get the trust check
+        est = out.get("overlapped_comm_fraction",
+                      out.get("comm_fraction"))
+        if (isinstance(est, (int, float))
+                and out.get("overlap_spans") is not None
+                and "overlap_divergence" not in out):
+            out["overlap_divergence"] = bool(
+                abs(out["overlap_spans"] - est) > 0.25)
+
     # ---- staleness probes (--staleness-probe-every) ----
     stale = [r for r in records if r.get("event") == "staleness"]
     drifts = [r["max_rel_drift"] for r in stale
@@ -448,6 +479,19 @@ def format_summary(path: str, s: Dict[str, Any]) -> str:
     # can no longer be trusted at this config
     row("overlap (estimated)", "overlapped_comm_fraction", "{:.2%}")
     row("overlap (measured)", "measured_overlap_fraction", "{:.2%}")
+    # always-on span verdict (obs/trainspan.py) — present even when no
+    # profiler window ran, so every traced run gets a measured number
+    row("overlap (spans)", "overlap_spans", "{:.2%}")
+    if s.get("comm_wait_share_by_rank"):
+        lines.append("  {:<26} {}".format(
+            "comm wait share (spans)", ", ".join(
+                f"{k}={v:.1%}" for k, v in
+                sorted(s["comm_wait_share_by_rank"].items()))))
+    if s.get("straggler_max_gap_s") is not None:
+        lines.append("  {:<26} r{} (+{:.0f} ms behind median start)"
+                     .format("straggler (spans)",
+                             s.get("straggler_rank", "?"),
+                             s["straggler_max_gap_s"] * 1e3))
     if s.get("overlap_divergence"):
         lines.append(f"  {'!! overlap divergence':<26} measured and "
                      f"estimated overlap differ by > 0.25")

@@ -51,6 +51,11 @@ RULE_DEFAULTS: Dict[str, Dict[str, Any]] = {
     # a known source produced nothing for horizon_s (covers the
     # missing-heartbeat case: heartbeat records stop arriving)
     "silent-source": {"horizon_s": 30.0, "severity": "page"},
+    # training-span straggler attribution (obs/trainspan.py): the same
+    # rank arrived last at the dispatch boundary for the last `sustain`
+    # attributed epochs, each time by more than factor * the rolling
+    # median epoch time — a persistently slow rank, not a one-off blip
+    "straggler-skew": {"factor": 0.5, "sustain": 3, "severity": "warn"},
 }
 
 DEFAULT_RULES: List[Dict[str, Any]] = [
@@ -59,6 +64,7 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {"rule": "staleness-age"},
     {"rule": "fault-rate"},
     {"rule": "silent-source"},
+    {"rule": "straggler-skew"},
 ]
 
 
@@ -187,6 +193,34 @@ class AlertEngine:
                 age = agg.silent_for(src)
                 yield (src, age > horizon, age, horizon,
                        f"no records for {age:.1f}s")
+        elif rid == "straggler-skew":
+            ts = (agg.trainspan()
+                  if hasattr(agg, "trainspan") else None)
+            per_epoch = (ts or {}).get("per_epoch") or {}
+            attributed = [(e, pe) for e, pe in sorted(per_epoch.items())
+                          if pe.get("straggler_rank") is not None]
+            if not attributed:
+                return
+            times = [t for h in agg.epoch_times.values() for t in h]
+            med = _median(times) if times else 0.0
+            thr = float(cfg["factor"]) * med
+            sustain = max(int(cfg["sustain"]), 1)
+            recent = attributed[-sustain:]
+            recent_ranks = {pe["straggler_rank"] for _, pe in recent}
+            # every ever-attributed rank gets an observation so a fired
+            # instance can RESOLVE once the skew stops
+            for r in sorted({pe["straggler_rank"]
+                             for _, pe in attributed}):
+                red = (med > 0 and len(recent) >= sustain
+                       and recent_ranks == {r}
+                       and min(pe.get("gap_s", 0.0)
+                               for _, pe in recent) > thr)
+                gap = max((pe.get("gap_s", 0.0) for _, pe in recent
+                           if pe["straggler_rank"] == r), default=0.0)
+                yield (f"r{r}", red, gap, thr,
+                       f"rank {r} arrived {gap * 1e3:.0f} ms behind "
+                       f"the median boundary (median epoch "
+                       f"{med:.3f}s, sustain {len(recent)})")
 
     # ---------------- edges -------------------------------------------
 
@@ -365,6 +399,18 @@ def prometheus_text(agg: LiveAggregator,
         if kind == "span":
             gauge("pipegcn_spans_total", n, {"source": src},
                   mtype="counter")
+    # training-span verdicts (obs/trainspan.py fold over the live
+    # buffer): the always-on measured overlap + rank-skew surface
+    ts = agg.trainspan() if hasattr(agg, "trainspan") else None
+    if ts:
+        gauge("pipegcn_overlap_fraction", ts.get("overlap_spans"))
+        for r, s in sorted(ts.get("comm_wait_s_by_rank",
+                                  {}).items()):
+            gauge("pipegcn_comm_wait_seconds", s, {"rank": str(r)})
+        for r, g in sorted(ts.get("straggler_gap_s_by_rank",
+                                  {}).items()):
+            gauge("pipegcn_straggler_gap_seconds", g,
+                  {"rank": str(r)})
     if engine is not None:
         for inst in engine.firing():
             gauge("pipegcn_alert_firing", 1, inst)

@@ -213,6 +213,11 @@ class LiveAggregator:
       shed_by_reason[reason]  run-wide shed row totals
       autoscale_counts[action]  run-wide scale-decision totals
       integrity_counts[outcome]  run-wide integrity-check totals
+      trainspan()            span-derived training verdicts (measured
+                             overlap fraction, per-rank comm-wait,
+                             straggler attribution) folded from the
+                             bounded train-span/tracesync buffers
+                             (obs/trainspan.py fold_spans)
       quarantined            members with a standing SDC quarantine
       last_seen[source]       clock time a record last ARRIVED — the
                               silent-source alert's input
@@ -222,6 +227,11 @@ class LiveAggregator:
     The clock is injectable so alert-horizon tests run on a fake."""
 
     HISTORY = 64  # epoch-time history per source (regression window)
+    # bounded train-span/tracesync buffer: at ~5 spans + 1 anchor per
+    # rank per dispatched block this covers hundreds of recent epochs,
+    # and the overlap/straggler verdicts are about the RECENT run
+    # anyway (the report CLI folds whole streams post-hoc)
+    SPAN_HISTORY = 4096
 
     def __init__(self, target: str, validate: bool = True,
                  clock=time.time):
@@ -248,6 +258,10 @@ class LiveAggregator:
         self.quarantined: set = set()
         self.last_seen: Dict[str, float] = {}
         self.epoch_times: Dict[str, List[float]] = {}
+        # training-path span plane (obs/trainspan.py): bounded raw
+        # buffers folded on demand by trainspan()
+        self._train_spans: List[Dict[str, Any]] = []
+        self._tracesync: List[Dict[str, Any]] = []
         self.n_records = 0
         self.n_invalid = 0
         self.schema_version: Optional[int] = None
@@ -325,6 +339,14 @@ class LiveAggregator:
         elif kind == "autoscale":
             a = str(rec.get("action"))
             self.autoscale_counts[a] = self.autoscale_counts.get(a, 0) + 1
+        elif kind == "span":
+            tid = rec.get("trace_id")
+            if isinstance(tid, str) and tid.startswith("train-e"):
+                self._train_spans.append(rec)
+                del self._train_spans[:-self.SPAN_HISTORY]
+        elif kind == "tracesync":
+            self._tracesync.append(rec)
+            del self._tracesync[:-self.SPAN_HISTORY]
         elif kind == "serving":
             by = rec.get("shed_by_reason")
             if isinstance(by, dict):
@@ -345,6 +367,16 @@ class LiveAggregator:
     def silent_for(self, source: str) -> float:
         """Seconds since `source` last produced a record."""
         return max(self._clock() - self.last_seen.get(source, 0.0), 0.0)
+
+    def trainspan(self) -> Optional[Dict[str, Any]]:
+        """Span-derived training verdicts over the recent buffer
+        (obs/trainspan.fold_spans): measured overlap fraction, per-rank
+        comm-wait, straggler attribution on the aligned clock. None
+        until any train span has arrived."""
+        if not self._train_spans:
+            return None
+        from .trainspan import fold_spans
+        return fold_spans(self._train_spans + self._tracesync)
 
     def snapshot(self) -> Dict[str, Any]:
         """Plain-dict rollup for /health and --follow: per-source ages
@@ -401,6 +433,18 @@ class LiveAggregator:
                      "shed", "staleness_age", "param_generation",
                      "param_staleness")}
                 for s, r in serving.items()}
+        ts = self.trainspan()
+        if ts is not None:
+            # the live pipeline-overlap verdict + straggler attribution
+            # (docs/OBSERVABILITY.md "Training traces")
+            snap["trainspan"] = {
+                "overlap_spans": ts["overlap_spans"],
+                "comm_wait_share_by_rank": ts["comm_wait_share_by_rank"],
+                "straggler_gap_s_by_rank": ts["straggler_gap_s_by_rank"],
+                "straggler_max_gap_s": ts["straggler_max_gap_s"],
+                "straggler_rank": ts["straggler_rank"],
+                "clock_offsets": ts["offsets"],
+            }
         if membership:
             snap["membership"] = {
                 s: {"generation": r.get("generation"),

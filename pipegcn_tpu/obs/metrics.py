@@ -556,6 +556,22 @@ class MetricsLogger:
             **extra,
         })
 
+    def tracesync(self, rank: int, epoch: int, t_anchor: float,
+                  generation: int = 0, **extra) -> Dict[str, Any]:
+        """One training clock anchor (obs/trainspan.py): this rank's
+        wall-clock reading of the dispatched block's harvest barrier.
+        NOT hard-flushed — same volume/durability class as spans (one
+        per dispatched block; the flush-per-write default lands them,
+        and every fault path hard-flushes the whole sink anyway)."""
+        return self.write({
+            "event": "tracesync",
+            "rank": int(rank),
+            "epoch": int(epoch),
+            "t_anchor": float(t_anchor),
+            "generation": int(generation),
+            **extra,
+        })
+
     def blackbox(self, rank: int, reason: str,
                  crumbs: Sequence[Dict[str, Any]],
                  last_crumb: Optional[Dict[str, Any]],

@@ -17,7 +17,11 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 13  # v13: integrity record kind (SDC detector
+SCHEMA_VERSION = 14  # v14: tracesync record kind (per-rank training
+#                      clock anchors at collective barriers —
+#                      obs/trainspan.py, docs/OBSERVABILITY.md
+#                      "Training traces")
+#                 v13: integrity record kind (SDC detector
 #                      outcomes: digest scrub, Freivalds compute
 #                      verification, halo wire checksum —
 #                      resilience/integrity.py)
@@ -433,6 +437,21 @@ INTEGRITY_FIELDS: Dict[str, str] = {
     "overhead_s": "number",        # measured cost of this check
 }
 
+# one record per dispatched training block per rank (obs/trainspan.py):
+# the rank's wall-clock anchor for the block's harvest barrier. Every
+# rank's compiled step for epoch E can only complete once the gradient
+# all-reduce has, so the anchors for epoch E mark the same physical
+# instant on every rank; trainspan.estimate_offsets folds them into
+# per-rank clock offsets and the timeline / straggler attribution /
+# overlap math all run on the aligned clock. Extras: source (r<k>).
+TRACESYNC_FIELDS: Dict[str, str] = {
+    "event": "string",             # "tracesync"
+    "rank": "integer",             # process that wrote the anchor
+    "epoch": "integer",            # first epoch of the dispatched block
+    "t_anchor": "number",          # unix seconds at the harvest barrier
+    "generation": "integer",       # membership generation of the run
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -453,6 +472,7 @@ _BY_EVENT = {
     "soak": SOAK_FIELDS,
     "alert": ALERT_FIELDS,
     "span": SPAN_FIELDS,
+    "tracesync": TRACESYNC_FIELDS,
     "blackbox": BLACKBOX_FIELDS,
     "diagnosis": DIAGNOSIS_FIELDS,
     "autoscale": AUTOSCALE_FIELDS,

@@ -28,7 +28,14 @@ Perfetto (ui.perfetto.dev) or chrome://tracing:
     docs/SERVING.md) become ``X`` slices on a "spans" track, and every
     trace id shared across streams is stitched into a Perfetto *flow*
     (``s``/``t``/``f`` events) so one query reads as an arrow chain
-    router -> replica -> engine across processes.
+    router -> replica -> engine across processes;
+  - training-path spans (``train-e<E>`` trace ids, obs/trainspan.py)
+    ride a dedicated per-rank "train" track on the tracesync-ALIGNED
+    clock (per-rank offsets from the grad_reduce barrier anchors), and
+    each epoch's matching collective spans (grad_reduce /
+    bgrad_return / per-layer halo_exchange) are stitched into
+    cross-rank flows — the rank-skew picture the straggler
+    attribution quantifies.
 
 Chrome-trace JSON contract kept deliberately strict (the timeline test
 pins it): object with "traceEvents" (list) + "displayTimeUnit"; every
@@ -42,9 +49,12 @@ import json
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .trainspan import COMM_OPS, TRAIN_OPS, estimate_offsets
+
 # wall-clock-stamped record kinds rendered beyond the training tracks
 _WALL_KINDS = ("serving", "fleet", "membership", "stream", "soak",
                "alert")
+_TRAIN_TRACE = "train-e"
 
 
 def _scalar_args(r: Dict[str, Any]) -> Dict[str, Any]:
@@ -89,6 +99,18 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
     events: List[Dict[str, Any]] = []
     meta: List[Dict[str, Any]] = []
 
+    # training-span clock alignment (obs/trainspan.py): per-rank
+    # offsets estimated from the tracesync / grad_reduce barrier
+    # anchors; every train span renders (and stitches) on the aligned
+    # clock t - offset
+    train_off = estimate_offsets(
+        [r for _, records in rank_records for r in records])
+
+    def _train_aligned(rec: Dict[str, Any], rank: int,
+                       t: float) -> float:
+        r = rec.get("rank")
+        return t - train_off.get(r if isinstance(r, int) else rank, 0.0)
+
     # pass 1: per-rank epoch start maps; establish the global alignment
     per_rank = []
     any_unstamped = False
@@ -105,6 +127,9 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
                  else r.get("time_unix")
                  if r.get("event") in _WALL_KINDS else None)
             if isinstance(t, (int, float)):
+                if r.get("event") == "span" and str(
+                        r.get("trace_id", "")).startswith(_TRAIN_TRACE):
+                    t = _train_aligned(r, rank, float(t))
                 wall_min = t if wall_min is None else min(wall_min, t)
 
     if any_unstamped:
@@ -261,14 +286,32 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
                         and isinstance(t_start, (int, float))
                         and isinstance(dur_ms, (int, float))):
                     continue
-                ts = wus(float(t_start))
-                extra_tids.add(5)
+                is_train = (tid_.startswith(_TRAIN_TRACE)
+                            and r.get("op") in TRAIN_OPS)
+                if is_train:
+                    # dedicated per-rank "train" track on the ALIGNED
+                    # clock; flows stitch each epoch's MATCHING
+                    # collective spans across ranks (per op, per halo
+                    # layer), not every span of the epoch
+                    ts = wus(_train_aligned(r, rank, float(t_start)))
+                    track = 6
+                    if r.get("op") in COMM_OPS:
+                        fkey = f"{tid_}|{r['op']}"
+                        if isinstance(r.get("layer"), int):
+                            fkey += f"|L{r['layer']}"
+                        span_sites.setdefault(fkey, []).append(
+                            (ts, pid, track))
+                else:
+                    ts = wus(float(t_start))
+                    track = 5
+                    span_sites.setdefault(tid_, []).append(
+                        (ts, pid, track))
+                extra_tids.add(track)
                 events.append({
-                    "ph": "X", "pid": pid, "tid": 5, "ts": ts,
+                    "ph": "X", "pid": pid, "tid": track, "ts": ts,
                     "dur": round(max(float(dur_ms), 0.0) * 1e3, 3),
                     "name": str(r.get("op", "span")),
                     "args": _scalar_args(r)})
-                span_sites.setdefault(tid_, []).append((ts, pid, 5))
             elif ev == "profile":
                 a = r.get("epoch_start")
                 b = r.get("epoch_end")
@@ -291,7 +334,8 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
                                    "args": {"device_s": sec}})
                     cursor += dur
 
-        for tid, tname in ((3, "serving"), (4, "events"), (5, "spans")):
+        for tid, tname in ((3, "serving"), (4, "events"), (5, "spans"),
+                           (6, "train")):
             if tid in extra_tids:
                 meta.append({"ph": "M", "pid": pid, "tid": tid,
                              "name": "thread_name",
@@ -308,11 +352,14 @@ def build_timeline(rank_records: Sequence[Tuple[int, Sequence[Dict[str, Any]]]]
             continue
         sites.sort()
         fid = zlib.crc32(trace_id.encode("utf-8"))
+        # train-collective flow keys carry "|" (trace|op[|layer]);
+        # serving flows stay the plain per-query chain
+        cat = "collective" if "|" in trace_id else "query"
         for i, (ts, pid, tid) in enumerate(sites):
             ph = "s" if i == 0 else ("f" if i == len(sites) - 1
                                      else "t")
             fe = {"ph": ph, "pid": pid, "tid": tid, "ts": ts,
-                  "cat": "query", "name": "query", "id": fid}
+                  "cat": cat, "name": cat, "id": fid}
             if ph == "f":
                 fe["bp"] = "e"
             events.append(fe)

@@ -151,6 +151,14 @@ class TrainConfig:
     # update). Not for production scaling — collectives become
     # in-device data movement.
     emulate_parts: bool = False
+    # ---- training-span plane (obs/trainspan.py) ----
+    # always-on per-rank span emission into the metrics sink: compute /
+    # halo_exchange / bgrad_return / grad_reduce / checkpoint / eval
+    # spans per dispatched block plus the tracesync clock anchors.
+    # Host-side Python only — zero effect on the compiled programs
+    # (tests/test_trainspan.py pins zero recompiles with spans hot).
+    # --no-train-traces turns it off; inert without a metrics sink.
+    train_traces: bool = True
 
 
 class Trainer:
@@ -1900,6 +1908,8 @@ class Trainer:
             if metrics is not None:
                 metrics.eval_record(e, eval_wait, float(acc),
                                     **eval_extra)
+            if tspan is not None:
+                tspan.eval_span(e, eval_wait)
             history.append((e + 1, p["loss"], acc))
             if acc > best_val:
                 best_val = acc
@@ -1915,6 +1925,17 @@ class Trainer:
         comm_cost = {"comm": 0.0, "reduce": 0.0, "bgrad": 0.0}
         comm_measured = False
         timer = PhaseTimer()
+        # ---- training-span plane (obs/trainspan.py): always-on
+        # per-rank spans + tracesync clock anchors into the metrics
+        # sink. Host-side only — nothing here touches a traced
+        # program, so the zero-recompile pins hold with spans hot ----
+        tspan = None
+        if metrics is not None and getattr(tcfg, "train_traces", True):
+            from ..obs.trainspan import TrainSpanPlane
+            tspan = TrainSpanPlane(
+                metrics, rank=jax.process_index(),
+                generation=(max(coord.cfg.generation, 0)
+                            if coord is not None else 0))
         profiling = False
         n_epochs = tcfg.n_epochs
         # ---- profiling window + staleness probes (obs/profiler.py) ----
@@ -2499,6 +2520,20 @@ class Trainer:
                     chunk = 1
                     old_halo = jax.tree_util.tree_map(
                         jnp.copy, self.state["comm"]["halo"])
+                slow_ms = (fault_plan.due_arg("slow-rank", epoch)
+                           if fault_plan is not None else None)
+                if slow_ms:
+                    # deterministic straggler (slow-rank@E[:rN]:<ms>):
+                    # this rank arrives late at the dispatch boundary,
+                    # so every peer waits on its collectives inside the
+                    # compiled step. The training-span plane's aligned
+                    # compute-window starts attribute the gap to this
+                    # rank (obs/trainspan.py straggler attribution)
+                    log_fn(f"fault-injected {slow_ms} ms straggle at "
+                           f"epoch {epoch}")
+                    frec.crumb("slow-rank-injected", epoch=epoch,
+                               slow_ms=slow_ms)
+                    time.sleep(slow_ms / 1000.0)
                 timer.clear()
                 # dispatch span left OPEN across the step: if the
                 # program wedges inside (a dead collective), the crash
@@ -2516,6 +2551,11 @@ class Trainer:
                         loss = float(blk_losses[-1])
                     jax.block_until_ready(self.state["params"])
                 frec.exit("dispatch", epoch=epoch)
+                if tspan is not None:
+                    # the block's spans: the real dispatch->harvest wall
+                    # window, plus (once measure_comm landed) the comm
+                    # tail ending at the harvest barrier
+                    tspan.block(epoch, chunk, timer.durations()["step"])
                 dur = timer.durations()["step"] / chunk
                 stop_profile = profiling and (
                     epoch + chunk >= prof_window[1]
@@ -2873,6 +2913,27 @@ class Trainer:
                     # the step, so we report the collectives' own cost)
                     comm_cost = self.measure_comm()
                     comm_measured = True
+                    if tspan is not None:
+                        # arm the comm tail: standalone per-epoch costs
+                        # apportioned over the exchanged layers by wire
+                        # bytes (the same arithmetic as
+                        # est_halo_bytes_per_epoch, kept per-layer)
+                        item = 4 if self.cfg.compute_dtype == jnp.float32 \
+                            else 2
+                        hdt = (getattr(tcfg, "halo_dtype", "none")
+                               or "none") if tcfg.enable_pipeline \
+                            else "none"
+                        if hdt == "float8":
+                            item = 1
+                        elif hdt == "bfloat16":
+                            item = min(item, 2)
+                        tspan.set_comm(
+                            comm_cost,
+                            [(i, 2 * self.P * self.sg.halo_size
+                              * self._layer_width(i) * item)
+                             for i in self._graph_layer_range()],
+                            hdt if hdt != "none" else
+                            ("float32" if item == 4 else "bfloat16"))
                     if reference_logs:
                         # semantics differ from the reference: its Comm(s)
                         # is per-epoch EXPOSED wait around blocking
@@ -2922,6 +2983,7 @@ class Trainer:
                     # semantics, and N-1 fewer multi-GB writes to the
                     # shared filesystem)
                     frec.enter("checkpoint-io", epoch=epoch + 1)
+                    ck_t0 = tspan.clock() if tspan is not None else 0.0
                     host = self.host_state()
                     if jax.process_index() == 0:
                         try:
@@ -2985,6 +3047,13 @@ class Trainer:
                                                   epoch=epoch + 1,
                                                   reason="corrupt-ckpt")
                     frec.exit("checkpoint-io", epoch=epoch + 1)
+                    if tspan is not None:
+                        t1 = tspan.clock()
+                        tspan.checkpoint_span(
+                            epoch + 1, t1 - ck_t0, t_end=t1,
+                            status=("error"
+                                    if ckpt_pending == epoch + 1
+                                    else "ok"))
                 epoch += 1
 
         except BaseException as exc:
@@ -2999,6 +3068,13 @@ class Trainer:
             # snapshot when one exists — the previous periodic
             # checkpoint survives either way (saves are atomic, and the
             # generation rotation keeps the older good ones).
+            if tspan is not None:
+                # fault path: make the spans already emitted durable
+                # before any recovery/exit handling can end the process
+                try:
+                    tspan.flush()
+                except Exception:  # noqa: BLE001
+                    pass
             converted = None
             if (coord is not None and coord.active
                     and not isinstance(exc, (Preempted, PeerLost,

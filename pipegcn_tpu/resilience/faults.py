@@ -72,6 +72,14 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 that a torn artifact is indistinguishable from absent
   ro-dir        ``ro-dir@E``: opens-for-write raise EROFS over the
                 window — the artifact directory went read-only
+  slow-rank     ``slow-rank@E[:rN]:<ms>``: a host-side sleep of <ms>
+                milliseconds at rank rN's dispatch boundary — a
+                deterministic straggler (one rank arrives late at the
+                epoch's collectives while the others wait inside the
+                compiled step). Exercises the training-span straggler
+                attribution + the straggler-skew alert rule
+                (obs/trainspan.py, docs/OBSERVABILITY.md "Training
+                traces"); available to scripts/soak.py episodes
   slow-fs       ``slow-fs@E:<ms>``: every durable-write seam op sleeps
                 <ms> milliseconds over the window — a degraded shared
                 filesystem; nothing fails, progress just crawls
@@ -130,16 +138,17 @@ from typing import List, Optional
 from .storage import IO_KINDS
 
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
-         "desync", "hang", "overflow", "kernel-crash", "kill", "rejoin",
-         "replica-kill", "graph-delta", "net-delay", "net-drop",
-         "net-partition", "bitflip") + IO_KINDS
+         "desync", "hang", "slow-rank", "overflow", "kernel-crash",
+         "kill", "rejoin", "replica-kill", "graph-delta", "net-delay",
+         "net-drop", "net-partition", "bitflip") + IO_KINDS
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire.
 # IO kinds arm at the boundary and disarm by the next checkpoint
 # boundary, so a resume past the arming epoch has outlived them too.
-_BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "kernel-crash",
-                   "kill", "replica-kill", "graph-delta", "net-delay",
-                   "net-drop", "net-partition", "bitflip") + IO_KINDS
+_BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "slow-rank",
+                   "kernel-crash", "kill", "replica-kill",
+                   "graph-delta", "net-delay", "net-drop",
+                   "net-partition", "bitflip") + IO_KINDS
 
 # the optional third group is 'r<N>' (rank), 'm<K>' (member), or a bare
 # number — the per-kind argument (slow-fs / hang: milliseconds). A
@@ -150,8 +159,10 @@ _ENTRY_RE = re.compile(
     r"^([a-z-]+)@(\d+)(?::([rm]?)(\d+))?(?::([a-z0-9]+))?$")
 
 # kinds whose entries may carry a bare numeric argument
-# (slow-fs / hang / net-delay: milliseconds; net-partition: seconds)
-_ARG_KINDS = ("slow-fs", "hang", "net-delay", "net-partition")
+# (slow-fs / hang / slow-rank / net-delay: milliseconds;
+# net-partition: seconds)
+_ARG_KINDS = ("slow-fs", "hang", "slow-rank", "net-delay",
+              "net-partition")
 
 # kinds whose entries carry a REQUIRED word argument (the SDC target
 # class); the legal classes live next to the detectors

@@ -83,9 +83,11 @@ from .storage import IO_KINDS
 
 # terminal kinds end the generation; the supervisor relaunches
 TERMINAL_KINDS = ("kill", "sigterm", "crash")
-# in-process kinds: the run recovers without a restart
+# in-process kinds: the run recovers without a restart (slow-rank is
+# a pure perturbation — a host-side sleep at one dispatch boundary
+# that the training-span plane must attribute, obs/trainspan.py)
 SOFT_KINDS = ("nan-loss", "kernel-crash", "corrupt-ckpt",
-              "graph-delta") + IO_KINDS
+              "graph-delta", "slow-rank") + IO_KINDS
 
 _REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -149,6 +151,9 @@ def compose_schedule(cfg: SoakConfig, episode: int) \
             e = rng.randrange(1, cfg.n_epochs - 1)
         if kind == "slow-fs":
             entries.append(f"slow-fs@{e}:{rng.choice((5, 20))}")
+        elif kind == "slow-rank":
+            # ms of injected dispatch-boundary straggle (slow-rank@E:ms)
+            entries.append(f"slow-rank@{e}:{rng.choice((50, 200))}")
         else:
             entries.append(f"{kind}@{e}")
     if cfg.integrity:

@@ -134,6 +134,22 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m live \
     -p no:cacheprovider "$@"
 
+# Trainspan lane (docs/OBSERVABILITY.md "Training traces"): the
+# training-path distributed-tracing plane — per-rank span emission
+# conservation + comm-tail geometry, tracesync clock-offset recovery
+# on planted skew, span-fold overlap agreement with the profiler
+# fold, straggler attribution, the straggler-skew alert
+# fire/dedupe/resolve under a fake clock, timeline cross-rank flow
+# stitching, the report span-overlap fallback, and the zero-recompile
+# pin with spans hot. The two-process slow-rank drill (slow-rank@E:rN
+# stalls one rank's dispatch; attribution must name it, the alert
+# must fire, spans must survive) is marked faults+slow and so also
+# rides the broad faults lane; run the marker standalone so a tracing
+# regression is named even when the broad lane is trimmed.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m trainspan \
+    -p no:cacheprovider "$@"
+
 # Integrity lane (docs/RESILIENCE.md "Silent data corruption"): the
 # SDC defense plane — Fletcher digest host/device bit-parity, the
 # seeded bitflip-detection matrix (every target class x kernel

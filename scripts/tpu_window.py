@@ -161,6 +161,16 @@ QUEUE = [
     ("integrity_overhead",
      [sys.executable, "bench.py", "--no-compare", "--force-candidate"],
      3600, [_BENCH_PART]),
+    # round-19: the always-on training-span plane measured on chip —
+    # bench.py's train-span pass drives two fit() runs (spans on vs
+    # off) over the headline config and publishes the span-derived
+    # verdicts (overlap_spans, comm_wait_share, per-rank
+    # straggler_gap_s) plus the tracing cost train_traces_delta_s in
+    # the BENCH json (expected ~0: the plane is host-side bookkeeping;
+    # docs/OBSERVABILITY.md "Training traces")
+    ("train_spans",
+     [sys.executable, "bench.py", "--no-compare", "--force-candidate"],
+     3600, [_BENCH_PART]),
     # VERDICT r5 item 8: second shape point for the auto-kernel policy
     ("offshape_products",
      [sys.executable, "scripts/offshape_bench.py", "--shape",

@@ -168,7 +168,7 @@ def test_alert_rules_load_and_reject_typos(tmp_path):
     rules = load_rules(None)
     assert [r["rule"] for r in rules] == [
         "epoch-time-regression", "shed-rate", "staleness-age",
-        "fault-rate", "silent-source"]
+        "fault-rate", "silent-source", "straggler-skew"]
     p = tmp_path / "rules.json"
     p.write_text(json.dumps([
         {"rule": "epoch-time-regression", "factor": 2.0},

@@ -205,6 +205,22 @@ def test_schema_v10_drift_guard():
         assert obs_schema.SCHEMA_VERSION > 10
 
 
+_V14_TRACESYNC_FIELDS = {
+    "event": "string", "rank": "integer", "epoch": "integer",
+    "t_anchor": "number", "generation": "integer",
+}
+
+
+def test_schema_v14_drift_guard():
+    if obs_schema.SCHEMA_VERSION == 14:
+        for name, tag in _V14_TRACESYNC_FIELDS.items():
+            assert obs_schema.TRACESYNC_FIELDS.get(name) == tag, (
+                f"schema field tracesync.{name} removed or retyped "
+                f"without bumping SCHEMA_VERSION")
+    else:
+        assert obs_schema.SCHEMA_VERSION > 14
+
+
 def test_validate_record():
     validate_record({"event": "epoch", "epoch": 0, "step_time_s": 0.1,
                      "loss": 1.0, "grad_norm": 0.5, "halo_bytes": 128,
