@@ -388,6 +388,16 @@ def main():
     ap.add_argument("--stream-slack", type=float, default=0.10,
                     help="fractional padding headroom reserved for "
                          "in-place growth in the --stream build")
+    ap.add_argument("--stream-journal-dir", type=str, default="",
+                    help="persistent write-ahead delta journal for the "
+                         "--stream measurement (stream/journal.py); "
+                         "unset = ephemeral, non-resumable")
+    ap.add_argument("--stream-resume", action="store_true",
+                    help="resume a --stream measurement mid-schedule: "
+                         "replay every journaled delta from "
+                         "--stream-journal-dir against the rebuilt "
+                         "nominal graph, then deliver only the "
+                         "remaining scheduled deltas live")
     ap.add_argument(_STAGE_FLAG, type=int, default=0, dest="stage",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -1293,14 +1303,34 @@ def _measure_stream(args, backend, device_kind, n_parts, degraded,
         g, n_batches=n_deltas + 2, edges_per_batch=epb,
         dels_per_batch=max(4, epb // 2),
         nodes_per_batch=max(1, g.num_nodes // 10_000), seed=0)
+    # optional durability: a persistent WAL journal makes the
+    # measurement resumable mid-schedule — a killed run's applied
+    # deltas replay from the journal, the remainder deliver live
+    journal = None
+    replay_stats = None
+    if args.stream_journal_dir:
+        from pipegcn_tpu.stream import DeltaJournal, replay_for_resume
+
+        journal = DeltaJournal(args.stream_journal_dir)
     with tempfile.TemporaryDirectory(prefix="bench-stream-") as td:
         dpath = os.path.join(td, "deltas.jsonl")
         save_deltas(dpath, batches[:n_deltas])
         plan = StreamPlan.parse(f"{dpath}@{n_warm}:1")
+        if journal is not None and args.stream_resume:
+            wm = journal.last_seq()
+            replay_stats = replay_for_resume(
+                journal, wm, trainer.apply_graph_deltas, plan=plan)
+            plan.skip_journaled(wm)
+            print(f"# stream: resumed mid-schedule — replayed "
+                  f"{replay_stats['replayed']} journaled delta(s) "
+                  f"(+{replay_stats['rederived']} re-derived), "
+                  f"{plan.remaining()} still scheduled",
+                  file=sys.stderr)
         mpath = os.path.join(td, "metrics.jsonl")
         t0 = time.perf_counter()
         with MetricsLogger(mpath) as ml:
             trainer.fit(None, metrics=ml, stream_plan=plan,
+                        journal=journal,
                         log_fn=lambda m: print(f"# {m}",
                                                file=sys.stderr))
         fit_s = time.perf_counter() - t0
@@ -1388,6 +1418,13 @@ def _measure_stream(args, backend, device_kind, n_parts, degraded,
         "serve_touched_slots": touched,
         "serve_warmup_s": round(warm_s, 2),
         "topo_generation": engine.topo_generation,
+        "trainer_topo_generation": int(getattr(trainer,
+                                               "topo_generation", 0)),
+        "journal_replayed": (replay_stats["replayed"]
+                             + replay_stats["rederived"]
+                             if replay_stats else 0),
+        "journal_last_seq": (journal.last_seq()
+                             if journal is not None else -1),
     }
     if degraded:
         result["degraded"] = True

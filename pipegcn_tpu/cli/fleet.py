@@ -136,11 +136,52 @@ def _replica_main(args) -> int:
     ml.run_header(config={"replica": rid, "incarnation": inc,
                           "n_partitions": args.n_partitions})
 
+    # crash-consistent streaming: when a durable delta journal exists
+    # (the trainer's WAL, stream/journal.py), the replica replays every
+    # journaled topology delta against its freshly-loaded NOMINAL
+    # artifact BEFORE publishing readiness — the fleet never routes to
+    # a replica serving a stale graph. The callable runs inside
+    # serve_forever, after the port binds but before the ready file.
+    journal_dir = getattr(args, "journal_dir", "") or (
+        os.path.join(args.checkpoint_dir, "journal")
+        if args.checkpoint_dir else "")
+    replay = None
+    if journal_dir and os.path.isdir(journal_dir):
+        def replay():
+            import numpy as np
+
+            from ..graph.datasets import load_data
+            from ..stream import DeltaJournal, GraphPatcher
+
+            journal = DeltaJournal(journal_dir)
+            entries = journal.entries()
+            if not entries:
+                return 0
+            # the patcher needs the host graph + partition assignment
+            # the artifact was built from: reload the dataset (replicas
+            # share the driver's flags, so this is the same graph) and
+            # derive the assignment from the shard's global-id rows
+            g = load_data(args.dataset, args.data_root)
+            sg = trainer.sg
+            parts = np.zeros(g.num_nodes, np.int32)
+            for p in range(sg.num_parts):
+                n = int(sg.inner_count[p])
+                parts[np.asarray(sg.global_nid[p, :n])] = p
+            patcher = GraphPatcher(
+                g, sg, parts,
+                slack=getattr(args, "stream_slack", 0.10))
+            trainer.enable_stream(patcher)
+            for _gen, batch in entries:
+                rep = trainer.apply_graph_deltas(batch)
+                engine.apply_graph_deltas(rep)
+            engine.refresh_boundary()
+            return len(entries)
+
     server = ReplicaServer(
         engine, args.fleet_dir, rid, incarnation=inc, ml=ml,
         checkpoint_dir=args.checkpoint_dir or None,
         swap_poll_s=args.fleet_swap_poll,
-        report_every_s=args.serve_report_every, log=log)
+        report_every_s=args.serve_report_every, replay=replay, log=log)
 
     def _on_signal(signum, frame):  # noqa: ARG001
         server.request_stop()

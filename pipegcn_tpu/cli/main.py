@@ -33,7 +33,8 @@ from ..graph.datasets import inductive_split, load_data
 from ..models.sage import ModelConfig
 from ..partition.halo import ShardedGraph
 from ..partition.partitioner import locality_clusters, partition_graph
-from ..utils.checkpoint import checkpoint_exists, load_checkpoint, save_pytree
+from ..utils.checkpoint import (checkpoint_exists, load_checkpoint,
+                                peek_watermark, save_pytree)
 
 
 def derive_graph_name(args) -> str:
@@ -441,8 +442,10 @@ def run(args) -> dict:
     )
     trainer = Trainer(sg, cfg, tcfg)
 
+    patcher = None
+    journal = None
     if streaming:
-        from ..stream import GraphPatcher
+        from ..stream import DeltaJournal, GraphPatcher
 
         patcher = GraphPatcher(host_g, sg, host_parts,
                                slack=args.stream_slack)
@@ -451,14 +454,51 @@ def run(args) -> dict:
         print(f"streaming enabled: {n_due} delta batch(es) scheduled, "
               f"slack={args.stream_slack:.0%}, "
               f"headroom={patcher.slack_remaining()}")
+        # write-ahead delta journal: defaults under the checkpoint dir
+        # so the elastic supervisor / soak harness inherit durability
+        # with zero extra plumbing; --journal-dir overrides
+        journal_dir = getattr(args, "journal_dir", "") or (
+            os.path.join(args.checkpoint_dir, "journal")
+            if args.checkpoint_dir else "")
+        if journal_dir:
+            journal = DeltaJournal(journal_dir)
+            print(f"delta journal at {journal_dir} "
+                  f"(last durable seq {journal.last_seq()})")
 
     graph_name = args.graph_name or derive_graph_name(args)
     os.makedirs(args.results_dir, exist_ok=True)
     rfile = result_file_name(args)
 
     start_epoch = 0
+    replay_stats = None
+    wm_seq, wm_gen = -1, 0
     if args.resume:
         if checkpoint_exists(args.checkpoint_dir):
+            if journal is not None:
+                # crash-consistent streaming resume: the graph below is
+                # NOMINAL (checkpoints never hold topology), so replay
+                # every journaled seq <= the checkpoint's watermark
+                # BEFORE loading state — the params must meet the graph
+                # they trained against (a replayed re-pad also restores
+                # the carry shapes the checkpoint was saved with). Seqs
+                # past the watermark are uncommitted: truncated here,
+                # re-delivered by the plan at their scheduled epochs.
+                from ..stream import replay_for_resume
+
+                wm_seq, wm_gen = peek_watermark(args.checkpoint_dir)
+                replay_stats = replay_for_resume(
+                    journal, wm_seq, trainer.apply_graph_deltas,
+                    plan=stream_plan)
+                if stream_plan is not None:
+                    stream_plan.skip_journaled(wm_seq)
+                print(f"journal replay to watermark seq={wm_seq}: "
+                      f"{replay_stats['replayed']} replayed, "
+                      f"{replay_stats['rederived']} re-derived from "
+                      f"the plan, {replay_stats['truncated']} "
+                      f"uncommitted entr(ies) rolled back; "
+                      f"topo_generation={trainer.topo_generation}"
+                      + (f" (checkpoint says {wm_gen})"
+                         if trainer.topo_generation != wm_gen else ""))
             # host_state() (not device_get): the sharded comm carry is
             # not process-addressable in multi-host runs; every process
             # resumes together, so the allgather inside is lockstep
@@ -489,6 +529,22 @@ def run(args) -> dict:
             mesh={"n_parts": args.n_partitions,
                   **mesh_info(trainer.mesh)},
         )
+        if replay_stats is not None:
+            # the resume replay ran before the sink existed; its audit
+            # records land here (soak invariant #9 + the topo-rollback
+            # postmortem rule read them)
+            metrics.journal(
+                op="replay", seq=wm_seq,
+                topo_generation=int(trainer.topo_generation),
+                n_records=replay_stats["replayed"], source="resume",
+                rederived=replay_stats["rederived"],
+                watermark_generation=wm_gen)
+            if replay_stats["truncated"]:
+                metrics.journal(
+                    op="truncate", seq=wm_seq,
+                    topo_generation=int(trainer.topo_generation),
+                    n_records=replay_stats["truncated"],
+                    source="resume")
 
     # ---- fault tolerance (docs/RESILIENCE.md) ----
     from ..resilience import (DivergenceSentinel, FaultPlan,
@@ -557,8 +613,29 @@ def run(args) -> dict:
                 preemption=preemption,
                 fault_plan=fault_plan,
                 stream_plan=stream_plan,
+                journal=journal,
                 coord=coord,
             )
+            if journal is not None and args.resume:
+                # prove the replayed topology: the patched tables must
+                # digest-match a from-scratch rebuild of the post-delta
+                # graph (the PR-13 bit-identity oracle as a runtime
+                # check; soak invariant #9 reads this record)
+                from ..stream import verify_against_rebuild
+
+                v = verify_against_rebuild(patcher)
+                print(f"journal verify: tables_match="
+                      f"{v['tables_match']}, topo_generation="
+                      f"{trainer.topo_generation}"
+                      + (f", mismatched tables: {v['mismatch']}"
+                         if v["mismatch"] else ""))
+                if metrics is not None:
+                    metrics.journal(
+                        op="verify", seq=int(patcher.last_seq),
+                        topo_generation=int(trainer.topo_generation),
+                        n_records=0, source="resume",
+                        tables_match=bool(v["tables_match"]),
+                        mismatch=list(v["mismatch"]))
     finally:
         coord.stop()
         # every record is already flushed; close releases the handle

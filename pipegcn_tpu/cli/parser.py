@@ -326,6 +326,17 @@ def create_parser() -> argparse.ArgumentParser:
                              "slots) of the sharded build for streamed "
                              "growth; exhausting it re-pads loudly "
                              "(one recompile) instead of failing")
+    parser.add_argument("--journal-dir", "--journal_dir", type=str,
+                        default="",
+                        help="write-ahead delta journal directory "
+                             "(stream/journal.py): every applied delta "
+                             "batch is made durable before it mutates "
+                             "the topology, and --resume replays the "
+                             "journal to the checkpoint's watermark. "
+                             "Defaults to <checkpoint-dir>/journal "
+                             "when streaming with --checkpoint-dir; "
+                             "set explicitly to journal without "
+                             "checkpoints")
     # ---- numerics guardrails (docs/RESILIENCE.md "Numerics") ----
     parser.add_argument("--loss-scale", "--loss_scale", type=str,
                         default="off",

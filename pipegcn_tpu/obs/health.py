@@ -395,6 +395,15 @@ def prometheus_text(agg: LiveAggregator,
               {"source": src})
     for src, rec in sorted(agg.latest("stream").items()):
         gauge("pipegcn_stream_seq", rec.get("seq"), {"source": src})
+    # write-ahead delta journal (stream/journal.py): the topology
+    # generation each writer last reported, and the replay lag — how
+    # many journaled seqs a crash right now would have to re-apply
+    # (watermark/append records carry lag_seqs; 0 = fully covered)
+    for src, rec in sorted(agg.latest("journal").items()):
+        lab = {"source": src}
+        gauge("pipegcn_topo_generation", rec.get("topo_generation"),
+              lab)
+        gauge("pipegcn_journal_lag_seqs", rec.get("lag_seqs"), lab)
     for (src, kind), n in sorted(agg.counts.items()):
         if kind == "span":
             gauge("pipegcn_spans_total", n, {"source": src},

@@ -472,6 +472,29 @@ class MetricsLogger:
         self.hard_flush()
         return rec
 
+    def journal(self, op: str, seq: int, topo_generation: int,
+                n_records: int = 0, source: str = "trainer",
+                **extra) -> Dict[str, Any]:
+        """One write-ahead delta-journal lifecycle event
+        (stream/journal.py, docs/STREAMING.md "Durability & replay"):
+        append/watermark from the trainer's stream boundary,
+        replay/truncate/verify from a resume, degraded/recovered from
+        the journal's own pending queue, skew from the router.
+        Hard-flushed — the journal records ARE the durability audit
+        trail, so they must survive the very crash they describe."""
+        extra.setdefault("time_unix", time.time())
+        rec = self.write({
+            "event": "journal",
+            "op": str(op),
+            "seq": int(seq),
+            "topo_generation": int(topo_generation),
+            "n_records": int(n_records),
+            "source": str(source),
+            **extra,
+        })
+        self.hard_flush()
+        return rec
+
     def membership(self, generation: int, assignment: Dict[str, Any],
                    trigger: str,
                    restart_latency_s: Optional[float] = None,

@@ -21,6 +21,11 @@ confidence-ranked verdict:
   recompile-storm    repeated recompiles dominated the run
   divergence         sentinel retries exhausted / NaN death
   preemption         a requested, checkpointed, resumable exit
+  topo-rollback      a resume rolled the delta journal back past the
+                     checkpoint watermark (journal op="truncate"
+                     records with dropped entries): topology deltas
+                     applied after the last durable checkpoint were
+                     un-committed and re-delivered by the stream plan
   crash              an uncaught exception not matching the above
   clean-exit         the run completed after the last recorded trouble
   unknown            nothing matched (pipegcn-debug exits 4)
@@ -499,6 +504,47 @@ def _rule_crash(b: Dict) -> Optional[Dict]:
                            "read the cited error before retrying"}
 
 
+def _rule_topo_rollback(b: Dict) -> Optional[Dict]:
+    """Crash-consistent streaming (stream/journal.py): a resume found
+    journal entries PAST the checkpoint watermark — deltas applied
+    after the last durable checkpoint — and rolled them back
+    (op="truncate" with dropped records) for re-delivery by the
+    stream plan. Moderate confidence: the rollback itself is the
+    designed recovery, so a completed run's clean-exit outranks it;
+    it becomes the verdict only when the run died around the
+    rollback."""
+    truncs = [r for r in b.get("records", ())
+              if r.get("event") == "journal"
+              and r.get("op") == "truncate"
+              and int(r.get("n_records", 0)) > 0]
+    if not truncs:
+        return None
+    ev = []
+    for r in truncs[:3]:
+        ev.append(f"journal record: {int(r.get('n_records', 0))} "
+                  f"entr{'y' if int(r.get('n_records', 0)) == 1 else 'ies'} "
+                  f"past watermark seq {r.get('seq')} rolled back "
+                  f"(journal at generation {r.get('topo_generation')})")
+    replays = [r for r in b.get("records", ())
+               if r.get("event") == "journal"
+               and r.get("op") == "replay"]
+    for r in replays[:2]:
+        ev.append(f"journal record: replay of "
+                  f"{int(r.get('n_records', 0))} entr"
+                  f"{'y' if int(r.get('n_records', 0)) == 1 else 'ies'}"
+                  f" (+{int(r.get('rederived', 0))} re-derived from "
+                  f"the plan) up to watermark seq {r.get('seq')}")
+    return {
+        "confidence": 0.6, "evidence": ev,
+        "remediation": "topology deltas newer than the checkpoint "
+                       "watermark were un-committed on resume and "
+                       "re-delivered at their scheduled epochs — "
+                       "verify the run's journal op=\"verify\" record "
+                       "shows tables_match; checkpoint more often "
+                       "(or fsync the journal) to shrink the "
+                       "watermark gap"}
+
+
 # (name, matcher) in priority order; confidence breaks ties the other
 # way, so the order only matters between equal-confidence matches
 _RULES: List[Tuple[str, Callable[[Dict], Optional[Dict]]]] = [
@@ -514,6 +560,7 @@ _RULES: List[Tuple[str, Callable[[Dict], Optional[Dict]]]] = [
     ("recompile-storm", _rule_recompile_storm),
     ("divergence", _rule_divergence),
     ("preemption", _rule_preemption),
+    ("topo-rollback", _rule_topo_rollback),
     ("crash", _rule_crash),
 ]
 

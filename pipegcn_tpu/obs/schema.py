@@ -17,7 +17,12 @@ from __future__ import annotations
 
 from typing import Dict, Mapping
 
-SCHEMA_VERSION = 14  # v14: tracesync record kind (per-rank training
+SCHEMA_VERSION = 15  # v15: journal record kind (write-ahead delta
+#                      journal lifecycle: append / watermark / replay /
+#                      truncate / verify / degraded / recovered / skew
+#                      — stream/journal.py, docs/STREAMING.md
+#                      "Durability & replay")
+#                 v14: tracesync record kind (per-rank training
 #                      clock anchors at collective barriers —
 #                      obs/trainspan.py, docs/OBSERVABILITY.md
 #                      "Training traces")
@@ -238,6 +243,13 @@ SERVING_FIELDS: Dict[str, str] = {
 #                  params; extras: reason
 #   fleet-stop     the supervisor stopped relaunching (max-restarts /
 #                  restart-storm brake); extras: reason
+#   topo-skew      (v15) the replica reported a topo_generation behind
+#                  the fleet maximum — it serves a stale graph and is
+#                  routed around until journal replay catches it up;
+#                  extras: topo_generation, fleet_generation
+#   topo-caught-up (v15) a previously stale replica reported the fleet
+#                  generation again and re-entered routing; extras:
+#                  topo_generation
 FLEET_FIELDS: Dict[str, str] = {
     "event": "string",             # "fleet"
     "kind": "string",              # see above
@@ -452,6 +464,31 @@ TRACESYNC_FIELDS: Dict[str, str] = {
     "generation": "integer",       # membership generation of the run
 }
 
+# one record per write-ahead delta-journal lifecycle event
+# (stream/journal.py, emitted by the trainer's stream boundary, the
+# CLI's resume replay, and serving-replica restarts): op is one of
+# append (a batch became durable and was applied; extras: lag_seqs =
+# journaled seqs a crash right now would replay), watermark (a
+# checkpoint generation landed covering seq), replay (a resume
+# re-applied n_records journaled batches; extras: rederived = records
+# the torn journal lost and the plan re-derived), truncate (WAL
+# rollback past the checkpoint watermark: n_records uncommitted
+# entries dropped — the topo-rollback postmortem signature), verify
+# (the bit-identity oracle ran post-replay; extras: tables_match),
+# degraded / recovered (the journal's own degrade-not-lose queue), and
+# skew (the router observed a replica behind the fleet's
+# topo_generation). source labels the writer (trainer | resume |
+# replica-m<K> | router).
+JOURNAL_FIELDS: Dict[str, str] = {
+    "event": "string",             # "journal"
+    "op": "string",                # append | watermark | replay | ...
+    "seq": "integer",              # delta seq the op is about (-1 none)
+    "topo_generation": "integer",  # topology generation after the op
+    "n_records": "integer",        # records the op touched (0 for point
+    #                              # ops like watermark)
+    "source": "string",            # trainer | resume | replica-m<K> | …
+}
+
 _BY_EVENT = {
     "run": RUN_FIELDS,
     "epoch": EPOCH_FIELDS,
@@ -477,6 +514,7 @@ _BY_EVENT = {
     "diagnosis": DIAGNOSIS_FIELDS,
     "autoscale": AUTOSCALE_FIELDS,
     "integrity": INTEGRITY_FIELDS,
+    "journal": JOURNAL_FIELDS,
 }
 
 _JSON_TYPES = {

@@ -451,14 +451,26 @@ class SequentialRunner:
         loss_sum = 0.0
         grad_sum = None
         start_rank = 0
+        # crash-consistency: the per-rank state is only resumable
+        # against the SAME topology generation it was summed over —
+        # partial gradients straddling a graph delta are silently
+        # wrong, so a generation mismatch restarts the epoch at rank 0
+        gen = int(getattr(self, "topo_generation", 0))
         if state_path is not None and os.path.exists(state_path):
             with open(state_path, "rb") as f:
                 st = pickle.load(f)
-            if st["epoch"] == epoch:
+            if (st["epoch"] == epoch
+                    and int(st.get("topo_generation", 0)) == gen):
                 start_rank = st["next_rank"]
                 loss_sum = st["loss_sum"]
                 grad_sum = st["grad_sum"]
                 self._log(f"resuming epoch {epoch} at rank {start_rank}")
+            elif st["epoch"] == epoch:
+                self._log(
+                    f"discarding per-rank state: summed at "
+                    f"topo_generation {st.get('topo_generation', 0)}, "
+                    f"graph now at {gen} — restarting epoch {epoch} "
+                    f"at rank 0")
         sends_all, probes_all = [], []
         new_norm0 = None
         nf_counts: Dict[str, int] = {}
@@ -494,7 +506,8 @@ class SequentialRunner:
                 with open(state_path + ".tmp", "wb") as f:
                     pickle.dump({"epoch": epoch, "next_rank": r + 1,
                                  "loss_sum": loss_sum,
-                                 "grad_sum": grad_sum}, f)
+                                 "grad_sum": grad_sum,
+                                 "topo_generation": gen}, f)
                 os.replace(state_path + ".tmp", state_path)
             self._log(f"rank {r}: loss_sum {loss_sum:.4f}")
 

@@ -701,6 +701,10 @@ class Trainer:
         # per-shard BucketPlan cache for dirty-shard-only rebuilds
         # (_use_bucket passes it through to build_sharded_bucket_tables)
         self._bucket_plan_cache: dict = {}
+        # topology generation: bumped once per applied DeltaBatch, and
+        # stamped into checkpoints (the journal watermark) so every
+        # resume path knows which graph the params trained against
+        self.topo_generation = int(getattr(self, "topo_generation", 0))
 
     def apply_graph_deltas(self, batch, allow_repad: bool = True):
         """Apply one DeltaBatch to the live trainer: patch the sharded
@@ -765,6 +769,7 @@ class Trainer:
         self._eval_cache.clear()
         self._sharded_eval_cache.clear()
         report.tables_rebuilt = rebuilt
+        self.topo_generation = getattr(self, "topo_generation", 0) + 1
         return report
 
     # ---------------- integrity plane (resilience/integrity.py) -------
@@ -1713,6 +1718,7 @@ class Trainer:
         fault_plan=None,
         coord=None,
         stream_plan=None,
+        journal=None,
     ) -> Dict[str, Any]:
         """The single epoch loop (reference train.py:327-400): periodic
         evaluation, best-val/BN-stats tracking, timing with <5-epoch
@@ -2044,13 +2050,41 @@ class Trainer:
         ckpt_pending = None  # epoch of a failed periodic save awaiting
         #                      retry; the previous generation stays the
         #                      authoritative resume point until it lands
+        # ---- delta-journal state (stream/journal.py) ----
+        journal_pending_since = None  # epoch of the first append the
+        #                               degraded disk rejected
+        last_ckpt_seq = -1  # stream seq the newest checkpoint covers
+        if journal is not None and getattr(self, "_stream", None) is None:
+            raise ValueError(
+                "fit(journal=...) requires enable_stream(patcher): the "
+                "journal records applied DeltaBatches")
+        if journal is not None:
+            # the CLI replays to the checkpoint watermark before fit;
+            # everything journaled now is covered by that checkpoint
+            last_ckpt_seq = int(self._stream.last_seq)
+
+        def _stream_watermark():
+            """Checkpoint extras pairing the state with its topology
+            position (None outside streaming runs — zero npz delta)."""
+            p = getattr(self, "_stream", None)
+            if p is None:
+                return None
+            return {"__stream_seq__": np.asarray(int(p.last_seq),
+                                                 np.int64),
+                    "__topo_generation__": np.asarray(
+                        int(getattr(self, "topo_generation", 0)),
+                        np.int64)}
         if fault_plan is not None:
             # a resumed run gets the same --fault-plan; entries it
             # already lived through must not re-fire
             fault_plan.skip_before(start_epoch)
-        if stream_plan is not None:
-            # a resumed run's checkpointed graph already contains the
-            # deltas applied before start_epoch
+        if stream_plan is not None and journal is None:
+            # LEGACY (journal-less) resume: assume the pre-start_epoch
+            # deltas are already in the graph and drop them. With a
+            # journal the CLI has already replayed to the checkpoint
+            # watermark and called skip_journaled(); every seq past the
+            # watermark stays scheduled, whatever its epoch — the WAL
+            # rollback re-delivers it at the boundary it belongs to.
             stream_plan.skip_before(start_epoch)
         if coord is not None:
             coord.start()
@@ -2186,20 +2220,87 @@ class Trainer:
                         local_sdc_code = SDC_CODES.get(
                             bad[0].target, 0)
                 # ---- streaming deltas: the graph changes HERE, at the
-                # boundary where the donated state is consistent ----
+                # boundary where the donated state is consistent.
+                # WAL-first when a journal is attached: a batch is made
+                # durable BEFORE it mutates the topology; an append the
+                # degraded disk rejects queues the batch (degrade-not-
+                # lose) and the apply waits for a later boundary ----
                 stream_reports = []
                 stream_due = [] if stream_plan is None else \
                     stream_plan.due(epoch)
-                if (stream_due or (fault_plan is not None and
-                                   fault_plan.peek("graph-delta", epoch))) \
+                if (stream_due or journal_pending_since is not None
+                        or (fault_plan is not None and
+                            fault_plan.peek("graph-delta", epoch))) \
                         and pending is not None:
                     # an in-flight async eval was dispatched against the
                     # pre-patch topology; finish it before the graph (and
                     # the host-side eval context) grows under it
                     _harvest_eval(pending)
                     pending = None
+
+                def _journal_gate(db):
+                    """WAL-first: True = durable, apply now. False =
+                    queued pending (or a batch ahead of it is) — do NOT
+                    apply; order is preserved by the queue."""
+                    nonlocal journal_pending_since
+                    if journal is None:
+                        return True
+                    gen = (self.topo_generation + 1
+                           + journal.pending_count)
+                    if journal.append(db, gen):
+                        if metrics is not None:
+                            metrics.journal(
+                                op="append", seq=int(db.seq),
+                                topo_generation=gen, n_records=1,
+                                lag_seqs=max(
+                                    journal.last_seq() - last_ckpt_seq,
+                                    0))
+                        return True
+                    if journal_pending_since is None:
+                        journal_pending_since = epoch
+                        log_fn(f"JOURNAL APPEND FAILED at epoch "
+                               f"{epoch} (seq={db.seq}); io-degraded "
+                               f"— delta queued, NOT applied (WAL-"
+                               f"first), retrying at later boundaries")
+                        if metrics is not None:
+                            metrics.fault(kind=IO_DEGRADED, epoch=epoch,
+                                          reason="journal append failed",
+                                          component="journal")
+                            metrics.journal(
+                                op="degraded", seq=int(db.seq),
+                                topo_generation=self.topo_generation,
+                                n_records=journal.pending_count)
+                    return False
+
+                if journal is not None and journal.pending_count:
+                    # the disk may have recovered: retry queued appends
+                    # in order; whatever becomes durable applies now
+                    drained = journal.drain_pending()
+                    for db, _g in drained:
+                        rep = self.apply_graph_deltas(db)
+                        stream_reports.append(rep)
+                        if rep.repadded:
+                            seen_chunks.clear()
+                    if drained and not journal.pending_count:
+                        log_fn(f"journal recovered at epoch {epoch}: "
+                               f"{len(drained)} queued delta(s) made "
+                               f"durable and applied")
+                        if metrics is not None:
+                            metrics.recovery(
+                                kind=IO_DEGRADED, epoch=epoch,
+                                pending_since=journal_pending_since
+                                if journal_pending_since is not None
+                                else epoch,
+                                component="journal")
+                            metrics.journal(
+                                op="recovered", seq=journal.last_seq(),
+                                topo_generation=self.topo_generation,
+                                n_records=len(drained))
+                        journal_pending_since = None
                 if stream_plan is not None:
                     for sb in stream_due:
+                        if not _journal_gate(sb):
+                            continue
                         rep = self.apply_graph_deltas(sb)
                         log_fn(
                             f"stream delta seq={rep.seq} at epoch "
@@ -2225,20 +2326,46 @@ class Trainer:
                         from ..graph.synthetic import \
                             synthetic_delta_schedule
 
+                        # seq must clear everything applied AND
+                        # everything journaled-but-queued ahead of it
+                        base = self._stream.last_seq
+                        if journal is not None:
+                            base = max(base, journal.last_seq())
+                            if journal.pending:
+                                base = max(base,
+                                           journal.pending[-1][0].seq)
                         fb = synthetic_delta_schedule(
                             self._stream.g, n_batches=1,
                             edges_per_batch=4, dels_per_batch=2,
                             nodes_per_batch=1, seed=epoch,
-                            start_seq=self._stream.last_seq + 1)[0]
-                        rep = self.apply_graph_deltas(fb)
-                        log_fn(f"fault-injected graph delta at epoch "
-                               f"{epoch} (seq={rep.seq})")
+                            start_seq=base + 1)[0]
                         if metrics is not None:
                             metrics.fault(kind="injected", epoch=epoch,
                                           reason="graph-delta")
-                        stream_reports.append(rep)
-                        if rep.repadded:
-                            seen_chunks.clear()
+                        if _journal_gate(fb):
+                            rep = self.apply_graph_deltas(fb)
+                            log_fn(f"fault-injected graph delta at "
+                                   f"epoch {epoch} (seq={rep.seq})")
+                            stream_reports.append(rep)
+                            if rep.repadded:
+                                seen_chunks.clear()
+                if fault_plan is not None and \
+                        fault_plan.due("journal-torn", epoch):
+                    # chaos lane: the newest journal segment loses its
+                    # tail (interrupted append / disk corruption); the
+                    # next resume must walk back to the surviving
+                    # prefix and re-derive the rest from the plan
+                    if journal is None:
+                        log_fn(f"fault journal-torn at epoch {epoch} "
+                               f"skipped: no delta journal")
+                    else:
+                        lost = journal.tear_newest_segment()
+                        log_fn(f"fault-injected journal tear at epoch "
+                               f"{epoch}: {lost} record(s) lost from "
+                               f"the newest segment")
+                        if metrics is not None:
+                            metrics.fault(kind="injected", epoch=epoch,
+                                          reason="journal-torn")
                 if integ is not None and stream_reports:
                     # the deltas legitimately rebuilt tables and
                     # flushed carry rows: re-baseline, forget the
@@ -2989,7 +3116,8 @@ class Trainer:
                         try:
                             save_checkpoint(checkpoint_dir, host,
                                             epoch + 1,
-                                            keep=checkpoint_keep)
+                                            keep=checkpoint_keep,
+                                            extra=_stream_watermark())
                         except OSError as io_exc:
                             # storage degradation, never an abort: the
                             # previous generation stays the
@@ -3012,7 +3140,8 @@ class Trainer:
                                     save_checkpoint(
                                         checkpoint_fallback_dir, host,
                                         epoch + 1,
-                                        keep=checkpoint_keep)
+                                        keep=checkpoint_keep,
+                                        extra=_stream_watermark())
                                     log_fn(
                                         f"checkpoint epoch {epoch + 1} "
                                         f"saved to fallback dir "
@@ -3032,6 +3161,23 @@ class Trainer:
                                         epoch=epoch + 1,
                                         pending_since=ckpt_pending)
                                 ckpt_pending = None
+                            if journal is not None:
+                                # the new generation covers everything
+                                # applied so far: advance the durable
+                                # watermark and report the replay lag a
+                                # crash right now would incur
+                                last_ckpt_seq = int(
+                                    self._stream.last_seq)
+                                if metrics is not None:
+                                    metrics.journal(
+                                        op="watermark",
+                                        seq=last_ckpt_seq,
+                                        topo_generation=int(
+                                            self.topo_generation),
+                                        n_records=0,
+                                        lag_seqs=max(
+                                            journal.last_seq()
+                                            - last_ckpt_seq, 0))
                             if fault_plan is not None and \
                                     fault_plan.due("corrupt-ckpt",
                                                    epoch + 1):
@@ -3135,17 +3281,22 @@ class Trainer:
                                        start_epoch))
                     save_checkpoint(checkpoint_dir,
                                     jax.device_get(self.state), done,
-                                    keep=checkpoint_keep)
+                                    keep=checkpoint_keep,
+                                    extra=_stream_watermark())
                     log_fn(f"{tag} checkpoint saved to "
                            f"{checkpoint_dir} (epoch {done})")
                 except Exception as save_exc:  # noqa: BLE001
                     if last_good is not None:
                         # poisoned buffers: the host-side snapshot is
-                        # still a valid, older resume point
+                        # still a valid, older resume point. The live
+                        # topology is never rolled back in-process, so
+                        # the CURRENT watermark is the graph these
+                        # params were last training against
                         try:
                             save_checkpoint(checkpoint_dir,
                                             last_good[1], last_good[0],
-                                            keep=checkpoint_keep)
+                                            keep=checkpoint_keep,
+                                            extra=_stream_watermark())
                             log_fn(f"{tag} checkpoint fell back to the "
                                    f"epoch-{last_good[0]} snapshot "
                                    f"({save_exc!r})")

@@ -815,6 +815,19 @@ class ElasticSupervisor:
             self._ledger_pending.append(kw)
         extra = ({"diagnosis": diagnosis["verdict"]}
                  if diagnosis else {})
+        # surface the durable stream watermark with every membership
+        # generation: the checkpoint's (__stream_seq__,
+        # __topo_generation__) pair tells the reader exactly which
+        # topology the relaunched fleet will replay to before training
+        from ..utils.checkpoint import peek_watermark
+
+        try:
+            wm_seq, wm_gen = peek_watermark(self.args.checkpoint_dir)
+        except Exception:  # noqa: BLE001 — observability must not kill
+            wm_seq, wm_gen = -1, 0
+        if wm_seq >= 0 or wm_gen > 0:
+            extra["stream_seq"] = int(wm_seq)
+            extra["topo_generation"] = int(wm_gen)
         self._metrics_logger().membership(
             generation=generation, assignment=assignment.as_json(),
             trigger=trigger, restart_latency_s=latency,

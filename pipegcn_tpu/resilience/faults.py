@@ -53,6 +53,13 @@ e.g. ``--fault-plan nan-loss@5:r1,sigterm@8,corrupt-ckpt@10``. Kinds:
                 training graph at that epoch boundary — edges appear
                 and vanish, a node arrives — exercising the incremental
                 patch, the carry flush, and the forced drift probe
+  journal-torn  ``journal-torn@E``: truncate the delta journal's newest
+                segment to half its bytes at that boundary (an
+                interrupted append / disk corruption); the next resume
+                must tolerate the torn tail, replay the surviving
+                prefix, and re-derive the lost records from the stream
+                plan (stream/journal.py). Skipped when no journal is
+                attached
                 mid-run without a prepared delta file. Requires
                 streaming to be enabled (warn + skip otherwise)
   replica-kill  ``replica-kill@W[:mK]``: SIGKILL serving replica K at
@@ -139,16 +146,17 @@ from .storage import IO_KINDS
 
 KINDS = ("nan-loss", "nan-grad", "sigterm", "crash", "corrupt-ckpt",
          "desync", "hang", "slow-rank", "overflow", "kernel-crash",
-         "kill", "rejoin", "replica-kill", "graph-delta", "net-delay",
-         "net-drop", "net-partition", "bitflip") + IO_KINDS
+         "kill", "rejoin", "replica-kill", "graph-delta",
+         "journal-torn", "net-delay", "net-drop", "net-partition",
+         "bitflip") + IO_KINDS
 # kinds that fire at the start of an epoch boundary: a resume whose
 # start_epoch equals the scheduled epoch has already seen them fire.
 # IO kinds arm at the boundary and disarm by the next checkpoint
 # boundary, so a resume past the arming epoch has outlived them too.
 _BOUNDARY_KINDS = ("sigterm", "crash", "desync", "hang", "slow-rank",
                    "kernel-crash", "kill", "replica-kill",
-                   "graph-delta", "net-delay", "net-drop",
-                   "net-partition", "bitflip") + IO_KINDS
+                   "graph-delta", "journal-torn", "net-delay",
+                   "net-drop", "net-partition", "bitflip") + IO_KINDS
 
 # the optional third group is 'r<N>' (rank), 'm<K>' (member), or a bare
 # number — the per-kind argument (slow-fs / hang: milliseconds). A

@@ -30,6 +30,13 @@ structural invariants over the artifacts left behind:
               decision records, at least one crowd-provoked scale-up,
               and tickets conserved through the scale events
               (serve/autoscale.py, check_autoscale)
+  journal     (invariant #9) the clean resume's post-run rebuild audit
+              — the ``journal`` op="verify" record — shows the
+              trainer's topo_generation at the NOMINAL delta count
+              (every scheduled delta applied exactly once through any
+              composition of WAL replay, plan re-derivation, and live
+              delivery) and the patched device tables digest-matching
+              a from-scratch ShardedGraph.build (stream/journal.py)
   resume      the final clean ``--resume`` exits 0 and reaches
               n_epochs
   diagnosis   the automated postmortem (obs/postmortem.py) over the
@@ -49,9 +56,12 @@ Schedule composition rules (all deterministic per episode seed):
     .skip_before stops them from re-firing forever on resume — every
     terminal fault costs exactly one restart budget unit (plus one
     more when a corrupt-ckpt forces the loader one generation back)
-  * the streaming delta applies AFTER the last terminal epoch: there
-    is no delta replay on resume (stream.StreamPlan.skip_before), so
-    a delta must never precede a restart boundary
+  * the streaming delta epoch is UNCONSTRAINED: the write-ahead delta
+    journal (stream/journal.py) makes deltas durable before they are
+    applied, and every resume path replays seqs at-or-under the
+    checkpoint watermark before training continues — so a delta may
+    land before, between, or after restart boundaries (the PR-14
+    "after the last terminal epoch" rule is retired)
   * hang / desync / replica-kill / rejoin are excluded from the
     default pool — the episodes run one member (streaming is single-
     process), where those kinds either stall on the watchdog horizon
@@ -87,7 +97,7 @@ TERMINAL_KINDS = ("kill", "sigterm", "crash")
 # a pure perturbation — a host-side sleep at one dispatch boundary
 # that the training-span plane must attribute, obs/trainspan.py)
 SOFT_KINDS = ("nan-loss", "kernel-crash", "corrupt-ckpt",
-              "graph-delta", "slow-rank") + IO_KINDS
+              "graph-delta", "slow-rank", "journal-torn") + IO_KINDS
 
 _REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -163,8 +173,10 @@ def compose_schedule(cfg: SoakConfig, episode: int) \
         e = rng.randrange(1, cfg.n_epochs - 1)
         cls = rng.choice(("params", "carry", "tables", "halo"))
         entries.append(f"bitflip@{e}:{cls}")
-    stream_epoch = min((term_epochs[-1] if term_epochs else 0) + 1,
-                       cfg.n_epochs - 1)
+    # delta placement is unconstrained: the WAL journal + watermark
+    # replay make a delta before (or between) restart boundaries
+    # exactly as recoverable as one after them
+    stream_epoch = rng.randrange(1, cfg.n_epochs - 1)
     return entries, stream_epoch
 
 
@@ -277,6 +289,10 @@ _KIND_TO_CLASS: Dict[str, Tuple[str, ...]] = {
     "sigterm": ("preemption", "crash"),
     "crash": ("crash", "preemption"),
     "bitflip": ("sdc",),
+    # a torn journal tail alone is recoverable (replay falls back to
+    # the plan's delta files); if the episode still went red, the
+    # rollback picture is the consistent explanation
+    "journal-torn": ("topo-rollback", "crash"),
 }
 
 
@@ -454,6 +470,53 @@ def check_integrity(metric_files: Sequence[str],
     return _inv(not errors, scheduled=list(scheduled),
                 injected=sorted(set(injected)),
                 detected=sorted(set(detected))[:8],
+                **({"error": "; ".join(errors)} if errors else {}))
+
+
+def check_journal(resume_metrics: str, n_batches: int) -> Dict:
+    """Invariant #9 (journaled streaming): the clean resume's post-run
+    rebuild audit — the ``journal`` op="verify" record in the resume
+    metrics stream — reports the trainer's topo_generation at the
+    NOMINAL delta count (every scheduled delta applied exactly once,
+    whether by WAL replay, plan re-derivation after a torn tail, or
+    live delivery) and ``tables_match`` true: the patched device
+    tables are bitwise-identical to a from-scratch rebuild."""
+    if not os.path.exists(resume_metrics):
+        return _inv(False, error="no resume metrics stream")
+    verify = None
+    replayed = truncated = 0
+    with open(resume_metrics, encoding="utf-8") as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("event") != "journal":
+                continue
+            op = rec.get("op")
+            if op == "verify":
+                verify = rec
+            elif op == "replay":
+                replayed += (int(rec.get("n_records", 0))
+                             + int(rec.get("rederived", 0)))
+            elif op == "truncate":
+                truncated += int(rec.get("n_records", 0))
+    if verify is None:
+        return _inv(False,
+                    error="no journal verify record in the resume "
+                          "stream (journaled resume did not run)")
+    errors = []
+    if verify.get("tables_match") is not True:
+        errors.append(f"device tables diverge from rebuild: "
+                      f"{verify.get('mismatch')}")
+    if int(verify.get("topo_generation", -1)) != n_batches:
+        errors.append(f"topo_generation "
+                      f"{verify.get('topo_generation')} != nominal "
+                      f"{n_batches}")
+    return _inv(not errors,
+                topo_generation=verify.get("topo_generation"),
+                tables_match=verify.get("tables_match"),
+                replayed=replayed, truncated=truncated,
                 **({"error": "; ".join(errors)} if errors else {}))
 
 
@@ -676,6 +739,10 @@ def run_episode(cfg: SoakConfig, episode: int,
         "integrity": (check_integrity(metric_files, schedule,
                                       cfg.integrity_every)
                       if cfg.integrity else _inv(True, skipped=True)),
+        # invariant #9: post-resume topo_generation at nominal, device
+        # tables digest-match a from-scratch rebuild (one delta batch
+        # per episode, see _write_delta_file)
+        "journal": check_journal(resume_metrics, n_batches=1),
         "resume": _inv(res_rc == 0,
                        rc=res_rc,
                        **({} if res_rc == 0

@@ -129,11 +129,14 @@ def _ready_path(fleet_dir: str, replica: int) -> str:
 
 
 def _write_ready(fleet_dir: str, replica: int, incarnation: int,
-                 port: int) -> None:
+                 port: int, topo_generation: int = 0) -> None:
     """Atomic publish: the manager must never read a torn port. Routed
     through the storage-fault seams (resilience/storage.py); a failed
     publish propagates and the replica dies unready — the manager's
-    ready-timeout + relaunch policy IS the degradation path here."""
+    ready-timeout + relaunch policy IS the degradation path here.
+    `topo_generation` is the graph-topology watermark the replica
+    serves (stream/journal.py replay runs BEFORE this publish, so
+    readiness implies caught-up)."""
     from ..resilience.storage import write_text_atomic
 
     write_text_atomic(
@@ -141,6 +144,7 @@ def _write_ready(fleet_dir: str, replica: int, incarnation: int,
         json.dumps({"replica": int(replica),
                     "incarnation": int(incarnation),
                     "port": int(port), "pid": os.getpid(),
+                    "topo_generation": int(topo_generation),
                     "t_ready": time.time()}),
         fsync=False)
 
@@ -179,6 +183,7 @@ class ReplicaServer:
                  swap_poll_s: float = 0.5,
                  heartbeat_interval_s: float = 0.2,
                  report_every_s: float = 2.0,
+                 replay: Optional[Callable[[], int]] = None,
                  log: Callable[[str], None] = print):
         from ..resilience.coord import HeartbeatWatchdog
 
@@ -188,6 +193,11 @@ class ReplicaServer:
         self.incarnation = int(incarnation)
         self.ml = ml
         self.checkpoint_dir = checkpoint_dir
+        # crash-consistent streaming: a restart/spawn must replay the
+        # durable delta journal BEFORE declaring readiness, so the
+        # fleet never routes to a replica serving a stale topology.
+        # `replay()` returns the number of journal records applied.
+        self._replay = replay
         self.swap_poll_s = float(swap_poll_s)
         self.report_every_s = float(report_every_s)
         self.log = log
@@ -222,6 +232,8 @@ class ReplicaServer:
                     "staleness_age": int(self.engine.staleness_age),
                     "param_generation": int(self.engine.param_generation),
                     "param_staleness": int(self.engine.param_staleness),
+                    "topo_generation": int(getattr(
+                        self.engine, "topo_generation", 0)),
                     "incarnation": self.incarnation,
                 }
             self.n_queries += int(ids.size)
@@ -248,6 +260,9 @@ class ReplicaServer:
                             int(self.engine.param_generation),
                         "param_staleness":
                             int(self.engine.param_staleness),
+                        "topo_generation":
+                            int(getattr(self.engine,
+                                        "topo_generation", 0)),
                         "n_feat_raw": int(getattr(self.engine,
                                                   "n_feat_raw", 0)),
                         "n_queries": int(self.n_queries)}
@@ -377,8 +392,24 @@ class ReplicaServer:
                                daemon=True,
                                name=f"replica-{self.replica_id}-srv")
         srv.start()
+        # journal replay BEFORE readiness: a restarted replica catches
+        # up to the fleet's topo_generation before any batch can route
+        # here. The port is already bound (so the manager's connect
+        # won't race), but the ready file is not yet published.
+        if self._replay is not None:
+            n = int(self._replay())
+            gen = int(getattr(self.engine, "topo_generation", 0))
+            self.log(f"replica {self.replica_id}: replayed {n} journal "
+                     f"record(s); topo_generation={gen}")
+            if self.ml is not None:
+                self.ml.journal(
+                    op="replay", seq=-1, topo_generation=gen,
+                    n_records=n,
+                    source=f"replica-m{self.replica_id}")
         _write_ready(self.fleet_dir, self.replica_id, self.incarnation,
-                     port)
+                     port,
+                     topo_generation=int(getattr(
+                         self.engine, "topo_generation", 0)))
         self.log(f"replica {self.replica_id} (incarnation "
                  f"{self.incarnation}) serving on port {port}")
         try:
@@ -807,6 +838,39 @@ class FleetManager:
                  f"as incarnation {rep.incarnation} in "
                  f"{dec.delay_s:.1f}s")
 
+    def note_topo(self, rid: int, gen,
+                  router: Optional[Router]) -> Optional[bool]:
+        """Fold a replica's reported topo_generation (query meta,
+        health response, or readiness file) into the router's skew
+        detector, emitting the fleet record on each edge: `topo-skew`
+        when the replica falls behind the fleet maximum (it is routed
+        around), `topo-caught-up` when journal replay brings it back.
+        Returns the router edge (True down / False up / None)."""
+        if router is None or gen is None:
+            return None
+        edge = router.note_topo_generation(rid, gen)
+        if edge is None:
+            return None
+        if self.ml is not None:
+            gens = router.topo_generations()
+            fleet_gen = max(gens.values()) if gens else int(gen)
+            if edge:
+                self.ml.fleet("topo-skew", rid, window=self.window,
+                              topo_generation=int(gen),
+                              fleet_generation=int(fleet_gen))
+            else:
+                self.ml.fleet("topo-caught-up", rid,
+                              window=self.window,
+                              topo_generation=int(gen))
+        if edge:
+            self.log(f"fleet: replica {rid} topology STALE "
+                     f"(generation {int(gen)}); routed around until "
+                     f"journal replay catches it up")
+        else:
+            self.log(f"fleet: replica {rid} topology caught up "
+                     f"(generation {int(gen)}); routed back in")
+        return edge
+
     def poll(self, router: Optional[Router] = None) -> None:
         """One supervision step: detect deaths, run due relaunches,
         fold ready rejoins back into the router."""
@@ -877,6 +941,11 @@ class FleetManager:
                     self.log(f"fleet: replica {rep.rid} rejoined as "
                              f"incarnation {rep.incarnation} after "
                              f"{latency:.1f}s")
+                    # the ready file carries the replica's post-replay
+                    # topo_generation: a rejoin that somehow skipped
+                    # replay is caught here and held out of routing
+                    self.note_topo(rep.rid,
+                                   info.get("topo_generation"), router)
                 elif rep.proc.poll() is not None:
                     # relaunch died before readiness: another strike
                     self._on_death(
@@ -1087,6 +1156,16 @@ def run_fleet_loop(manager: FleetManager, router: Router, *,
                     stats.note_params(
                         int(meta.get("param_generation", -1)),
                         int(meta.get("param_staleness", 0)))
+                # live cross-replica skew detection: every answer
+                # carries the replica's topo_generation; one falling
+                # behind the fleet maximum is routed around. The batch
+                # is already completed — a bookkeeping failure here
+                # must never re-shed it.
+                try:
+                    manager.note_topo(
+                        rid, meta.get("topo_generation"), router)
+                except AttributeError:
+                    pass  # manager without skew tracking
             except FleetUnavailable:
                 # the whole fleet is down / timed out: the batch is
                 # answered 'shed', never silently lost (the shed count

@@ -104,6 +104,10 @@ class Router:
         # autoscale retire) remaps only that replica's arcs
         self._ring_points = int(ring_points)
         self._ring: List[Tuple[int, int]] = []
+        # per-replica topology generation (note_topo_generation): a
+        # replica behind the fleet max is stale and routed around
+        self._topo_gens: Dict[int, int] = {}
+        self._topo_stale: set = set()
         self._rebuild_ring()
 
     def _rebuild_ring(self) -> None:
@@ -133,13 +137,55 @@ class Router:
 
     def mark_up(self, rid: int) -> bool:
         """Put a replica back into rotation (rejoin); returns True on
-        the UP edge."""
+        the UP edge. A replica held out for topology skew stays routed
+        out — only `note_topo_generation` reporting the fleet
+        generation clears that hold (else the manager's health-probe
+        heal path would route a stale graph back in)."""
         with self._lock:
             if rid not in self._clients:  # already retired
+                return False
+            if rid in self._topo_stale:
                 return False
             was_down = not self._up.get(rid, False)
             self._up[rid] = True
         return was_down
+
+    def note_topo_generation(self, rid: int, gen: int) -> Optional[bool]:
+        """Cross-replica topology-skew detection (stream/journal.py):
+        record the ``topo_generation`` a replica last reported (health
+        response / query meta / readiness file). A replica BEHIND the
+        fleet's maximum is serving a stale graph — it is routed around
+        (mark_down, firing `on_fault` with a ``topo-skew:`` reason) and
+        rejoins automatically once it reports the fleet generation
+        again (journal replay on its restart path). Returns True on the
+        skew DOWN edge, False on the catch-up UP edge, None when
+        nothing changed."""
+        rid, gen = int(rid), int(gen)
+        with self._lock:
+            if rid not in self._clients:
+                return None
+            self._topo_gens[rid] = gen
+            fleet_gen = max(self._topo_gens.values())
+            stale = gen < fleet_gen
+            was_stale = rid in self._topo_stale
+            if stale:
+                self._topo_stale.add(rid)
+            else:
+                self._topo_stale.discard(rid)
+        if stale and not was_stale:
+            self.mark_down(
+                rid, f"topo-skew:replica at generation {gen}, fleet "
+                     f"at {fleet_gen}")
+            return True
+        if was_stale and not stale:
+            self.mark_up(rid)
+            return False
+        return None
+
+    def topo_generations(self) -> Dict[int, int]:
+        """Last reported topo_generation per replica (skew surface)."""
+        with self._lock:
+            return dict(self._topo_gens)
 
     def has_replica(self, rid: int) -> bool:
         with self._lock:
@@ -167,6 +213,8 @@ class Router:
             self._clients.pop(rid, None)
             self._up.pop(rid, None)
             self._inflight.pop(rid, None)
+            self._topo_gens.pop(rid, None)
+            self._topo_stale.discard(rid)
             self._rebuild_ring()
 
     def is_up(self, rid: int) -> bool:

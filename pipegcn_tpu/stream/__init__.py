@@ -14,6 +14,12 @@ second half) without ever re-running METIS:
               shapes are STATIC across deltas. Bit-identity of the
               patched ShardedGraph vs a from-scratch build of the same
               final edge list is the correctness oracle.
+  journal.py  write-ahead delta journal: every applied batch is made
+              durable BEFORE it mutates the topology, checkpoints
+              stamp a seq/topo_generation watermark, and every resume
+              path (trainer --resume, elastic replan, serving replica
+              restart) replays the journal so a kill between apply and
+              checkpoint can never silently revert the graph.
 
 See docs/STREAMING.md for the delta format, the slack model, and the
 drift-measurement methodology.
@@ -21,6 +27,9 @@ drift-measurement methodology.
 
 from .deltas import (DELTA_FORMAT_VERSION, DeltaBatch, StreamPlan,
                      load_deltas, save_deltas)
+from .journal import (JOURNAL_FORMAT_VERSION, DeltaJournal,
+                      JournalCorrupt, replay_for_resume,
+                      verify_against_rebuild)
 from .patch import GraphPatcher, PatchReport, SlackExhausted
 
 __all__ = [
@@ -29,6 +38,11 @@ __all__ = [
     "StreamPlan",
     "load_deltas",
     "save_deltas",
+    "JOURNAL_FORMAT_VERSION",
+    "DeltaJournal",
+    "JournalCorrupt",
+    "replay_for_resume",
+    "verify_against_rebuild",
     "GraphPatcher",
     "PatchReport",
     "SlackExhausted",

@@ -352,12 +352,35 @@ class StreamPlan:
         return sum(1 for d in self._done if not d)
 
     def skip_before(self, start_epoch: int) -> None:
-        """Retire batches scheduled strictly before `start_epoch` — a
-        resume's checkpointed graph already contains them (deltas are
-        applied at the START of their epoch, like boundary faults)."""
+        """Retire batches scheduled strictly before `start_epoch`.
+
+        LEGACY resume semantics, only correct when no delta journal is
+        in play: it assumes the resumed graph already contains the
+        pre-resume deltas, which is false (resume rebuilds the nominal
+        graph) — journaled runs use :meth:`skip_journaled` after WAL
+        replay instead (stream/journal.py)."""
         for i, (e, _) in enumerate(self._entries):
             if e < start_epoch:
                 self._done[i] = True
+
+    def skip_journaled(self, last_seq: int) -> int:
+        """Journal-aware resume: retire exactly the batches with
+        seq <= `last_seq` (the checkpoint watermark — WAL replay just
+        re-applied them). Later-scheduled batches stay live even when
+        their epoch predates the resume point, so nothing is dropped on
+        the floor. Returns the number retired."""
+        n = 0
+        for i, (_, b) in enumerate(self._entries):
+            if not self._done[i] and b.seq <= last_seq:
+                self._done[i] = True
+                n += 1
+        return n
+
+    def batches_upto(self, last_seq: int) -> List[DeltaBatch]:
+        """All scheduled batches with seq <= `last_seq`, regardless of
+        done state — the re-derivation source when the journal lost its
+        tail (stream/journal.py replay_for_resume)."""
+        return [b for (_, b) in self._entries if b.seq <= last_seq]
 
     def due(self, epoch: int) -> List[DeltaBatch]:
         """Consume and return every batch scheduled at-or-before

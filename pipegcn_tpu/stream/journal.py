@@ -1,0 +1,446 @@
+"""Write-ahead delta journal: crash-consistent streaming topology.
+
+PR 13 made the graph live (stream/deltas.py + stream/patch.py) but left
+a durability hole: a kill between a delta apply and the next checkpoint
+silently reverts topology on resume — the checkpoint holds params that
+trained AGAINST the post-delta graph while the resumed process rebuilds
+the nominal one. This module closes the hole with a WAL:
+
+  * every applied ``DeltaBatch`` is journaled BEFORE it is applied
+    (WAL-first), as one CRC-guarded JSONL record carrying the batch
+    payload plus the ``topo_generation`` the apply produced;
+  * records accumulate in segment files ``journal-<firstseq>.jsonl``
+    (header line pins format + version); segments rotate at
+    ``segment_max_records`` and new segments are born atomically
+    (header written via ``write_text_atomic``) so a torn rotation never
+    leaves a headerless file;
+  * checkpoints stamp a watermark (``__stream_seq__`` = last applied
+    seq, ``__topo_generation__``) — resume rebuilds the nominal graph,
+    replays every journaled seq <= watermark through the patcher, then
+    truncates the journal after the watermark (classic WAL rollback of
+    uncommitted entries: the StreamPlan re-delivers them at their
+    scheduled epochs, reproducing the uninterrupted trajectory
+    bitwise);
+  * the newest segment's tail is torn-tolerant: a half-written last
+    line (crash mid-append, or the ``journal-torn`` fault drill) is
+    dropped at scan time and the lost suffix re-derived from the plan;
+    a bad record anywhere ELSE is real corruption and raises
+    :class:`JournalCorrupt` loudly;
+  * degrade-not-lose: an append that hits the armed ``FaultyIO`` seams
+    (ENOSPC / ro-dir / torn-write) queues the batch in an in-memory
+    pending list INSTEAD of applying it — order is preserved, nothing
+    is applied that is not durable, and the trainer drains the queue at
+    later epoch boundaries once the disk recovers (same policy family
+    as the membership ledger and the metrics sink).
+
+The bit-identity oracle from tests/test_stream.py is packaged here as
+:func:`verify_against_rebuild` so every resume path (trainer CLI, soak
+invariant #9, serving replicas) can prove "replayed tables == a
+from-scratch build of the post-delta graph" with one call.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..resilience.storage import FAULTY_IO, FaultyIO, write_text_atomic
+from .deltas import DeltaBatch, StreamPlan, _canon_payload, _json_crc
+
+JOURNAL_FORMAT_VERSION = 1
+_FORMAT_NAME = "pipegcn-journal"
+_SEG_RE = re.compile(r"^journal-(\d{8})\.jsonl$")
+
+# journal record "op" vocabulary for obs/schema.py `journal` records
+# (emitted by the trainer / CLI, not by this module — listed here so
+# the writer and the schema agree on one source of truth)
+JOURNAL_OPS = ("append", "replay", "rotate", "truncate", "degraded",
+               "recovered", "skew", "watermark", "verify")
+
+
+class JournalCorrupt(RuntimeError):
+    """A journal segment failed validation beyond the tolerated torn
+    tail (bad header, CRC mismatch in a sealed segment, seq regression
+    across records)."""
+
+
+# ---------------------------------------------------------------------
+# record (de)serialization
+# ---------------------------------------------------------------------
+
+def _record_line(batch: DeltaBatch, topo_generation: int) -> str:
+    payload = _canon_payload(batch)
+    payload["topo_generation"] = int(topo_generation)
+    payload["crc"] = _json_crc(payload)
+    return json.dumps(payload, sort_keys=True)
+
+
+def _parse_record(rec: dict) -> Tuple[int, DeltaBatch]:
+    gen = int(rec.pop("topo_generation", 0))
+    multilabel = bool(rec.pop("node_label_multilabel", False))
+    feat = rec["node_feat"]
+    nf = (np.asarray(feat, np.float32).reshape(len(feat), -1)
+          if feat else None)
+    nl = rec["node_label"]
+    label = (np.asarray(nl, np.float32 if multilabel else np.int64)
+             if nl else None)
+    b = DeltaBatch.make(rec["seq"], rec["add_edges"], rec["del_edges"],
+                        nf, label, tuple(rec["node_nbrs"]))
+    return gen, b
+
+
+def _segment_name(first_seq: int) -> str:
+    return f"journal-{first_seq:08d}.jsonl"
+
+
+def _header_line(first_seq: int) -> str:
+    hdr = {"format": _FORMAT_NAME, "version": JOURNAL_FORMAT_VERSION,
+           "first_seq": int(first_seq)}
+    hdr["crc"] = _json_crc(hdr)
+    return json.dumps(hdr, sort_keys=True)
+
+
+# ---------------------------------------------------------------------
+# the journal
+# ---------------------------------------------------------------------
+
+class DeltaJournal:
+    """Append-only, CRC-chunked, segment-rotated WAL of applied
+    ``DeltaBatch``es.
+
+    Thread-unsafe by design (the trainer touches it from the epoch loop
+    only; serving replicas replay before their serve threads start).
+    """
+
+    def __init__(self, directory: str, *, segment_max_records: int = 256,
+                 fsync: bool = False, io: Optional[FaultyIO] = None):
+        self.directory = directory
+        self.segment_max_records = int(segment_max_records)
+        self.fsync = bool(fsync)
+        self._io = io if io is not None else FAULTY_IO
+        # (batch, topo_generation) appends that could not be made
+        # durable, in arrival order — degrade-not-lose
+        self.pending: List[Tuple[DeltaBatch, int]] = []
+        os.makedirs(directory, exist_ok=True)
+        self._seg_path: Optional[str] = None   # newest segment
+        self._seg_records = 0                  # good records in it
+        self._last_seq = -1
+        self._last_gen = 0
+        self._rescan()
+
+    # -- scanning ------------------------------------------------------
+
+    def _segments(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = _SEG_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        out.sort()
+        return out
+
+    def _scan_segment(self, path: str, *, newest: bool
+                      ) -> List[Tuple[int, DeltaBatch]]:
+        """Parse one segment. In the NEWEST segment a trailing bad /
+        partial line is a torn tail: tolerated, good prefix kept. In a
+        sealed segment any bad line is corruption."""
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+        lines = raw.split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()
+        if not lines:
+            raise JournalCorrupt(f"{path}: empty segment (no header)")
+        try:
+            hdr = json.loads(lines[0])
+            crc = hdr.pop("crc")
+            ok = (_json_crc(hdr) == crc
+                  and hdr.get("format") == _FORMAT_NAME
+                  and hdr.get("version") == JOURNAL_FORMAT_VERSION)
+        except (ValueError, KeyError, TypeError):
+            ok = False
+        if not ok:
+            raise JournalCorrupt(
+                f"{path}: bad or version-skewed header — refusing to "
+                f"replay through it")
+        entries: List[Tuple[int, DeltaBatch]] = []
+        for i, line in enumerate(lines[1:], start=2):
+            try:
+                rec = json.loads(line)
+                crc = rec.pop("crc")
+                if _json_crc(rec) != crc:
+                    raise ValueError("crc mismatch")
+                gen, b = _parse_record(rec)
+            except (ValueError, KeyError, TypeError, IndexError) as exc:
+                if newest and i == len(lines):
+                    break  # torn tail: drop the partial record
+                raise JournalCorrupt(
+                    f"{path}:{i}: corrupt journal record ({exc}) in a "
+                    f"sealed position — not a torn tail") from exc
+            entries.append((gen, b))
+        return entries
+
+    def _rescan(self) -> None:
+        segs = self._segments()
+        self._seg_path = segs[-1][1] if segs else None
+        self._seg_records = 0
+        self._last_seq = -1
+        self._last_gen = 0
+        for _, path in segs:
+            newest = path == self._seg_path
+            entries = self._scan_segment(path, newest=newest)
+            if newest:
+                self._seg_records = len(entries)
+                self._heal_torn_tail()
+            for gen, b in entries:
+                if b.seq <= self._last_seq:
+                    raise JournalCorrupt(
+                        f"{path}: seq {b.seq} after {self._last_seq} — "
+                        f"journal is not monotonic")
+                self._last_seq, self._last_gen = b.seq, gen
+
+    def _heal_torn_tail(self) -> None:
+        """A crash mid-append leaves the newest segment ending in a
+        partial line with no terminator; a later append would weld its
+        record onto that garbage, silently losing a durable-looking
+        write. Rewrite the segment down to its good prefix (header +
+        ``_seg_records`` good lines) before anyone appends."""
+        path = self._seg_path
+        if path is None or not os.path.exists(path):
+            return
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+        lines = raw.split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()
+        good = "\n".join(lines[:1 + self._seg_records]) + "\n"
+        if good != raw:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(good)
+
+    # -- reading -------------------------------------------------------
+
+    def entries(self) -> List[Tuple[int, DeltaBatch]]:
+        """All good (topo_generation, batch) records in seq order,
+        torn-tail tolerant."""
+        out: List[Tuple[int, DeltaBatch]] = []
+        segs = self._segments()
+        for _, path in segs:
+            out.extend(self._scan_segment(
+                path, newest=(path == segs[-1][1])))
+        return out
+
+    def replay(self, up_to_seq: Optional[int] = None
+               ) -> List[Tuple[int, DeltaBatch]]:
+        """Entries with seq <= up_to_seq (all when None)."""
+        es = self.entries()
+        if up_to_seq is None:
+            return es
+        return [(g, b) for g, b in es if b.seq <= up_to_seq]
+
+    def last_seq(self) -> int:
+        return self._last_seq
+
+    def last_generation(self) -> int:
+        return self._last_gen
+
+    @property
+    def pending_count(self) -> int:
+        return len(self.pending)
+
+    # -- writing -------------------------------------------------------
+
+    def _append_durable(self, batch: DeltaBatch,
+                        topo_generation: int) -> None:
+        """Raises OSError on any seam failure; on success the record is
+        on disk (fsync'd when configured)."""
+        rotate = (self._seg_path is None
+                  or self._seg_records >= self.segment_max_records)
+        if rotate:
+            path = os.path.join(self.directory,
+                                _segment_name(max(batch.seq, 0)))
+            # atomic birth: the header lands via temp+rename, so a torn
+            # rotation leaves no headerless segment behind
+            write_text_atomic(path, _header_line(batch.seq) + "\n",
+                              fsync=self.fsync, io=self._io)
+            self._seg_path, self._seg_records = path, 0
+        path = self._seg_path
+        self._io.gate(path, "open")
+        with open(path, "a", encoding="utf-8") as f:
+            self._io.gate(path, "write")
+            f.write(_record_line(batch, topo_generation) + "\n")
+            f.flush()
+            if self.fsync:
+                self._io.gate(path, "fsync")
+                os.fsync(f.fileno())
+        self._seg_records += 1
+        self._last_seq = int(batch.seq)
+        self._last_gen = int(topo_generation)
+
+    def append(self, batch: DeltaBatch, topo_generation: int) -> bool:
+        """Journal one batch. True = durable now; False = the disk is
+        degraded and the batch joined the pending queue (caller must
+        NOT apply it yet — WAL-first means un-journaled changes never
+        reach the topology)."""
+        if self.pending:
+            # order preservation: nothing overtakes a queued batch
+            self.pending.append((batch, int(topo_generation)))
+            return False
+        try:
+            self._append_durable(batch, topo_generation)
+            return True
+        except OSError:
+            self.pending.append((batch, int(topo_generation)))
+            return False
+
+    def drain_pending(self) -> List[Tuple[DeltaBatch, int]]:
+        """Retry queued appends in order; returns the batches that just
+        became durable (the caller applies them now). Stops at the
+        first append that still fails."""
+        drained: List[Tuple[DeltaBatch, int]] = []
+        while self.pending:
+            batch, gen = self.pending[0]
+            try:
+                self._append_durable(batch, gen)
+            except OSError:
+                break
+            self.pending.pop(0)
+            drained.append((batch, gen))
+        return drained
+
+    # -- rollback / fault hooks ---------------------------------------
+
+    def truncate_after(self, seq: int) -> int:
+        """WAL rollback: drop every record with seq > `seq` (entries
+        past the checkpoint watermark are uncommitted — the StreamPlan
+        re-delivers them at their scheduled epochs). Segments are
+        rewritten atomically. Returns the number of records dropped."""
+        keep: List[Tuple[int, DeltaBatch]] = []
+        dropped = 0
+        segs = self._segments()
+        for _, path in segs:
+            for gen, b in self._scan_segment(
+                    path, newest=(path == segs[-1][1])):
+                if b.seq <= seq:
+                    keep.append((gen, b))
+                else:
+                    dropped += 1
+        if dropped == 0:
+            return 0
+        for _, path in segs:
+            os.remove(path)
+        self._seg_path = None
+        self._seg_records = 0
+        self._last_seq = -1
+        self._last_gen = 0
+        for i in range(0, len(keep), self.segment_max_records):
+            chunk = keep[i:i + self.segment_max_records]
+            lines = [_header_line(chunk[0][1].seq)]
+            lines += [_record_line(b, g) for g, b in chunk]
+            path = os.path.join(self.directory,
+                                _segment_name(chunk[0][1].seq))
+            write_text_atomic(path, "\n".join(lines) + "\n",
+                              fsync=self.fsync, io=self._io)
+            self._seg_path, self._seg_records = path, len(chunk)
+        if keep:
+            self._last_seq = int(keep[-1][1].seq)
+            self._last_gen = int(keep[-1][0])
+        return dropped
+
+    def tear_newest_segment(self) -> int:
+        """Fault-drill hook (``journal-torn@E``): truncate the newest
+        segment file to half its bytes, exactly like an interrupted
+        append. Returns the number of records lost (recovery walks back
+        to the surviving prefix and re-derives the rest from the
+        plan)."""
+        if self._seg_path is None or not os.path.exists(self._seg_path):
+            return 0
+        before = len(self._scan_segment(self._seg_path, newest=True))
+        size = os.path.getsize(self._seg_path)
+        with open(self._seg_path, "r+b") as f:
+            f.truncate(size // 2)
+        try:
+            after = len(self._scan_segment(self._seg_path, newest=True))
+        except JournalCorrupt:
+            # header itself torn: the segment is gone entirely
+            os.remove(self._seg_path)
+            after = 0
+        self._rescan()
+        return before - after
+
+
+# ---------------------------------------------------------------------
+# replay + verification helpers (shared by trainer CLI, soak, serving)
+# ---------------------------------------------------------------------
+
+def replay_for_resume(journal: DeltaJournal, watermark_seq: int,
+                      apply_fn: Callable[[DeltaBatch], object], *,
+                      plan: Optional[StreamPlan] = None,
+                      ) -> Dict[str, int]:
+    """Bring a freshly-rebuilt NOMINAL graph to the state the
+    checkpointed params trained against: apply every seq <=
+    `watermark_seq`, preferring the journal's copy and falling back to
+    the plan's delta files for seqs the journal lost (torn tail /
+    ``journal-torn`` drill). Then roll the journal back past the
+    watermark (uncommitted entries — the plan re-delivers them live).
+
+    Returns ``{"replayed", "rederived", "truncated", "skipped",
+    "topo_generation"}``.
+    """
+    journaled = {b.seq: (g, b) for g, b in journal.replay(watermark_seq)}
+    planned: Dict[int, DeltaBatch] = {}
+    if plan is not None:
+        planned = {b.seq: b for b in plan.batches_upto(watermark_seq)}
+    seqs = sorted(set(journaled) | set(planned))
+    replayed = rederived = skipped = 0
+    gen = 0
+    for s in seqs:
+        if s in journaled:
+            g, b = journaled[s]
+            apply_fn(b)
+            replayed += 1
+            gen = g
+        elif s in planned:
+            apply_fn(planned[s])
+            rederived += 1
+            gen += 1
+        else:  # pragma: no cover — unreachable (s from the union)
+            skipped += 1
+    truncated = journal.truncate_after(watermark_seq)
+    return {"replayed": replayed, "rederived": rederived,
+            "truncated": truncated, "skipped": skipped,
+            "topo_generation": gen}
+
+
+_VERIFY_ARRAYS = ("inner_count", "train_count", "edge_count",
+                  "send_counts", "edge_src", "edge_dst", "send_idx",
+                  "send_mask", "feat", "label", "train_mask",
+                  "val_mask", "test_mask", "in_deg", "global_nid")
+
+
+def verify_against_rebuild(patcher) -> Dict[str, object]:
+    """The bit-identity oracle as a callable check: rebuild the sharded
+    tables from scratch out of the patcher's CURRENT graph + partition
+    map at the same padded dims, and compare every device table
+    bitwise. Returns ``{"tables_match": bool, "mismatch": [names]}``.
+    """
+    from ..partition.halo import ShardedGraph
+
+    sg = patcher.sg
+    sg2 = ShardedGraph.build(patcher.g, patcher.parts,
+                             n_parts=sg.num_parts,
+                             min_n_max=sg.n_max, min_b_max=sg.b_max,
+                             min_e_max=sg.e_max)
+    mismatch = []
+    for name in _VERIFY_ARRAYS:
+        a = np.asarray(getattr(sg, name))
+        b = np.asarray(getattr(sg2, name))
+        if a.shape != b.shape or a.dtype != b.dtype \
+                or not np.array_equal(a, b):
+            mismatch.append(name)
+    return {"tables_match": not mismatch, "mismatch": mismatch}

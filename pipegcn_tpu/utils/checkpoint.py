@@ -293,7 +293,8 @@ def disk_preflight(directory: str, state: Any,
 
 
 def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
-                    keep: int = 3) -> str:
+                    keep: int = 3,
+                    extra: Optional[Dict[str, Any]] = None) -> str:
     """Save full training state for resume; returns the generation path.
 
     The epoch rides INSIDE the npz (one atomic os.replace), so a crash
@@ -304,7 +305,12 @@ def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
     (keep <= 0 keeps everything; the legacy state.npz is never
     pruned — it may be the only pre-rotation fallback). When the disk
     preflight says space is tight the save is still attempted but the
-    prune is skipped for this save."""
+    prune is skipped for this save.
+
+    `extra` rides alongside ``__epoch__`` inside the same npz (same
+    atomicity guarantee) — the streaming path stamps its journal
+    watermark (``__stream_seq__``, ``__topo_generation__``) here so a
+    state can never be paired with the wrong topology position."""
     os.makedirs(directory, exist_ok=True)
     _sweep_stale_tmps(directory)
     headroom = disk_preflight(directory, state)
@@ -315,8 +321,11 @@ def save_checkpoint(directory: str, state: Dict[str, Any], epoch: int,
             f"generation; attempting the save anyway but KEEPING all "
             f"older generations (rotation-deletion skipped)")
     path = os.path.join(directory, _gen_name(epoch))
-    save_pytree(path, state,
-                extra={"__epoch__": np.asarray(epoch, np.int64)})
+    extras = {"__epoch__": np.asarray(epoch, np.int64)}
+    if extra:
+        for k, v in extra.items():
+            extras[k] = np.asarray(v)
+    save_pytree(path, state, extra=extras)
     io = _io()
     lp = os.path.join(directory, _LATEST)
     io.gate(lp, "open")
@@ -391,8 +400,12 @@ def _epoch_of(path: str, directory: str) -> int:
     return _legacy_epoch(directory)
 
 
-def load_checkpoint(directory: str, template: Dict[str, Any]):
-    """Returns (state, next_epoch) restored from save_checkpoint.
+def load_checkpoint(directory: str, template: Dict[str, Any],
+                    with_extras: bool = False):
+    """Returns (state, next_epoch) restored from save_checkpoint —
+    or (state, next_epoch, extras) when `with_extras` is True (the
+    extras dict carries whatever rode along via ``save_checkpoint``'s
+    `extra=`, e.g. the streaming watermark).
 
     Tries the ``latest`` generation first and falls back through older
     generations (warning on each corrupt one) — a torn or bit-rotted
@@ -412,6 +425,8 @@ def load_checkpoint(directory: str, template: Dict[str, Any]):
                 warnings.warn(
                     f"restored previous good checkpoint generation "
                     f"{os.path.basename(path)} (epoch {epoch})")
+            if with_extras:
+                return state, epoch, extras
             return state, epoch
         except CheckpointCorrupt as exc:
             last_exc = exc
@@ -421,6 +436,25 @@ def load_checkpoint(directory: str, template: Dict[str, Any]):
     raise CheckpointCorrupt(
         f"every checkpoint generation in {directory} failed "
         f"verification; last error: {last_exc}")
+
+
+def peek_watermark(directory: str) -> Tuple[int, int]:
+    """Streaming watermark (last applied delta seq, topo_generation) of
+    the newest loadable generation, reading only the two scalars (npz
+    members load lazily — this never touches the state arrays).
+    Returns (-1, 0) — the nominal graph — when there is no checkpoint
+    or it predates the journal."""
+    for path in _candidates(directory):
+        try:
+            with np.load(path) as data:
+                seq = (int(data["__stream_seq__"])
+                       if "__stream_seq__" in data.files else -1)
+                gen = (int(data["__topo_generation__"])
+                       if "__topo_generation__" in data.files else 0)
+                return seq, gen
+        except _READ_ERRORS:
+            continue  # load_checkpoint will fall back the same way
+    return -1, 0
 
 
 def checkpoint_exists(directory: str) -> bool:

@@ -150,6 +150,25 @@ timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m trainspan \
     -p no:cacheprovider "$@"
 
+# Journal lane (docs/STREAMING.md "Durability & replay"): the
+# crash-consistent streaming plane — WAL segment rotation/reopen
+# round-trip, sealed-segment CRC tamper loudness, torn-tail heal,
+# ENOSPC degrade-not-lose pending queue, the kill-mid-stream and
+# journal-torn bitwise resume drills (journal replay + plan
+# re-derivation must reproduce the doomed run's tables and losses
+# bit-for-bit on both SpMM paths), router topo_generation skew
+# routing, replica replay-before-readiness, and the two-process
+# elastic drill (sigterm@E preempts the streaming child; the
+# relaunched generation inherits a partition whose deltas it never
+# applied live and must replay the journal to the fleet watermark
+# before training, verified against a from-scratch rebuild). The
+# elastic drill is marked faults+slow and so also rides the broad
+# faults lane; run the marker standalone so a durability regression
+# is named even when the broad lane is trimmed.
+timeout -k 30 "${CHAOS_TIMEOUT_S:-1800}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m journal \
+    -p no:cacheprovider "$@"
+
 # Integrity lane (docs/RESILIENCE.md "Silent data corruption"): the
 # SDC defense plane — Fletcher digest host/device bit-parity, the
 # seeded bitflip-detection matrix (every target class x kernel

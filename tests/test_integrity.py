@@ -233,7 +233,7 @@ def test_quarantine_marker_roundtrip(tmp_path):
 
 
 def test_integrity_record_validates_and_schema_pin():
-    assert SCHEMA_VERSION == 14
+    assert SCHEMA_VERSION == 15
     buf = io.StringIO()
     ml = MetricsLogger(buf)
     ml.run_header(config={}, device={}, mesh={})

@@ -1,0 +1,773 @@
+"""Crash-consistent streaming tests (docs/STREAMING.md "Durability &
+replay"; docs/RESILIENCE.md journal row).
+
+The load-bearing contracts:
+
+  * WAL mechanics — CRC-guarded segment rotation, reopen rescan, torn
+    tails tolerated (and HEALED) only at the newest segment's end,
+    corruption anywhere else loud, ENOSPC degrade-not-lose pending
+    queue with order-preserving drain, watermark rollback
+    (``truncate_after``).
+  * Resume semantics — ``replay_for_resume`` prefers the journal's
+    copy, re-derives torn-away seqs from the plan, rolls back
+    uncommitted entries; ``StreamPlan.skip_journaled`` retires exactly
+    the replayed batches (never dropping pre-resume deltas on the
+    floor like the legacy ``skip_before``).
+  * The kill-mid-stream drill — a process killed between a delta apply
+    and the next checkpoint resumes via journal replay to a trajectory
+    BITWISE-identical (device tables, params, optimizer state, losses)
+    to the uninterrupted run, on the xla and bucket SpMM paths; same
+    for the ``journal-torn`` fault (newest segment truncated, lost
+    suffix re-derived from the plan).
+  * Fleet topology recovery — the router routes around a replica whose
+    reported ``topo_generation`` trails the fleet (zero tickets lost),
+    refuses the health-probe heal path while it is stale, and folds it
+    back in on catch-up; a restarted ReplicaServer replays its journal
+    BEFORE publishing readiness.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from pipegcn_tpu.graph.synthetic import (synthetic_delta_schedule,
+                                         synthetic_graph)
+from pipegcn_tpu.models import ModelConfig
+from pipegcn_tpu.parallel import Trainer, TrainConfig
+from pipegcn_tpu.partition.halo import ShardedGraph
+from pipegcn_tpu.partition.partitioner import partition_graph
+from pipegcn_tpu.resilience.storage import FaultyIO
+from pipegcn_tpu.stream import (DeltaJournal, GraphPatcher, JournalCorrupt,
+                                StreamPlan, replay_for_resume, save_deltas,
+                                verify_against_rebuild)
+from pipegcn_tpu.utils.checkpoint import (load_checkpoint, peek_watermark,
+                                          save_checkpoint)
+
+pytestmark = [pytest.mark.stream, pytest.mark.journal]
+
+P = 4
+
+
+def _batches(n=5, seed=2):
+    g = synthetic_graph(num_nodes=80, avg_degree=4, n_feat=4, n_class=2,
+                        seed=1)
+    return synthetic_delta_schedule(g, n_batches=n, edges_per_batch=3,
+                                    dels_per_batch=1, nodes_per_batch=1,
+                                    seed=seed)
+
+
+def _assert_batches_equal(a, b):
+    assert a.seq == b.seq
+    for f in ("add_edges", "del_edges", "node_feat", "node_label"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+    assert len(a.node_nbrs) == len(b.node_nbrs)
+    for x, y in zip(a.node_nbrs, b.node_nbrs):
+        assert np.array_equal(x, y)
+
+
+# ---------------- WAL mechanics --------------------------------------
+
+
+def test_journal_roundtrip_rotation_and_reopen(tmp_path):
+    """Appends rotate segments at segment_max_records; a reopen rescans
+    to the same last_seq/last_generation and entries round-trip every
+    batch bit-exactly."""
+    bs = _batches(5)
+    d = str(tmp_path / "j")
+    j = DeltaJournal(d, segment_max_records=2)
+    for i, b in enumerate(bs):
+        assert j.append(b, i + 1) is True
+    assert j.last_seq() == 4 and j.last_generation() == 5
+    segs = sorted(n for n in os.listdir(d) if n.startswith("journal-"))
+    assert segs == ["journal-00000000.jsonl", "journal-00000002.jsonl",
+                    "journal-00000004.jsonl"]
+    j2 = DeltaJournal(d, segment_max_records=2)
+    assert j2.last_seq() == 4 and j2.last_generation() == 5
+    ents = j2.entries()
+    assert [g for g, _ in ents] == [1, 2, 3, 4, 5]
+    for (_, got), want in zip(ents, bs):
+        _assert_batches_equal(got, want)
+    # replay() slices by seq
+    assert [b.seq for _, b in j2.replay(2)] == [0, 1, 2]
+
+
+def test_sealed_segment_corruption_is_loud(tmp_path):
+    """A bad record in a SEALED position (not the newest segment's
+    tail) is real corruption: the journal refuses to open rather than
+    replaying through it."""
+    bs = _batches(4)
+    d = str(tmp_path / "j")
+    j = DeltaJournal(d, segment_max_records=2)
+    for i, b in enumerate(bs):
+        j.append(b, i + 1)
+    first = os.path.join(d, "journal-00000000.jsonl")
+    with open(first) as f:
+        lines = f.read().splitlines()
+    rec = json.loads(lines[1])
+    rec["add_edges"] = [[0, 1]]  # payload edit, stale crc
+    lines[1] = json.dumps(rec, sort_keys=True)
+    with open(first, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(JournalCorrupt, match="sealed"):
+        DeltaJournal(d, segment_max_records=2)
+
+
+def test_torn_tail_tolerated_healed_and_appendable(tmp_path):
+    """A half-written last line of the NEWEST segment (crash
+    mid-append) is dropped at scan time, the file is healed back to its
+    good prefix, and subsequent appends land cleanly after it — no
+    record welding onto the torn garbage."""
+    bs = _batches(4)
+    d = str(tmp_path / "j")
+    j = DeltaJournal(d)
+    for i, b in enumerate(bs[:3]):
+        j.append(b, i + 1)
+    path = j._seg_path
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 40)  # cuts into the last record's line
+    j2 = DeltaJournal(d)
+    assert j2.last_seq() == 1  # seq 2 torn away
+    # healed: the torn suffix is gone from disk
+    with open(path, "rb") as f:
+        assert f.read().endswith(b"\n")
+    assert j2.append(bs[3], 9) is True
+    assert [b.seq for _, b in j2.entries()] == [0, 1, 3]
+    # ...and a reopen still parses every line
+    assert DeltaJournal(d).last_seq() == 3
+
+
+def test_enospc_pending_queue_preserves_order(tmp_path):
+    """Degrade-not-lose: appends under an armed enospc seam queue in
+    arrival order (nothing overtakes a queued batch, nothing is lost),
+    and drain_pending makes them durable in order once the disk
+    recovers."""
+    bs = _batches(4)
+    io = FaultyIO()
+    j = DeltaJournal(str(tmp_path / "j"), io=io)
+    assert j.append(bs[0], 1) is True
+    io.arm("enospc")
+    assert j.append(bs[1], 2) is False
+    assert j.append(bs[2], 3) is False
+    assert j.pending_count == 2
+    assert j.last_seq() == 0  # nothing durable past seq 0
+    assert j.drain_pending() == []  # still failing
+    io.disarm("enospc")
+    # order preserved even after recovery: a fresh append may not
+    # overtake the queue
+    assert j.append(bs[3], 4) is False
+    assert j.pending_count == 3
+    drained = j.drain_pending()
+    assert [b.seq for b, _ in drained] == [1, 2, 3]
+    assert [g for _, g in drained] == [2, 3, 4]
+    assert j.pending_count == 0
+    assert [b.seq for _, b in j.entries()] == [0, 1, 2, 3]
+
+
+def test_truncate_after_rolls_back_across_segments(tmp_path):
+    """WAL rollback drops every record past the watermark, rewriting
+    segments atomically — including across a rotation boundary, and
+    down to an empty journal that stays appendable."""
+    bs = _batches(5)
+    d = str(tmp_path / "j")
+    j = DeltaJournal(d, segment_max_records=2)
+    for i, b in enumerate(bs):
+        j.append(b, i + 1)
+    assert j.truncate_after(10) == 0  # nothing past the watermark
+    assert j.truncate_after(2) == 2
+    assert j.last_seq() == 2 and j.last_generation() == 3
+    assert [b.seq for _, b in j.entries()] == [0, 1, 2]
+    assert DeltaJournal(d, segment_max_records=2).last_seq() == 2
+    # roll back everything: the journal empties but keeps working
+    assert j.truncate_after(-1) == 3
+    assert j.last_seq() == -1 and j.entries() == []
+    assert j.append(bs[0], 1) is True
+    assert j.last_seq() == 0
+
+
+def test_tear_newest_segment_fault_hook(tmp_path):
+    """The ``journal-torn@E`` drill hook: the newest segment loses its
+    byte-level tail, the loss count is reported, and the journal
+    remains scannable (recovery re-derives the lost seqs from the
+    plan)."""
+    bs = _batches(5)
+    d = str(tmp_path / "j")
+    j = DeltaJournal(d, segment_max_records=2)
+    for i, b in enumerate(bs):
+        j.append(b, i + 1)
+    lost = j.tear_newest_segment()
+    assert lost >= 1
+    assert j.last_seq() < 4
+    assert DeltaJournal(d, segment_max_records=2).last_seq() == j.last_seq()
+
+
+# ---------------- plan resume semantics ------------------------------
+
+
+def test_skip_journaled_retires_by_seq_not_epoch(tmp_path):
+    """The PR-20 resume fix: ``skip_journaled`` retires exactly the
+    batches WAL replay re-applied (seq <= watermark); a batch scheduled
+    at a pre-resume epoch but past the watermark stays live and is
+    re-delivered at the first boundary (the legacy ``skip_before``
+    would have dropped it on the floor)."""
+    b0, b1, b2 = _batches(3)
+    plan = StreamPlan([(1, b0), (2, b1), (3, b2)])
+    assert [b.seq for b in plan.batches_upto(1)] == [0, 1]
+    assert plan.skip_journaled(0) == 1
+    assert plan.remaining() == 2
+    # resume at epoch 5: due() catches up the passed-epoch entries
+    assert [b.seq for b in plan.due(5)] == [1, 2]
+    assert plan.remaining() == 0
+    # contrast: skip_before would have retired ALL of them silently
+    plan2 = StreamPlan([(1, b0), (2, b1), (3, b2)])
+    plan2.skip_before(5)
+    assert plan2.remaining() == 0 and plan2.due(5) == []
+
+
+def test_checkpoint_watermark_roundtrip(tmp_path):
+    """Checkpoints stamp the journal watermark; ``peek_watermark``
+    reads it without touching state arrays and defaults to the nominal
+    graph (-1, 0)."""
+    d = str(tmp_path / "ck")
+    assert peek_watermark(d) == (-1, 0)
+    state = {"x": np.arange(6, dtype=np.float32)}
+    save_checkpoint(d, state, epoch=3,
+                    extra={"__stream_seq__": 4, "__topo_generation__": 5})
+    assert peek_watermark(d) == (4, 5)
+    got, epoch, extras = load_checkpoint(
+        d, {"x": np.zeros(6, np.float32)}, with_extras=True)
+    assert epoch == 3
+    assert int(extras["__stream_seq__"]) == 4
+    assert int(extras["__topo_generation__"]) == 5
+    assert np.array_equal(got["x"], state["x"])
+
+
+def test_replay_for_resume_prefers_journal_rederives_truncates(tmp_path):
+    """The resume helper applies every seq <= watermark in order —
+    journal copy first, plan fallback for torn-away seqs — and rolls
+    the journal back past the watermark."""
+    bs = _batches(3)
+    d = str(tmp_path / "j")
+    j = DeltaJournal(d)
+    j.append(bs[0], 1)
+    j.append(bs[1], 2)  # seq 2 never made it to the journal (torn)
+    plan = StreamPlan([(1, bs[0]), (2, bs[1]), (3, bs[2])])
+    applied = []
+    stats = replay_for_resume(j, 2, lambda b: applied.append(b.seq),
+                              plan=plan)
+    assert applied == [0, 1, 2]
+    assert stats == {"replayed": 2, "rederived": 1, "truncated": 0,
+                     "skipped": 0, "topo_generation": 3}
+    # uncommitted entries past the watermark are rolled back
+    j2 = DeltaJournal(str(tmp_path / "j2"))
+    for i, b in enumerate(bs):
+        j2.append(b, i + 1)
+    applied2 = []
+    stats2 = replay_for_resume(j2, 0, lambda b: applied2.append(b.seq))
+    assert applied2 == [0]
+    assert stats2["replayed"] == 1 and stats2["truncated"] == 2
+    assert j2.last_seq() == 0
+
+
+# ---------------- kill-mid-stream drill (bitwise) --------------------
+
+
+def _stack(seed=6, n=240, slack=0.25, spmm="xla", n_epochs=6):
+    g = synthetic_graph(num_nodes=n, avg_degree=6, n_feat=10, n_class=4,
+                        seed=seed)
+    parts = partition_graph(g, P)
+    sg = ShardedGraph.build(g, parts, n_parts=P, slack=slack)
+    cfg = ModelConfig(layer_sizes=(10, 12, 4), norm="layer",
+                      dropout=0.0, model="graphsage",
+                      train_size=sg.n_train_global, spmm_impl=spmm)
+    tcfg = TrainConfig(seed=3, enable_pipeline=False, n_epochs=n_epochs,
+                       log_every=10_000, fused_epochs=1)
+    t = Trainer(sg, cfg, tcfg)
+    patcher = GraphPatcher(g, sg, parts, slack=slack)
+    t.enable_stream(patcher)
+    return g, parts, sg, cfg, tcfg, t, patcher
+
+
+def _assert_data_bit_identical(t, t2):
+    d1 = jax.device_get(t.data)
+    d2 = jax.device_get(t2.data)
+    assert set(d1) == set(d2)
+    for k in sorted(d1):
+        a, b = np.asarray(d1[k]), np.asarray(d2[k])
+        assert a.shape == b.shape, (k, a.shape, b.shape)
+        assert a.dtype == b.dtype, (k, a.dtype, b.dtype)
+        assert np.array_equal(a, b), (
+            k, np.argwhere(a != b)[:5] if a.shape else (a, b))
+
+
+def _assert_state_bit_identical(t, t2):
+    s1 = jax.device_get(t.host_state())
+    s2 = jax.device_get(t2.host_state())
+    flat1 = jax.tree_util.tree_flatten_with_path(s1)[0]
+    flat2 = dict(jax.tree_util.tree_flatten_with_path(s2)[0])
+    assert len(flat1) == len(flat2)
+    for path, v in flat1:
+        a, b = np.asarray(v), np.asarray(flat2[path])
+        assert a.shape == b.shape and a.dtype == b.dtype, path
+        assert np.array_equal(a, b), path
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("spmm", ["xla", "bucket"])
+def test_kill_mid_stream_resume_is_bitwise(tmp_path, spmm):
+    """THE acceptance drill: a process SIGKILLed between delta applies
+    and the next checkpoint resumes via journal replay + WAL rollback +
+    plan re-delivery to a trajectory bitwise-identical to the
+    uninterrupted run — device tables, params, optimizer moments, and
+    losses — on both SpMM paths."""
+    ck = str(tmp_path / "ck")
+    jdir = str(tmp_path / "journal")
+
+    # -- the doomed process: delta 0 applied + checkpointed; deltas 1,2
+    # journaled + applied, then SIGKILL before any further checkpoint
+    g, parts, sg, cfg, tcfg, t, patcher = _stack(spmm=spmm)
+    batches = synthetic_delta_schedule(g, n_batches=3, edges_per_batch=4,
+                                       dels_per_batch=2,
+                                       nodes_per_batch=1, seed=21)
+    dpath = str(tmp_path / "d.jsonl")
+    save_deltas(dpath, batches)
+    j = DeltaJournal(jdir)
+    assert j.append(batches[0], 1) is True       # WAL-first
+    assert not t.apply_graph_deltas(batches[0]).repadded
+    assert np.isfinite(t.train_epoch(0))
+    save_checkpoint(ck, t.host_state(), epoch=1,
+                    extra={"__stream_seq__": 0, "__topo_generation__": 1})
+    for gen, b in ((2, batches[1]), (3, batches[2])):
+        assert j.append(b, gen) is True
+        assert not t.apply_graph_deltas(b).repadded
+    del t, j  # SIGKILL: no further checkpoint, no clean shutdown
+
+    # -- the resumed process: NOMINAL rebuild, replay to the watermark,
+    # roll back the uncommitted tail, restore state, live re-delivery
+    g2, parts2, sg2, cfg2, tcfg2, t2, patcher2 = _stack(spmm=spmm)
+    wm_seq, wm_gen = peek_watermark(ck)
+    assert (wm_seq, wm_gen) == (0, 1)
+    j2 = DeltaJournal(jdir)
+    assert j2.last_seq() == 2  # the un-checkpointed applies survived
+    plan = StreamPlan.parse(f"{dpath}@0")  # seqs 0,1,2 at epochs 0,1,2
+    stats = replay_for_resume(j2, wm_seq, t2.apply_graph_deltas,
+                              plan=plan)
+    assert stats["replayed"] == 1 and stats["rederived"] == 0
+    assert stats["truncated"] == 2  # past-watermark entries rolled back
+    assert t2.topo_generation == wm_gen
+    assert plan.skip_journaled(wm_seq) == 1
+    host, start_epoch = load_checkpoint(ck, t2.host_state())
+    t2.restore_state(host)
+    assert start_epoch == 1
+    resumed_losses = []
+    for e in range(start_epoch, 3):
+        for b in plan.due(e):  # rolled-back deltas re-deliver live
+            assert j2.append(b, t2.topo_generation + 1) is True
+            assert not t2.apply_graph_deltas(b).repadded
+        resumed_losses.append(float(t2.train_epoch(e)))
+    assert t2.topo_generation == 3 and j2.last_seq() == 2
+
+    # -- the uninterrupted oracle: same schedule, never killed
+    g3, parts3, sg3, cfg3, tcfg3, t3, patcher3 = _stack(spmm=spmm)
+    oracle_losses = []
+    for e in range(3):
+        assert not t3.apply_graph_deltas(batches[e]).repadded
+        oracle_losses.append(float(t3.train_epoch(e)))
+
+    _assert_data_bit_identical(t2, t3)
+    _assert_state_bit_identical(t2, t3)
+    np.testing.assert_allclose(resumed_losses, oracle_losses[1:],
+                               rtol=1e-6)
+    # the packaged oracle agrees: replayed tables == from-scratch build
+    audit = verify_against_rebuild(patcher2)
+    assert audit["tables_match"], audit["mismatch"]
+
+
+@pytest.mark.faults
+def test_journal_torn_resume_rederives_from_plan_bitwise(tmp_path):
+    """The ``journal-torn`` drill end-to-end: the newest segment is
+    truncated after the checkpoint covered its records, so resume walks
+    back to the surviving prefix and re-derives the torn-away seq from
+    the plan — still bitwise-identical to the uninterrupted run."""
+    ck = str(tmp_path / "ck")
+    jdir = str(tmp_path / "journal")
+
+    g, parts, sg, cfg, tcfg, t, patcher = _stack()
+    batches = synthetic_delta_schedule(g, n_batches=3, edges_per_batch=4,
+                                       dels_per_batch=2,
+                                       nodes_per_batch=1, seed=21)
+    dpath = str(tmp_path / "d.jsonl")
+    save_deltas(dpath, batches)
+    j = DeltaJournal(jdir, segment_max_records=2)
+    for i, b in enumerate(batches):
+        assert j.append(b, i + 1) is True
+        assert not t.apply_graph_deltas(b).repadded
+    assert np.isfinite(t.train_epoch(0))
+    save_checkpoint(ck, t.host_state(), epoch=1,
+                    extra={"__stream_seq__": 2, "__topo_generation__": 3})
+    assert j.tear_newest_segment() == 1  # seq 2's record is gone
+    del t, j
+
+    g2, parts2, sg2, cfg2, tcfg2, t2, patcher2 = _stack()
+    wm_seq, wm_gen = peek_watermark(ck)
+    assert (wm_seq, wm_gen) == (2, 3)
+    j2 = DeltaJournal(jdir, segment_max_records=2)
+    assert j2.last_seq() == 1
+    plan = StreamPlan.parse(f"{dpath}@0")
+    stats = replay_for_resume(j2, wm_seq, t2.apply_graph_deltas,
+                              plan=plan)
+    assert stats["replayed"] == 2 and stats["rederived"] == 1
+    assert stats["truncated"] == 0
+    assert t2.topo_generation == 3 == wm_gen
+    assert plan.skip_journaled(wm_seq) == 3
+    host, start_epoch = load_checkpoint(ck, t2.host_state())
+    t2.restore_state(host)
+    resumed_losses = [float(t2.train_epoch(e))
+                      for e in range(start_epoch, 3)]
+
+    g3, parts3, sg3, cfg3, tcfg3, t3, patcher3 = _stack()
+    for b in batches:
+        assert not t3.apply_graph_deltas(b).repadded
+    oracle_losses = [float(t3.train_epoch(e)) for e in range(3)]
+
+    _assert_data_bit_identical(t2, t3)
+    _assert_state_bit_identical(t2, t3)
+    np.testing.assert_allclose(resumed_losses, oracle_losses[1:],
+                               rtol=1e-6)
+    assert verify_against_rebuild(patcher2)["tables_match"]
+
+
+def test_fit_journals_deltas_and_torn_fault_stays_scannable(tmp_path):
+    """fit() integration: every plan-delivered delta is journaled
+    (op="append" records with the watermark lag), checkpoints stamp the
+    watermark, and the ``journal-torn@E`` fault tears the newest
+    segment loudly while leaving the journal scannable (healed tail)."""
+    from pipegcn_tpu.obs.metrics import MetricsLogger, read_metrics
+    from pipegcn_tpu.resilience.faults import FaultPlan
+
+    ck = str(tmp_path / "ck")
+    jdir = str(tmp_path / "journal")
+    g, parts, sg, cfg, tcfg, t, patcher = _stack(n_epochs=6)
+    batches = synthetic_delta_schedule(g, n_batches=2, edges_per_batch=4,
+                                       dels_per_batch=2,
+                                       nodes_per_batch=1, seed=9)
+    dpath = str(tmp_path / "d.jsonl")
+    save_deltas(dpath, batches)
+    plan = StreamPlan.parse(f"{dpath}@2")  # epochs 2, 3
+    j = DeltaJournal(jdir)
+    mpath = str(tmp_path / "m.jsonl")
+    with MetricsLogger(mpath) as m:
+        t.fit(None, log_fn=lambda *_: None, metrics=m, stream_plan=plan,
+              fault_plan=FaultPlan.parse("journal-torn@4"), journal=j,
+              checkpoint_dir=ck, checkpoint_every=2)
+    recs = read_metrics(mpath)
+    appends = [r for r in recs if r["event"] == "journal"
+               and r["op"] == "append"]
+    assert [r["seq"] for r in appends] == [0, 1]
+    assert [r["topo_generation"] for r in appends] == [1, 2]
+    assert all(r["source"] == "trainer" for r in appends)
+    faults = [r for r in recs if r["event"] == "fault"]
+    assert any(r.get("reason") == "journal-torn" for r in faults)
+    # the watermark made it into the final checkpoint
+    wm_seq, wm_gen = peek_watermark(ck)
+    assert wm_gen == 2 and wm_seq == 1
+    # torn journal reopens cleanly (possibly with records lost — that
+    # is what the plan re-derivation path is for)
+    assert DeltaJournal(jdir).last_seq() <= 1
+
+
+# ---------------- fleet topology recovery ----------------------------
+
+
+class _FakeClient:
+    def __init__(self):
+        self.served = 0
+
+    def query(self, ids):
+        ids = np.asarray(ids)
+        self.served += int(ids.size)
+        return np.stack([ids, ids * 2], axis=1).astype(np.float32)
+
+
+def test_router_topo_skew_routes_around_then_rejoins():
+    """Satellite: a replica whose topo_generation trails the fleet max
+    is routed around (one ``topo-skew:`` fault record edge), the
+    health-probe mark_up heal path cannot route it back in, traffic
+    lands on the caught-up survivor with zero tickets lost, and the
+    replica rejoins on the catch-up edge after journal replay."""
+    from pipegcn_tpu.serve.router import Router
+
+    clients = {0: _FakeClient(), 1: _FakeClient()}
+    faults = []
+    r = Router(clients, policy="least-queue",
+               on_fault=lambda rid, why: faults.append((rid, why)))
+    assert r.note_topo_generation(0, 3) is None
+    assert r.note_topo_generation(1, 3) is None
+    assert r.note_topo_generation(0, 5) is None  # fleet advances
+    # replica 1 reports again, still at 3: skew DOWN edge
+    assert r.note_topo_generation(1, 3) is True
+    assert not r.is_up(1)
+    assert len(faults) == 1 and faults[0][0] == 1
+    assert faults[0][1].startswith("topo-skew:")
+    assert "generation 3" in faults[0][1] and "fleet at 5" in faults[0][1]
+    # the manager's health-probe heal path must NOT route it back in
+    assert r.mark_up(1) is False
+    assert not r.is_up(1)
+    # zero tickets lost: every batch lands on the fresh survivor
+    ids = np.arange(8, dtype=np.int64)
+    out, rid = r.dispatch(ids)
+    assert rid == 0 and out.shape == (8, 2)
+    assert r.n_failovers == 0 and clients[1].served == 0
+    # duplicate stale report: no second edge
+    assert r.note_topo_generation(1, 3) is None
+    assert len(faults) == 1
+    # journal replay caught the replica up: UP edge, back in rotation
+    assert r.note_topo_generation(1, 5) is False
+    assert r.is_up(1)
+    assert r.topo_generations() == {0: 5, 1: 5}
+    r.remove_replica(1)
+    assert r.topo_generations() == {0: 5}
+
+
+def test_fleet_manager_note_topo_emits_skew_records(tmp_path):
+    """FleetManager.note_topo folds reported generations into the
+    router and emits exactly one contracted ``fleet`` record per edge:
+    ``topo-skew`` (with the fleet generation) and ``topo-caught-up``."""
+    from pipegcn_tpu.obs.metrics import MetricsLogger, read_metrics
+    from pipegcn_tpu.obs.schema import validate_record
+    from pipegcn_tpu.serve.fleet import FleetManager
+    from pipegcn_tpu.serve.router import Router
+
+    router = Router({0: _FakeClient(), 1: _FakeClient()})
+    mpath = str(tmp_path / "m.jsonl")
+    with MetricsLogger(mpath) as ml:
+        mgr = FleetManager(str(tmp_path / "fleet"), 2, [], ml=ml,
+                           log=lambda m: None)
+        assert mgr.note_topo(0, 2, router) is None
+        assert mgr.note_topo(1, 2, router) is None
+        assert mgr.note_topo(0, 4, router) is None
+        assert mgr.note_topo(1, 2, router) is True   # skew edge
+        assert mgr.note_topo(1, 2, router) is None   # no duplicate
+        assert mgr.note_topo(1, 4, router) is False  # caught up
+        assert mgr.note_topo(1, None, router) is None
+    fleet = [r for r in read_metrics(mpath) if r.get("event") == "fleet"]
+    assert [r["kind"] for r in fleet] == ["topo-skew", "topo-caught-up"]
+    assert fleet[0]["replica"] == 1
+    assert fleet[0]["topo_generation"] == 2
+    assert fleet[0]["fleet_generation"] == 4
+    assert fleet[1]["topo_generation"] == 4
+    for r in fleet:
+        validate_record(r)
+
+
+def test_replica_server_replays_journal_before_readiness(tmp_path):
+    """A restarted serving replica replays its journal BEFORE
+    publishing readiness: the ready file carries the post-replay
+    topo_generation, and the replay audit record is emitted."""
+    from pipegcn_tpu.obs.metrics import MetricsLogger, read_metrics
+    from pipegcn_tpu.serve.fleet import (ReplicaServer, TcpReplicaClient,
+                                         _read_ready)
+
+    class Eng:
+        fully_fresh = True
+        staleness_age = 0
+        param_generation = 0
+        param_staleness = 0
+        topo_generation = 0
+
+        def query(self, ids, stats=None):
+            ids = np.asarray(ids)
+            return np.stack([ids, ids * 2], axis=1).astype(np.float32)
+
+    eng = Eng()
+    order = []
+
+    def replay():
+        order.append("replay")
+        eng.topo_generation = 7  # journal replay advanced the graph
+        return 3
+
+    mpath = str(tmp_path / "m.jsonl")
+    ml = MetricsLogger(mpath)
+    srv = ReplicaServer(eng, str(tmp_path), 0, incarnation=2, ml=ml,
+                        replay=replay, heartbeat_interval_s=0.05,
+                        swap_poll_s=30.0, report_every_s=30.0,
+                        log=lambda m: None)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    info, deadline = None, time.monotonic() + 30
+    while info is None and time.monotonic() < deadline:
+        info = _read_ready(str(tmp_path), 0)
+        time.sleep(0.01)
+    try:
+        assert info is not None, "replica never published readiness"
+        # replay ran before the publish, and readiness reports the
+        # POST-replay generation
+        assert order == ["replay"]
+        assert info["topo_generation"] == 7
+        cl = TcpReplicaClient("127.0.0.1", info["port"], 0)
+        try:
+            _, meta = cl.query(np.array([1, 2]))
+            assert meta["topo_generation"] == 7
+            assert cl.health()["topo_generation"] == 7
+            cl.stop()
+            th.join(timeout=10)
+            assert not th.is_alive()
+        finally:
+            cl.close()
+    finally:
+        srv.request_stop()
+        ml.close()
+    recs = read_metrics(mpath)
+    rep = [r for r in recs if r.get("event") == "journal"
+           and r.get("op") == "replay"]
+    assert len(rep) == 1
+    assert rep[0]["n_records"] == 3
+    assert rep[0]["topo_generation"] == 7
+    assert rep[0]["source"] == "replica-m0"
+
+
+# ---------------- soak invariant #9 + postmortem verdict -------------
+
+
+def test_soak_check_journal_invariant(tmp_path):
+    """Invariant #9 passes only when the resume stream carries a
+    journal op="verify" record with tables_match at the nominal
+    topo_generation."""
+    from pipegcn_tpu.resilience.soak import check_journal
+
+    p = str(tmp_path / "resume.jsonl")
+
+    def write(recs):
+        with open(p, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    good = {"event": "journal", "op": "verify", "seq": 0,
+            "topo_generation": 1, "n_records": 0, "source": "resume",
+            "tables_match": True, "mismatch": []}
+    write([{"event": "journal", "op": "replay", "seq": 0,
+            "topo_generation": 1, "n_records": 1, "source": "resume"},
+           good])
+    assert check_journal(p, n_batches=1)["ok"]
+    # no verify record: the journaled resume did not run
+    write([])
+    assert not check_journal(p, n_batches=1)["ok"]
+    # tables diverge
+    write([{**good, "tables_match": False, "mismatch": ["edge_src"]}])
+    assert not check_journal(p, n_batches=1)["ok"]
+    # wrong generation: a delta was lost or double-applied
+    write([{**good, "topo_generation": 2}])
+    assert not check_journal(p, n_batches=1)["ok"]
+    assert check_journal(str(tmp_path / "missing.jsonl"),
+                         n_batches=1)["ok"] is False
+
+
+def test_postmortem_topo_rollback_verdict():
+    """The explain CLI's ``topo-rollback`` rule fires on watermark
+    rollback records, citing the gap, and stays quiet otherwise."""
+    from pipegcn_tpu.obs.postmortem import _RULES, _rule_topo_rollback
+
+    assert "topo-rollback" in [name for name, _ in _RULES]
+    b = {"records": [
+        {"event": "journal", "op": "truncate", "seq": 3,
+         "topo_generation": 4, "n_records": 2, "source": "resume"},
+        {"event": "journal", "op": "replay", "seq": 3,
+         "topo_generation": 4, "n_records": 4, "rederived": 1,
+         "source": "resume"},
+    ]}
+    v = _rule_topo_rollback(b)
+    assert v is not None and v["confidence"] == pytest.approx(0.6)
+    ev = " ".join(v["evidence"])
+    assert "rolled back" in ev and "watermark seq 3" in ev
+    assert "re-derived" in ev
+    # no rollback, no verdict (a zero-drop truncate is bookkeeping)
+    assert _rule_topo_rollback({"records": [
+        {"event": "journal", "op": "truncate", "seq": 3,
+         "topo_generation": 4, "n_records": 0, "source": "resume"},
+    ]}) is None
+    assert _rule_topo_rollback({"records": []}) is None
+
+
+# ---------------- elastic inheritance drill (subprocess, slow) -------
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_elastic_successor_inherits_journaled_deltas(tmp_path):
+    """Two OS processes under the elastic supervisor: generation 0
+    applies a scheduled delta live (journaled under the shared
+    checkpoint dir) and is preempted; the generation-1 successor — a
+    fresh process that NEVER applied that delta live — inherits the
+    partitions, replays the journal to the crash checkpoint's
+    watermark before training, and finishes with the post-run rebuild
+    audit green. The supervisor's membership record carries the
+    watermark the relaunched fleet replayed to."""
+    import subprocess
+    import sys
+
+    from pipegcn_tpu.obs import read_metrics
+
+    from pipegcn_tpu.graph import load_data
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Same dataset string the child trains on (synthetic loads are
+    # seed-stable), so the batch is valid against the child's graph.
+    g = load_data("synthetic:240:6:10:4")
+    batches = synthetic_delta_schedule(g, n_batches=1, edges_per_batch=4,
+                                       dels_per_batch=2,
+                                       nodes_per_batch=1, seed=21)
+    dpath = str(tmp_path / "d.jsonl")
+    save_deltas(dpath, batches)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": repo,
+        "PYTHONUNBUFFERED": "1",
+    }
+    ck = str(tmp_path / "ck")
+    cmd = [
+        sys.executable, "-m", "pipegcn_tpu.cli.elastic",
+        "--max-restarts", "3", "--backoff-base", "0.1",
+        "--metrics-out", str(tmp_path / "sup.jsonl"),
+        "--",
+        "--dataset", "synthetic:240:6:10:4",
+        "--n-partitions", "2", "--parts-per-node", "2",
+        "--n-epochs", "8", "--n-hidden", "12", "--dropout", "0.0",
+        "--log-every", "1000", "--fix-seed", "--seed", "7", "--no-eval",
+        "--partition-dir", str(tmp_path / "parts"),
+        "--checkpoint-dir", ck, "--checkpoint-every", "2",
+        "--stream-plan", f"{dpath}@3", "--local-reorder", "none",
+        "--fault-plan", "sigterm@5",
+        "--metrics-out", str(tmp_path / "m.jsonl"),
+    ]
+    proc = subprocess.run(cmd, env=env, cwd=repo, timeout=560,
+                          capture_output=True, text=True)
+    tail = (proc.stdout + proc.stderr)[-3000:]
+    assert proc.returncode == 0, tail
+    # the journal survived under the shared checkpoint dir at seq 0
+    assert DeltaJournal(os.path.join(ck, "journal")).last_seq() == 0
+    # the successor's metrics stream: replay audit + rebuild verify
+    resume = read_metrics(tmp_path / "m.g1.m0.jsonl")
+    journal = [r for r in resume if r.get("event") == "journal"]
+    replays = [r for r in journal if r["op"] == "replay"]
+    assert replays and replays[0]["n_records"] == 1, tail
+    assert replays[0]["source"] == "resume"
+    verify = [r for r in journal if r["op"] == "verify"]
+    assert verify, tail
+    assert verify[-1]["tables_match"] is True
+    assert verify[-1]["topo_generation"] == 1
+    # the replan membership record surfaces the inherited watermark
+    membership = [r for r in read_metrics(tmp_path / "sup.jsonl")
+                  if r.get("event") == "membership"]
+    resumed = [r for r in membership
+               if r.get("trigger") == "preempt-resume"]
+    assert resumed, tail
+    assert resumed[0].get("stream_seq") == 0
+    assert resumed[0].get("topo_generation") == 1

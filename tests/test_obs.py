@@ -221,6 +221,27 @@ def test_schema_v14_drift_guard():
         assert obs_schema.SCHEMA_VERSION > 14
 
 
+# FROZEN copy of the v15 additions (v14 + the `journal` kind the
+# crash-consistent-streaming PR added: the write-ahead delta journal's
+# append/watermark/replay/truncate/verify/degraded/recovered/skew
+# lifecycle). Same contract as the earlier guards.
+_V15_JOURNAL_FIELDS = {
+    "event": "string", "op": "string", "seq": "integer",
+    "topo_generation": "integer", "n_records": "integer",
+    "source": "string",
+}
+
+
+def test_schema_v15_drift_guard():
+    if obs_schema.SCHEMA_VERSION == 15:
+        for name, tag in _V15_JOURNAL_FIELDS.items():
+            assert obs_schema.JOURNAL_FIELDS.get(name) == tag, (
+                f"schema field journal.{name} removed or retyped "
+                f"without bumping SCHEMA_VERSION")
+    else:
+        assert obs_schema.SCHEMA_VERSION > 15
+
+
 def test_validate_record():
     validate_record({"event": "epoch", "epoch": 0, "step_time_s": 0.1,
                      "loss": 1.0, "grad_norm": 0.5, "halo_bytes": 128,

@@ -361,9 +361,10 @@ def test_compose_schedule_deterministic_and_constrained():
                 assert epoch % cfg.checkpoint_every == 0
             if kind in TERMINAL_KINDS:
                 last_term = max(last_term, epoch)
-        # no delta replay on resume exists: the delta must apply after
-        # the last restart boundary
-        assert stream_epoch > last_term or last_term == 0
+        # delta placement is unconstrained now that the WAL journal
+        # replays deltas across restart boundaries — only the epoch
+        # range is pinned
+        assert 0 < stream_epoch < cfg.n_epochs
         FaultPlan.parse(",".join(sched))  # every schedule parses
     forced = SoakConfig(seed=3, force_faults=("enospc@4",))
     assert compose_schedule(forced, 0)[0][0] == "enospc@4"
